@@ -1,0 +1,34 @@
+"""Test environment: force an 8-device virtual CPU platform so sharding /
+multi-chip code paths are exercised without TPU hardware (SURVEY.md §4:
+the reference ran its distributed tests on loopback; ours run on a virtual
+device mesh)."""
+
+import os
+
+# Must be set before jax import (any jax import initializes the backend).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    """Every test starts from the same global PRNG state (parity: the
+    reference's seed files pinned before each functional test)."""
+    from veles_tpu import prng
+    prng._generators.clear()
+    yield
+    prng._generators.clear()

@@ -1,0 +1,45 @@
+import pickle
+
+from veles_tpu.config import Config, parse_override
+
+
+def test_autovivify_and_dotted_assignment():
+    c = Config()
+    c.mnist.loader.minibatch_size = 60
+    assert c.mnist.loader.minibatch_size == 60
+    assert "mnist" in c and "loader" in c.mnist
+
+
+def test_update_deep_merge():
+    c = Config()
+    c.a.b = 1
+    c.update({"a": {"c": 2}, "d": 3})
+    assert c.a.b == 1 and c.a.c == 2 and c.d == 3
+
+
+def test_dict_assignment_becomes_node():
+    c = Config()
+    c.model = {"layers": [10, 5], "lr": 0.1}
+    assert c.model.lr == 0.1
+    assert c.model.layers == [10, 5]
+
+
+def test_override_and_parse():
+    c = Config()
+    c.a.b.lr = 0.1
+    path, value = parse_override("root.a.b.lr=0.5")
+    c.override(path, value)
+    assert c.a.b.lr == 0.5
+    # non-literal values stay strings
+    path, value = parse_override("a.name=hello")
+    assert value == "hello"
+
+
+def test_to_dict_roundtrip_and_pickle():
+    c = Config()
+    c.x.y = [1, 2]
+    c.z = "s"
+    d = c.to_dict()
+    assert d == {"x": {"y": [1, 2]}, "z": "s"}
+    c2 = pickle.loads(pickle.dumps(c))
+    assert c2.x.y == [1, 2] and c2.z == "s"

@@ -1,0 +1,70 @@
+import pickle
+
+import jax
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice, make_device
+from veles_tpu.memory import Array
+
+
+def test_array_host_device_coherence():
+    a = Array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    dev = a.devmem()
+    assert isinstance(dev, jax.Array)
+    # device-side result lands without host transfer until mapped
+    a.set_devmem(dev * 2)
+    a.map_read()
+    np.testing.assert_array_equal(a.mem, np.arange(6).reshape(2, 3) * 2)
+
+
+def test_array_host_write_invalidates_device():
+    a = Array(np.zeros(4, np.float32))
+    d1 = a.devmem()
+    a.map_write()
+    a.mem[:] = 5
+    a.unmap()
+    d2 = a.devmem()
+    assert d2 is not d1
+    np.testing.assert_array_equal(np.asarray(d2), np.full(4, 5, np.float32))
+
+
+def test_array_pickles_host_only():
+    a = Array(np.ones(3, np.float32))
+    a.devmem()
+    b = pickle.loads(pickle.dumps(a))
+    np.testing.assert_array_equal(b.mem, np.ones(3, np.float32))
+    assert b._dev is None
+
+
+def test_array_indexing_and_len():
+    a = Array(np.arange(10.0))
+    assert len(a) == 10 and a[3] == 3.0
+    a[0] = 9.0
+    assert a.mem[0] == 9.0
+
+
+def test_device_factory():
+    assert isinstance(make_device("numpy"), NumpyDevice)
+    xd = make_device("xla")
+    assert isinstance(xd, XLADevice) and len(xd.devices) >= 1
+
+
+def test_prng_determinism_and_registry():
+    g1 = prng.get("w", seed=77)
+    fill_a = g1.fill_uniform((3, 3), -1, 1)
+    g1.seed(77)
+    fill_b = g1.fill_uniform((3, 3), -1, 1)
+    np.testing.assert_array_equal(fill_a, fill_b)
+    assert prng.get("w") is g1
+
+    k1 = g1.next_key()
+    k2 = g1.next_key()
+    assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+
+
+def test_prng_pickle_roundtrip():
+    g = prng.get("p", seed=5)
+    g.permutation(10)
+    g2 = pickle.loads(pickle.dumps(g))
+    np.testing.assert_array_equal(g.permutation(10), g2.permutation(10))

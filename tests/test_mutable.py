@@ -1,0 +1,40 @@
+import pickle
+
+from veles_tpu.mutable import Bool
+
+
+def test_plain_bool_assignment_and_callbacks():
+    b = Bool(False)
+    seen = []
+    b.on_change(seen.append)
+    b <<= True
+    assert bool(b) is True
+    b <<= True  # no flip, no callback
+    b <<= False
+    assert seen == [True, False]
+
+
+def test_derived_bools_are_live_views():
+    a, b = Bool(False), Bool(True)
+    both = a & b
+    either = a | b
+    nota = ~a
+    assert not both and either and nota
+    a <<= True
+    assert both and either and not nota
+
+
+def test_derived_bool_rejects_assignment():
+    a = Bool()
+    try:
+        (a & a).set(True)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("derived Bool must reject assignment")
+
+
+def test_pickle_flattens_to_value():
+    a, b = Bool(True), Bool(True)
+    d = pickle.loads(pickle.dumps(a & b))
+    assert bool(d) is True

@@ -1,0 +1,170 @@
+"""Runtime semantics tests (parity with reference veles/tests: unit wiring,
+gates, loops, attribute links, initialize-retry)."""
+
+import pickle
+
+from veles_tpu.units import TrivialUnit, Unit
+from veles_tpu.workflow import Repeater, Workflow
+
+
+class Recorder(Unit):
+    """Appends its name to the workflow-level trace each firing."""
+
+    def run(self):
+        self.workflow.trace.append(self.name)
+
+
+def make_wf():
+    wf = Workflow(name="wf")
+    wf.trace = []
+    return wf
+
+
+def test_linear_chain_fires_in_order():
+    wf = make_wf()
+    a = Recorder(wf, name="a")
+    b = Recorder(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(b)
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["a", "b"]
+
+
+def test_and_gate_waits_for_all_inputs():
+    wf = make_wf()
+    a = Recorder(wf, name="a")
+    b = Recorder(wf, name="b")
+    j = Recorder(wf, name="join")
+    a.link_from(wf.start_point)
+    b.link_from(wf.start_point)
+    j.link_from(a, b)
+    wf.end_point.link_from(j)
+    wf.initialize()
+    wf.run()
+    assert wf.trace.index("join") > max(wf.trace.index("a"),
+                                        wf.trace.index("b"))
+    assert wf.trace.count("join") == 1
+
+
+def test_gate_block_drops_pulse_and_skip_forwards():
+    wf = make_wf()
+    a = Recorder(wf, name="a")
+    b = Recorder(wf, name="b")
+    c = Recorder(wf, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_skip <<= True
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["a", "c"]  # b skipped but pulse forwarded
+
+    wf2 = make_wf()
+    a2 = Recorder(wf2, name="a")
+    b2 = Recorder(wf2, name="b")
+    a2.link_from(wf2.start_point)
+    b2.link_from(a2)
+    wf2.end_point.link_from(b2)
+    b2.gate_block <<= True
+    wf2.initialize()
+    wf2.run()
+    assert wf2.trace == ["a"]  # pulse dropped; end never reached
+    assert wf2.stopped is False or wf2.trace == ["a"]
+
+
+def test_training_loop_with_repeater_and_decision_gate():
+    """The canonical reference topology: start -> repeater -> work ->
+    decision; loop back via repeater until complete; end gated on complete."""
+    wf = make_wf()
+    rep = Repeater(wf)
+    work = Recorder(wf, name="work")
+
+    class Decision(Unit):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            from veles_tpu.mutable import Bool
+            self.complete = Bool(False)
+            self.iterations = 0
+
+        def run(self):
+            self.iterations += 1
+            if self.iterations >= 5:
+                self.complete <<= True
+
+    dec = Decision(wf, name="decision")
+    rep.link_from(wf.start_point)
+    work.link_from(rep)
+    dec.link_from(work)
+    rep.link_from(dec)               # loop back (repeater = OR gate)
+    rep.gate_block = dec.complete    # stop looping when complete
+    wf.end_point.link_from(dec)
+    wf.end_point.gate_block = ~dec.complete
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["work"] * 5
+    assert dec.iterations == 5
+
+
+def test_link_attrs_live_aliasing_both_ways():
+    wf = make_wf()
+    src = TrivialUnit(wf, name="src")
+    dst = TrivialUnit(wf, name="dst")
+    src.output = 41
+    dst.link_attrs(src, ("input", "output"))
+    assert dst.input == 41
+    src.output = 42
+    assert dst.input == 42
+    dst.input = 7          # writes through
+    assert src.output == 7
+
+
+def test_initialize_retry_order():
+    wf = make_wf()
+
+    class Dependent(Unit):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.tries = 0
+
+        def initialize(self, **kw):
+            self.tries += 1
+            if not getattr(self.workflow, "provider_ready", False):
+                return False
+            return super().initialize(**kw)
+
+    class Provider(Unit):
+        def initialize(self, **kw):
+            self.workflow.provider_ready = True
+            return super().initialize(**kw)
+
+    d = Dependent(wf, name="dep")   # added before provider on purpose
+    Provider(wf, name="prov")
+    wf.initialize()
+    assert d.tries == 2 and d.is_initialized
+
+
+def test_unit_timing_stats():
+    wf = make_wf()
+    a = Recorder(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    wf.initialize()
+    wf.run()
+    table = wf.print_stats()
+    assert "a" in table and "TOTAL" in table
+    assert a.run_count == 1 and a.run_time >= 0
+
+
+def test_workflow_units_picklable():
+    wf = make_wf()
+    a = Recorder(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    wf.initialize()
+    wf.run()
+    blob = pickle.dumps(wf)
+    wf2 = pickle.loads(blob)
+    assert [u.name for u in wf2.units][:1] == ["StartPoint"]

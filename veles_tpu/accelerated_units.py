@@ -1,0 +1,78 @@
+"""Backend-polymorphic units: numpy golden path vs jit-compiled XLA path.
+
+Parity: reference `veles/accelerated_units.py` (`AcceleratedUnit`,
+`AcceleratedWorkflow`) — `initialize()` dispatches to
+`ocl_init`/`cuda_init`/`numpy_init` and `run()` to the matching `*_run`; the
+reference assembles and compiles `.cl`/`.cu` kernel sources here.
+
+TPU-first: the kernel-template/compile machinery is replaced by `XLAUnit`:
+a unit declares a pure `compute(*arrays) -> arrays` function; `xla_init`
+jits it once (XLA traces, tiles onto the MXU, fuses — everything the
+reference's hand-written BLOCK_SIZE-tuned kernels did by hand). The jit
+cache is keyed by the function identity + input shapes, mirroring the
+reference's source-hash program cache at zero code cost.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from veles_tpu.backends import Device
+from veles_tpu.units import Unit
+
+
+class AcceleratedUnit(Unit):
+    """A unit whose work is device-dispatched."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.device: Optional[Device] = None
+
+    @property
+    def backend(self) -> str:
+        """Dispatch key from Device.backend_name; None device (host-only
+        workflows, tests) resolves to "xla" — jax default placement."""
+        return getattr(self.device, "backend_name", "xla")
+
+    def initialize(self, device: Optional[Device] = None,
+                   **kwargs: Any) -> Optional[bool]:
+        self.device = device
+        ret = getattr(self, f"{self.backend}_init")()
+        if ret is False:
+            return False
+        return super().initialize(device=device, **kwargs)
+
+    def run(self) -> None:
+        getattr(self, f"{self.backend}_run")()
+
+    # Override points. Default: xla falls back to numpy implementation so
+    # host-side units (loaders, decision) need only one code path.
+    def numpy_init(self) -> Optional[bool]:
+        return None
+
+    def xla_init(self) -> Optional[bool]:
+        return self.numpy_init()
+
+    def numpy_run(self) -> None:
+        pass
+
+    def xla_run(self) -> None:
+        self.numpy_run()
+
+
+class XLAUnit(AcceleratedUnit):
+    """An AcceleratedUnit whose XLA path is a jitted pure function.
+
+    Subclasses call `self.jit(fn)` ONCE in `xla_init` and store the result;
+    jax's own trace cache then keys recompilation by input shapes/dtypes
+    (the analog of the reference's source-hash program cache). Donation and
+    sharding annotations are handled at the *workflow-fused* level by
+    `veles_tpu.parallel` — per-unit jit is the debuggable granular mode.
+    """
+
+    def jit(self, fn, **jit_kwargs: Any):
+        """Jit `fn` (placement follows the workflow's device/mesh; XLA owns
+        tiling and fusion — the reference's BLOCK_SIZE tuning has no analog)."""
+        return jax.jit(fn, **jit_kwargs)

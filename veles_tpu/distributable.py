@@ -1,0 +1,42 @@
+"""Per-unit distributed-training protocol interface.
+
+Parity: reference `veles/distributable.py` (`IDistributable`,
+`DistributableUnit`) — in the reference this per-unit
+generate/apply-data-for-slave/master protocol IS the data-parallelism
+mechanism (async master–slave over pickle/ZeroMQ).
+
+TPU-first: synchronous SPMD replaces the wire protocol wholesale — gradient
+averaging is a `lax.psum` inside the sharded train step (see
+`veles_tpu.parallel`), so these methods never ship bytes. The interface is
+kept for API parity and for the host-side pieces that still partition work:
+the Loader uses `generate_data_for_slave`-shaped logic to shard minibatch
+indices across the data-parallel axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class IDistributable:
+    """Duck-typed interface (the reference used zope.interface)."""
+
+    def generate_data_for_slave(self, slave: Any) -> Any:
+        """Master -> slave job piece (reference semantics: weights/indices)."""
+        return None
+
+    def apply_data_from_master(self, data: Any) -> None:
+        pass
+
+    def generate_data_for_master(self) -> Any:
+        """Slave -> master update piece (reference: weight deltas/metrics)."""
+        return None
+
+    def apply_data_from_slave(self, data: Any, slave: Optional[Any] = None
+                              ) -> None:
+        pass
+
+    def drop_slave(self, slave: Any) -> None:
+        """Slave disconnected; re-queue its outstanding work (reference
+        fault model). SPMD equivalent: restart-from-snapshot, see
+        veles_tpu/snapshotter.py."""

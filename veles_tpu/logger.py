@@ -1,0 +1,66 @@
+"""Logger mixin giving every unit a named hierarchical logger.
+
+Parity: reference `veles/logger.py` (`Logger` mixin) — every Unit mixes this
+in and logs through `self.info/debug/warning/error`; log records carry the
+unit's class name (and instance name when set).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+_initialized = False
+
+
+def setup_logging(level: int = logging.INFO, stream=None) -> None:
+    """Install the root handler once; safe to call repeatedly."""
+    global _initialized
+    if _initialized:
+        logging.getLogger("veles").setLevel(level)
+        return
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"))
+    log = logging.getLogger("veles")
+    log.addHandler(handler)
+    log.setLevel(level)
+    log.propagate = False
+    _initialized = True
+
+
+class Logger:
+    """Mixin: `self.logger` is a child of the "veles" logger named after the
+    concrete class (plus the instance's `name` attribute when present)."""
+
+    _logger: Optional[logging.Logger] = None
+
+    @property
+    def logger(self) -> logging.Logger:
+        if self._logger is None:
+            name = type(self).__name__
+            inst = getattr(self, "name", None)
+            if inst and inst != name:
+                name = f"{name}[{inst}]"
+            self._logger = logging.getLogger(f"veles.{name}")
+        return self._logger
+
+    def debug(self, msg: str, *args) -> None:
+        self.logger.debug(msg, *args)
+
+    def info(self, msg: str, *args) -> None:
+        self.logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self.logger.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self.logger.error(msg, *args)
+
+    # logging.Logger objects are not picklable; recreate lazily after load.
+    def __getstate__(self):
+        state = getattr(super(), "__getstate__", lambda: self.__dict__.copy())()
+        if isinstance(state, dict):
+            state.pop("_logger", None)
+        return state

@@ -1,0 +1,151 @@
+"""Array: the host/device memory model.
+
+Parity: reference `veles/memory.py` (`Array`/`Vector`) — a paired host numpy
+array + device buffer with explicit coherence (`map_read`/`map_write`/
+`map_invalidate`/`unmap`), and `__getstate__` that pickles host-side data
+only so snapshots and network payloads are device-free.
+
+TPU-first: the device buffer is a jax Array; coherence collapses to tracking
+which side is fresh. `map_*` keeps the reference API (unit code is written
+against it) but the heavy lifting — transfers — happens lazily in `.mem`
+(host view) and `.devmem` (device view).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class Array:
+    """Host numpy array + lazily materialized jax device buffer."""
+
+    def __init__(self, data: Optional[Any] = None) -> None:
+        self._host: Optional[np.ndarray] = None
+        self._dev = None           # jax.Array or None
+        self._host_fresh = True    # which side holds the latest data
+        self._dev_fresh = False
+        if data is not None:
+            self.reset(data)
+
+    # -- (re)binding ---------------------------------------------------------
+
+    def reset(self, data: Any) -> "Array":
+        """Bind new contents (numpy, jax array, list, or scalar)."""
+        if isinstance(data, jax.Array):
+            self._dev = data
+            self._host = None
+            self._host_fresh, self._dev_fresh = False, True
+        else:
+            self._host = np.ascontiguousarray(data)
+            self._dev = None
+            self._host_fresh, self._dev_fresh = True, False
+        return self
+
+    @property
+    def initialized(self) -> bool:
+        return self._host is not None or self._dev is not None
+
+    # -- host side -----------------------------------------------------------
+
+    @property
+    def mem(self) -> Optional[np.ndarray]:
+        """Host view; pulls from device when the device side is fresher."""
+        if not self._host_fresh and self._dev_fresh:
+            self._host = np.asarray(self._dev)
+            self._host_fresh = True
+        return self._host
+
+    @mem.setter
+    def mem(self, value: Any) -> None:
+        self.reset(value)
+
+    def map_read(self) -> None:
+        self.mem  # ensure host copy is current
+
+    def map_write(self) -> None:
+        self.mem
+        self._dev_fresh = False  # host will be mutated
+
+    def map_invalidate(self) -> None:
+        # Host will be fully overwritten; skip the device->host pull.
+        if self._host is None and self._dev is not None:
+            self._host = np.empty(self._dev.shape,
+                                  np.dtype(self._dev.dtype.name))
+        self._host_fresh, self._dev_fresh = True, False
+
+    def unmap(self) -> None:
+        """End host access; device copy refreshes lazily on next `.devmem`."""
+
+    # -- device side ---------------------------------------------------------
+
+    def devmem(self, device=None):
+        """Device view; pushes from host when the host side is fresher.
+
+        `device` may be a framework Device (XLADevice), a raw jax device, or
+        None (jax default placement). Non-XLA framework devices (e.g.
+        NumpyDevice) fall back to default placement rather than crashing.
+        """
+        if self._host_fresh and not self._dev_fresh:
+            target = getattr(device, "device", device)
+            if not isinstance(target, jax.Device):
+                target = None
+            self._dev = (jax.device_put(self._host, target)
+                         if target is not None else jax.device_put(self._host))
+            self._dev_fresh = True
+        return self._dev
+
+    def set_devmem(self, value) -> None:
+        """Store a device-side result (fast path inside compiled steps: no
+        host transfer until someone maps for read)."""
+        self._dev = value
+        self._dev_fresh, self._host_fresh = True, False
+
+    # -- conveniences --------------------------------------------------------
+
+    @property
+    def shape(self):
+        src = self._host if self._host is not None else self._dev
+        return None if src is None else src.shape
+
+    @property
+    def dtype(self):
+        src = self._host if self._host is not None else self._dev
+        return None if src is None else src.dtype
+
+    @property
+    def size(self) -> int:
+        s = self.shape
+        return 0 if s is None else int(np.prod(s)) if s else 1
+
+    def __len__(self) -> int:
+        s = self.shape
+        return 0 if s is None else s[0]
+
+    def __bool__(self) -> bool:
+        return self.initialized
+
+    def __getitem__(self, idx):
+        return self.mem[idx]
+
+    def __setitem__(self, idx, value):
+        self.map_write()
+        self._host[idx] = value
+
+    def __repr__(self) -> str:
+        if not self.initialized:
+            return "Array(<empty>)"
+        side = "host" if self._host_fresh else "dev"
+        return f"Array({self.shape}, {self.dtype}, fresh={side})"
+
+    # -- pickling: host-resident only (parity: reference Array.__getstate__) -
+
+    def __getstate__(self):
+        return {"host": self.mem}
+
+    def __setstate__(self, state):
+        self._host = state["host"]
+        self._dev = None
+        self._host_fresh, self._dev_fresh = True, False

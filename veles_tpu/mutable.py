@@ -1,0 +1,87 @@
+"""Linkable mutable values used for workflow control flow.
+
+Parity: reference `veles/mutable.py` (`Bool`, `LinkableAttribute`) — `Bool` is
+a shared, composable boolean used for unit gates (`gate_block`, `gate_skip`):
+units link *to the same Bool object* so a Decision unit flipping its
+`complete` flag is instantly visible to every gate composed from it.
+Composition with ``&``/``|``/``~`` builds lazily-evaluated derived Bools.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+
+class Bool:
+    """A mutable, shareable, composable boolean.
+
+    - `b <<= True` (or `b.set(True)`) assigns; callbacks registered with
+      `on_change` fire when the effective value flips.
+    - `a & b`, `a | b`, `~a` return *derived* Bools that re-evaluate their
+      operands on every `bool()` — so gates stay live views.
+    """
+
+    __slots__ = ("_value", "_expr", "_callbacks", "name")
+
+    def __init__(self, value: bool = False, name: str = "",
+                 _expr: Optional[Callable[[], bool]] = None) -> None:
+        self._value = bool(value)
+        self._expr = _expr
+        self._callbacks: List[Callable[[bool], None]] = []
+        self.name = name
+
+    # -- evaluation ----------------------------------------------------------
+
+    def __bool__(self) -> bool:
+        if self._expr is not None:
+            return self._expr()
+        return self._value
+
+    # -- assignment ----------------------------------------------------------
+
+    def set(self, value) -> "Bool":
+        if self._expr is not None:
+            raise ValueError(f"Bool {self.name!r} is derived; cannot assign")
+        old = self._value
+        self._value = bool(value)
+        if old != self._value:
+            for cb in self._callbacks:
+                cb(self._value)
+        return self
+
+    def __ilshift__(self, value) -> "Bool":  # b <<= True
+        return self.set(value)
+
+    def on_change(self, callback: Callable[[bool], None]) -> None:
+        self._callbacks.append(callback)
+
+    # -- composition ---------------------------------------------------------
+
+    def __and__(self, other) -> "Bool":
+        return Bool(_expr=lambda: bool(self) and bool(other),
+                    name=f"({self.name} & {_name(other)})")
+
+    def __or__(self, other) -> "Bool":
+        return Bool(_expr=lambda: bool(self) or bool(other),
+                    name=f"({self.name} | {_name(other)})")
+
+    def __invert__(self) -> "Bool":
+        return Bool(_expr=lambda: not bool(self), name=f"~{self.name}")
+
+    def __repr__(self) -> str:
+        kind = "derived" if self._expr is not None else "plain"
+        return f"Bool({bool(self)}, {kind}{', ' + self.name if self.name else ''})"
+
+    # Derived Bools close over other objects; snapshots only need the value.
+    def __getstate__(self):
+        return {"_value": bool(self), "name": self.name}
+
+    def __setstate__(self, state):
+        self._value = state["_value"]
+        self._expr = None
+        self._callbacks = []
+        self.name = state.get("name", "")
+
+
+def _name(x) -> str:
+    return getattr(x, "name", "") or repr(bool(x))

@@ -1,0 +1,83 @@
+"""Seeded PRNG facade: numpy RandomState for host code, jax keys for device.
+
+Parity: reference `veles/prng/` (`RandomGenerator`, global `prng.get()`) — a
+registry of named, seedable generators so whole training runs are
+deterministic. The device side replaces the reference's xorshift OpenCL/CUDA
+kernels with `jax.random` keys threaded through jitted computations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+class RandomGenerator:
+    """A named generator holding a numpy `Generator` (host-side shuffles,
+    weight fills run on host then transferred) and a jax PRNG key (device-side
+    stochastic ops: dropout, stochastic pooling)."""
+
+    def __init__(self, name: str, seed: int = 1234) -> None:
+        self.name = name
+        self.seed(seed)
+
+    def seed(self, seed: int) -> None:
+        self._seed = int(seed)
+        self.state = np.random.RandomState(self._seed)
+        self._key = jax.random.key(self._seed)
+
+    # -- host (numpy) --------------------------------------------------------
+
+    def shuffle(self, arr) -> None:
+        self.state.shuffle(arr)
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self.state.permutation(n)
+
+    def randint(self, low: int, high: Optional[int] = None, size=None):
+        return self.state.randint(low, high, size)
+
+    def fill_uniform(self, shape, low: float, high: float,
+                     dtype=np.float32) -> np.ndarray:
+        """Weight-init fill (parity: reference `Forward` uniform fills)."""
+        return self.state.uniform(low, high, size=shape).astype(dtype)
+
+    def fill_normal(self, shape, mean: float = 0.0, stddev: float = 1.0,
+                    dtype=np.float32) -> np.ndarray:
+        return self.state.normal(mean, stddev, size=shape).astype(dtype)
+
+    # -- device (jax) --------------------------------------------------------
+
+    def next_key(self):
+        """Split off a fresh jax PRNG key (device-side stochastic ops)."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # jax keys are device arrays; snapshot the seed + numpy state instead.
+    def __getstate__(self):
+        return {"name": self.name, "_seed": self._seed,
+                "np_state": self.state.get_state()}
+
+    def __setstate__(self, state):
+        self.name = state["name"]
+        self.seed(state["_seed"])
+        self.state.set_state(state["np_state"])
+
+
+_generators: Dict[str, RandomGenerator] = {}
+
+
+def get(name: str = "default", seed: int = 1234) -> RandomGenerator:
+    """Fetch (creating on first use) the named global generator."""
+    gen = _generators.get(name)
+    if gen is None:
+        gen = _generators[name] = RandomGenerator(name, seed)
+    return gen
+
+
+def seed_all(seed: int) -> None:
+    """Reseed every registered generator (functional-test determinism)."""
+    for i, gen in enumerate(_generators.values()):
+        gen.seed(seed + i)
