@@ -5,14 +5,26 @@ device mesh)."""
 
 import os
 
-# Must be set before jax import (any jax import initializes the backend).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu — the ambient environment routes jax at the real TPU tunnel
+# (single-client!); tests must never touch it or they serialize against
+# benchmarks, pay tunnel compile latency per test, and HANG at exit on the
+# tunnel session teardown. Setting the env var is NOT enough: the baked
+# sitecustomize (axon.register) calls jax.config.update("jax_platforms",
+# "axon,cpu") in every python process, which takes precedence over
+# JAX_PLATFORMS. Override the config value itself before any backend
+# initialization.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Golden-model comparisons need full-precision matmuls (the platform default
+# here uses reduced-precision passes — SURVEY.md §7 "pin precision=HIGHEST").
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,97 @@
+"""Functional test of the minimum end-to-end slice (SURVEY.md §7 step 5):
+the MNIST-style All2AllTanh→All2AllSoftmax workflow trains on both backends
+with pinned seeds and reaches a low validation error — the reference's
+seeded few-epoch functional-test pattern (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build(max_epochs=3):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=10, sample_shape=(8, 8), n_validation=100, n_train=500,
+        minibatch_size=50, noise=0.6)
+    return StandardWorkflow(
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "weights_stddev": 0.05},
+            {"type": "softmax", "output_sample_shape": 10,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=10,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="TestMnist")
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_trains_to_low_error(device_cls):
+    wf = build(max_epochs=3)
+    wf.initialize(device=device_cls())
+    wf.run()
+    assert wf.decision.epoch_number == 3
+    # synthetic prototypes are separable: after 3 epochs the net must be
+    # far below chance (90 errors of 100 would be chance)
+    assert wf.decision.best_validation_err <= 20, \
+        f"validation errors too high: {wf.decision.best_validation_err}"
+    # the loop ran: every forward fired once per minibatch incl. validation
+    n_steps = wf.decision.epoch_number * (500 // 50 + 100 // 50)
+    assert wf.forwards[0].run_count == n_steps
+    # GD units skipped validation minibatches; the very last train
+    # minibatch's update is also skipped because decision.complete gates
+    # the chain the moment training finishes
+    assert wf.gds[0].run_count == wf.decision.epoch_number * (500 // 50) - 1
+
+
+def test_backends_agree():
+    """Cross-backend equivalence at workflow scale: identical seeds →
+    near-identical first-epoch trajectory (golden-model pattern)."""
+    wf_np = build(max_epochs=1)
+    wf_np.initialize(device=NumpyDevice())
+    wf_np.run()
+    wf_x = build(max_epochs=1)
+    wf_x.initialize(device=XLADevice())
+    wf_x.run()
+    assert wf_np.decision.epoch_metrics[1] == pytest.approx(
+        wf_x.decision.epoch_metrics[1], abs=3), (
+        wf_np.decision.epoch_metrics, wf_x.decision.epoch_metrics)
+    np.testing.assert_allclose(
+        wf_np.forwards[0].weights.mem, wf_x.forwards[0].weights.mem,
+        rtol=2e-3, atol=2e-4)
+
+
+def test_snapshot_resume_keeps_training():
+    """Regression: derived gate Bools are frozen by pickle; a restored
+    workflow must re-derive them (else GD units silently never run again)."""
+    import pickle
+
+    wf = build(max_epochs=2)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    blob = pickle.dumps(wf)
+
+    wf2 = pickle.loads(blob)
+    wf2.decision.max_epochs = 4
+    wf2.decision.complete <<= False
+    w_before = wf2.forwards[0].weights.mem.copy()
+    gd_runs_before = wf2.gds[0].run_count
+    wf2.initialize(device=NumpyDevice())
+    wf2.run()
+    assert wf2.decision.epoch_number == 4
+    assert wf2.gds[0].run_count > gd_runs_before, \
+        "restored workflow never applied weight updates (frozen gate_skip)"
+    assert not np.allclose(wf2.forwards[0].weights.mem, w_before)
+
+
+def test_early_stop_on_patience():
+    wf = build(max_epochs=100)
+    wf.decision.fail_iterations = 2
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert wf.decision.epoch_number < 100

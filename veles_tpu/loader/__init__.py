@@ -1,0 +1,4 @@
+"""Data loading layer (parity: reference `veles/loader/` — SURVEY.md §2.7)."""
+
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION, Loader  # noqa: F401
+from veles_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401

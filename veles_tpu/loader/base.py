@@ -1,0 +1,142 @@
+"""Loader base: the minibatch engine.
+
+Parity: reference `veles/loader/base.py` — three sample classes
+(TEST=0, VALIDATION=1, TRAIN=2, the reference's ordering), per-epoch global
+shuffle of the train set with the seeded PRNG, `minibatch_class` /
+`last_minibatch` / `epoch_ended` / `epoch_number` bookkeeping consumed by
+the Decision unit, and `IDistributable`-shaped index partitioning (on TPU
+the data-parallel shard split — see `shard_batch`).
+
+TPU-first deviation (documented): minibatches have a STATIC size — XLA
+compiles one program per shape. When a class length is not divisible by
+`minibatch_size`, the final minibatch wraps around to the start of the
+class's index list instead of shrinking (the reference shrank the last
+minibatch — a dynamic shape we must not feed jit). Choose divisible sizes
+for exact epoch metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.distributable import IDistributable
+from veles_tpu.memory import Array
+from veles_tpu.mutable import Bool
+
+TEST, VALIDATION, TRAIN = 0, 1, 2
+
+
+class Loader(AcceleratedUnit, IDistributable):
+    """Subclasses implement `load_data()` (fill `class_lengths`) and
+    `fill_minibatch(indices)` (fill minibatch_data/labels for the given
+    global sample indices)."""
+
+    def __init__(self, workflow=None, minibatch_size: int = 100,
+                 shuffle_train: bool = True, on_device: bool = True,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.minibatch_size = minibatch_size
+        self.shuffle_train = shuffle_train
+        #: when True, minibatches are pushed to the device once per fill
+        self.on_device = on_device
+        self.class_lengths: List[int] = [0, 0, 0]
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_indices = Array()
+        self.minibatch_class = TRAIN
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        #: shared gate object for GD units: True on non-train minibatches
+        self.not_train = Bool(False)
+        self.epoch_number = 0
+        self._order: List[int] = []     # (class, offset) cursor state
+        self._cursor = 0
+        self._indices_per_class: List[np.ndarray] = [
+            np.empty(0, np.int64)] * 3
+
+    # -- subclass contract ---------------------------------------------------
+
+    def load_data(self) -> None:
+        raise NotImplementedError
+
+    def fill_minibatch(self, indices: np.ndarray) -> None:
+        raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs: Any):
+        self.load_data()
+        offset = 0
+        for cls in (TEST, VALIDATION, TRAIN):
+            n = self.class_lengths[cls]
+            self._indices_per_class[cls] = np.arange(offset, offset + n,
+                                                     dtype=np.int64)
+            offset += n
+        self.total_samples = offset
+        self._start_epoch()
+        # Shape-probe fill: downstream units size their buffers off
+        # minibatch_data at initialize time (the reference allocated its
+        # minibatch Arrays in Loader.initialize too). The first run() refills
+        # the same indices, so this is idempotent.
+        cls, b, _ = self._schedule[0]
+        idx = self._indices_per_class[cls]
+        take = np.arange(0, self.minibatch_size) % len(idx)
+        self.fill_minibatch(idx[take])
+        self.minibatch_indices.reset(idx[take])
+        return super().initialize(device=device, **kwargs)
+
+    def _start_epoch(self) -> None:
+        if self.shuffle_train:
+            prng.get().shuffle(self._indices_per_class[TRAIN])
+        self._schedule = []
+        for cls in (TEST, VALIDATION, TRAIN):
+            n = self.class_lengths[cls]
+            if n == 0:
+                continue
+            n_batches = -(-n // self.minibatch_size)  # ceil
+            for b in range(n_batches):
+                self._schedule.append((cls, b, b == n_batches - 1))
+        self._cursor = 0
+
+    def run(self) -> None:
+        # (overrides AcceleratedUnit.run: one code path, host index math)
+        cls, b, last = self._schedule[self._cursor]
+        idx = self._indices_per_class[cls]
+        lo = b * self.minibatch_size
+        take = np.arange(lo, lo + self.minibatch_size) % len(idx)
+        chosen = idx[take]
+        self.minibatch_class = cls
+        self.last_minibatch <<= last
+        self.not_train <<= (cls != TRAIN)
+        self.minibatch_indices.reset(chosen)
+        self.fill_minibatch(chosen)
+        if self.on_device and self.device is not None \
+                and getattr(self.device, "backend_name", "") == "xla":
+            self.minibatch_data.devmem(self.device)
+            self.minibatch_labels.devmem(self.device)
+        self._cursor += 1
+        at_end = self._cursor >= len(self._schedule)
+        self.epoch_ended <<= at_end
+        if at_end:
+            self.epoch_number += 1
+            self._start_epoch()
+
+    # -- data-parallel partitioning (IDistributable-shaped; SPMD sharding) ---
+
+    def shard_batch(self, n_shards: int, shard: int) -> slice:
+        """The slice of the current minibatch owned by data-parallel shard
+        `shard` (parity: the reference master handed each slave a disjoint
+        index range via generate_data_for_slave)."""
+        per = self.minibatch_size // n_shards
+        return slice(shard * per, (shard + 1) * per)
+
+    def generate_data_for_slave(self, slave: Any) -> Any:
+        return {"indices": self.minibatch_indices.mem}
+
+    def apply_data_from_master(self, data: Any) -> None:
+        if data and "indices" in data:
+            self.fill_minibatch(np.asarray(data["indices"]))

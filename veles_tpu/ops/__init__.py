@@ -1,0 +1,18 @@
+"""Numeric core: every znicz op as a pure function, twice.
+
+- `ops.reference` — independent NumPy implementations (forward AND backward)
+  that serve as the golden model, exactly the role the reference's NumPy
+  backend played against its OpenCL/CUDA kernels (SURVEY.md §4: "the NumPy
+  backend is the golden model").
+- `ops.xla` — jnp/lax implementations used on TPU; backward passes come from
+  `jax.vjp` over these forwards, and the equivalence tests check vjp-grads
+  against the hand-derived NumPy backwards. One XLA lowering replaces both
+  of the reference's hand-written kernel families (`veles/znicz/ocl/*.cl`,
+  `veles/znicz/cuda/*.cu`).
+
+Conventions (TPU-first, deliberately NOT the reference's layouts):
+- images are NHWC, conv weights HWIO (XLA/MXU native);
+- fully-connected weights are (in_features, out_features): y = x @ W + b.
+"""
+
+from veles_tpu.ops import reference, xla  # noqa: F401

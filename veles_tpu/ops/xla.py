@@ -1,0 +1,344 @@
+"""jnp/lax implementations of the znicz ops — the TPU compute path.
+
+Parity: replaces BOTH hand-written kernel families of the reference
+(`veles/znicz/ocl/*.cl` and `veles/znicz/cuda/*.cu`) with XLA lowerings:
+matmuls/convs hit the MXU via lax.dot_general/conv_general_dilated,
+elementwise chains fuse into them, and backwards come from `jax.vjp` instead
+of hand-derived kernels. Semantics match `ops.reference` exactly (tested by
+tests/test_ops_equivalence.py; tolerance-based, SURVEY.md §4).
+
+All functions are pure and jit-safe: static shapes, no Python control flow
+on traced values.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+TANH_A = 1.7159
+TANH_B = 0.6666
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def act_forward(name: str, x):
+    if name == "linear":
+        return x
+    if name == "tanh":
+        return TANH_A * jnp.tanh(TANH_B * x)
+    if name == "relu":  # reference smooth RELU = softplus
+        return jax.nn.softplus(x)
+    if name == "strictrelu":
+        return jnp.maximum(x, 0.0)
+    if name == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if name == "log":
+        return jnp.arcsinh(x)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+def act_backward(name: str, y, err, x=None):
+    """dL/dx from dL/dy and the forward OUTPUT y (input x only where the
+    derivative needs it) — the reference's memory model: pre-activations
+    are never retained. Mirrors ops.reference.act_backward; used inside
+    the GD units' fused backward+update steps."""
+    if name == "linear":
+        return err
+    if name == "tanh":
+        return err * (TANH_B * (TANH_A - y * y / TANH_A))
+    if name == "relu":
+        return err * (1.0 - jnp.exp(-y))
+    if name == "strictrelu":
+        return err * (y > 0)
+    if name == "sigmoid":
+        return err * y * (1.0 - y)
+    if name == "log":
+        assert x is not None
+        return err / jnp.sqrt(x * x + 1.0)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# fully connected
+# ---------------------------------------------------------------------------
+
+
+def all2all_forward(x, w, b, activation: str = "linear"):
+    """y = act(x @ W + b). Flattens trailing dims of x (parity: All2All
+    accepts image inputs). The matmul is the MXU hot path — callers feed
+    bf16 inputs under mixed precision; accumulation stays f32."""
+    x2 = x.reshape(x.shape[0], -1)
+    return act_forward(activation, x2 @ w + b)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def all2all_softmax_forward(x, w, b):
+    """Fused linear+max-subtract+softmax (parity: All2AllSoftmax)."""
+    x2 = x.reshape(x.shape[0], -1)
+    return jax.nn.softmax(x2 @ w + b, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# convolution — NHWC/HWIO (TPU-native layouts)
+# ---------------------------------------------------------------------------
+
+
+def conv2d_forward(x, w, b, stride: Tuple[int, int] = (1, 1),
+                   padding: Tuple[int, int] = (0, 0),
+                   activation: str = "linear"):
+    ph, pw = padding
+    y = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return act_forward(activation, y + b)
+
+
+def deconv2d_forward(x, w, stride: Tuple[int, int] = (1, 1),
+                     padding: Tuple[int, int] = (0, 0),
+                     out_hw: Optional[Tuple[int, int]] = None):
+    """Transposed conv as the EXACT adjoint of conv2d_forward wrt its input
+    (parity: Deconv, which the reference defined as the conv gradient).
+    Strided conv output sizes are ambiguous under transposition, so we
+    transpose the concrete forward conv for the requested `out_hw` — XLA
+    lowers this to a single fractionally-strided conv."""
+    n, oh, ow, oc = x.shape
+    kh, kw, c, _ = w.shape
+    sy, sx = stride
+    ph, pw = padding
+    if out_hw is None:
+        out_hw = ((oh - 1) * sy + kh - 2 * ph, (ow - 1) * sx + kw - 2 * pw)
+    in_shape = (n, out_hw[0], out_hw[1], c)
+
+    def fwd(inp):
+        return lax.conv_general_dilated(
+            inp, w, window_strides=stride, padding=[(ph, ph), (pw, pw)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    transpose = jax.linear_transpose(
+        fwd, jax.ShapeDtypeStruct(in_shape, x.dtype))
+    (y,) = transpose(x)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pooling — ceil-mode windows (reference semantics: edge windows truncate)
+# ---------------------------------------------------------------------------
+
+
+def _ceil_pads(h, w, ky, kx, sy, sx):
+    oh = -(-(h - ky) // sy) + 1 if h > ky else 1
+    ow = -(-(w - kx) // sx) + 1 if w > kx else 1
+    return oh, ow, (oh - 1) * sy + ky - h, (ow - 1) * sx + kx - w
+
+
+def maxpool_forward(x, ksize: Tuple[int, int], stride: Tuple[int, int],
+                    use_abs: bool = False):
+    ky, kx = ksize
+    sy, sx = stride
+    n, h, w, c = x.shape
+    _, _, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
+    pads = [(0, 0, 0), (0, eh, 0), (0, ew, 0), (0, 0, 0)]
+    if use_abs:
+        # keep the signed value of the max-|·| element (MaxAbsPooling)
+        xp = lax.pad(x, jnp.array(0.0, x.dtype), pads)
+        return lax.reduce_window(
+            xp, jnp.array(0.0, x.dtype),
+            lambda a, b: jnp.where(jnp.abs(a) >= jnp.abs(b), a, b),
+            (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
+    xp = lax.pad(x, jnp.array(-jnp.inf, x.dtype), pads)
+    return lax.reduce_window(xp, jnp.array(-jnp.inf, x.dtype), lax.max,
+                             (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
+
+
+def avgpool_forward(x, ksize: Tuple[int, int], stride: Tuple[int, int]):
+    """Mean over the *unpadded* window contents (matches the golden model's
+    truncated edge windows)."""
+    ky, kx = ksize
+    sy, sx = stride
+    n, h, w, c = x.shape
+    _, _, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
+    pads = [(0, 0, 0), (0, eh, 0), (0, ew, 0), (0, 0, 0)]
+    xp = lax.pad(x, jnp.array(0.0, x.dtype), pads)
+    ssum = lax.reduce_window(xp, jnp.array(0.0, x.dtype), lax.add,
+                             (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
+    ones = lax.pad(jnp.ones_like(x), jnp.array(0.0, x.dtype), pads)
+    cnt = lax.reduce_window(ones, jnp.array(0.0, x.dtype), lax.add,
+                            (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
+    return ssum / cnt
+
+
+def stochastic_pool_forward(x, key, ksize: Tuple[int, int],
+                            stride: Tuple[int, int]):
+    """Stochastic pooling (Zeiler & Fergus; reference StochasticPooling):
+    sample a window element with probability proportional to its positive
+    magnitude; falls back to 0 where the window is all-nonpositive."""
+    ky, kx = ksize
+    sy, sx = stride
+    n, h, w, c = x.shape
+    # same ceil-mode window geometry as max/avg pooling (truncated edge
+    # windows), so the three pooling flavors are drop-in interchangeable
+    _, _, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
+    patches = lax.conv_general_dilated_patches(
+        x, (ky, kx), (sy, sx), padding=[(0, eh), (0, ew)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    # patches: (N, OH, OW, C*ky*kx) with feature dim ordered (C, ky*kx)
+    p = patches.reshape(n, oh, ow, c, ky * kx)
+    pos = jnp.maximum(p, 0.0)
+    tot = pos.sum(-1, keepdims=True)
+    probs = jnp.where(tot > 0, pos / jnp.maximum(tot, 1e-30), 0.0)
+    g = jax.random.gumbel(key, p.shape, p.dtype)
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-30)), -jnp.inf)
+    choice = (logp + g).argmax(-1)
+    picked = jnp.take_along_axis(p, choice[..., None], -1)[..., 0]
+    return jnp.where(tot[..., 0] > 0, picked, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# LRN
+# ---------------------------------------------------------------------------
+
+
+def lrn_forward(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
+                n: int = 5):
+    sq = x * x
+    half = n // 2
+    # window-sum across channels via reduce_window on the last axis
+    ssum = lax.reduce_window(
+        sq, jnp.array(0.0, x.dtype), lax.add,
+        (1,) * (x.ndim - 1) + (n,), (1,) * x.ndim,
+        [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    return x * (k + alpha * ssum) ** (-beta)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+def make_dropout_mask(key, shape, drop_prob: float, dtype=jnp.float32):
+    keep = 1.0 - drop_prob
+    return (jax.random.uniform(key, shape) < keep).astype(dtype) / dtype(keep)
+
+
+def dropout_forward(x, mask):
+    return x * mask
+
+
+# ---------------------------------------------------------------------------
+# evaluators / losses
+# ---------------------------------------------------------------------------
+
+
+def softmax_ce(probs, labels, n_classes: int):
+    """Mirror of reference.softmax_ce on device: returns (loss, err wrt
+    logits, n_err, confusion). All jit-safe."""
+    n = probs.shape[0]
+    onehot = jax.nn.one_hot(labels, n_classes, dtype=probs.dtype)
+    eps = jnp.finfo(probs.dtype).tiny
+    picked = jnp.take_along_axis(probs, labels[:, None], 1)[:, 0]
+    loss = -jnp.log(jnp.maximum(picked, eps)).mean()
+    err = (probs - onehot) / jnp.asarray(n, probs.dtype)
+    pred = probs.argmax(axis=1)
+    n_err = (pred != labels).sum()
+    confusion = jnp.zeros((n_classes, n_classes), jnp.int32
+                          ).at[labels, pred].add(1)
+    return loss, err, n_err, confusion
+
+
+def ce_loss_from_logits(logits, labels, n_classes: int):
+    """Scalar CE loss from logits — the form jax.grad differentiates in the
+    fused train step (log-softmax for stability)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
+    return -picked.mean()
+
+
+def mse(y, target):
+    n = y.shape[0]
+    diff = y - target
+    return (diff * diff).sum() / n, 2.0 * diff / jnp.asarray(n, y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Kohonen SOM
+# ---------------------------------------------------------------------------
+
+
+def kohonen_forward(x, w):
+    d2 = (x * x).sum(1)[:, None] - 2.0 * x @ w.T + (w * w).sum(1)[None, :]
+    return d2.argmin(axis=1)
+
+
+def kohonen_update(x, w, grid, lr, sigma):
+    """Sequential-over-samples SOM update as a lax.scan (the update is
+    order-dependent by definition; scan keeps it on-device and compiled —
+    parity: KohonenTrainer)."""
+
+    def step(w, xi):
+        d2 = ((w - xi[None, :]) ** 2).sum(1)
+        win = d2.argmin()
+        gd2 = ((grid - grid[win]) ** 2).sum(1)
+        h = jnp.exp(-gd2 / (2.0 * sigma * sigma)).astype(w.dtype)
+        return w + lr * h[:, None] * (xi[None, :] - w), None
+
+    w_new, _ = lax.scan(step, w, x)
+    return w_new
+
+
+# ---------------------------------------------------------------------------
+# RBM
+# ---------------------------------------------------------------------------
+
+
+def rbm_cd1(v0, w, bv, bh, key):
+    h0p = jax.nn.sigmoid(v0 @ w + bh)
+    h0 = (jax.random.uniform(key, h0p.shape) < h0p).astype(v0.dtype)
+    v1p = jax.nn.sigmoid(h0 @ w.T + bv)
+    h1p = jax.nn.sigmoid(v1p @ w + bh)
+    n = v0.shape[0]
+    dw = (v0.T @ h0p - v1p.T @ h1p) / n
+    dbv = (v0 - v1p).mean(axis=0)
+    dbh = (h0p - h1p).mean(axis=0)
+    return dw, dbv, dbh
+
+
+# ---------------------------------------------------------------------------
+# LSTM
+# ---------------------------------------------------------------------------
+
+
+def lstm_step(x, h, c, wx, wh, b):
+    z = x @ wx + h @ wh + b
+    hsz = h.shape[1]
+    i, f, g, o = (z[:, k * hsz:(k + 1) * hsz] for k in range(4))
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+@partial(jax.jit, static_argnames=())
+def lstm_scan(xs, h0, c0, wx, wh, b):
+    """Unroll over time with lax.scan (parity: the reference unrolled time
+    steps in the unit graph on host — SURVEY.md §5.7; scan is the TPU way).
+    xs: (T, N, D) -> hs: (T, N, H)."""
+
+    def step(carry, x):
+        h, c = carry
+        h, c = lstm_step(x, h, c, wx, wh, b)
+        return (h, c), h
+
+    (h, c), hs = lax.scan(step, (h0, c0), xs)
+    return hs, h, c

@@ -1,0 +1,3 @@
+"""Sample workflows (parity: reference `veles/znicz/samples/` — each sample
+is a workflow module + a config module mutating the global `root`, run via
+the CLI: `python -m veles_tpu <workflow.py> <config.py> [root.x=y ...]`)."""

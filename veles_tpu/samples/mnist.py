@@ -1,0 +1,88 @@
+"""MNIST-style fully-connected softmax workflow — config 1 of
+BASELINE.json:7 and the reference's flagship first sample
+(`veles/znicz/samples/MNIST`: All2AllTanh hidden layer → All2AllSoftmax,
+EvaluatorSoftmax, DecisionGD, GD chain).
+
+Data note: zero-egress environment — runs on the deterministic synthetic
+MNIST-shaped dataset (veles_tpu/loader/synthetic.py) unless the config
+points `root.mnist.loader.data_path` at an on-disk IDX/np dataset.
+
+Exposes the reference's `run(load, main)` module convention consumed by the
+CLI (`veles_tpu/__main__.py`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+# defaults (overridable by config modules / CLI dotted args)
+root.mnist.loader.minibatch_size = 100
+root.mnist.loader.n_validation = 200
+root.mnist.loader.n_train = 1000
+root.mnist.loader.data_path = ""
+root.mnist.layers = [
+    {"type": "all2all_tanh", "output_sample_shape": 100,
+     "weights_stddev": 0.05},
+    {"type": "softmax", "output_sample_shape": 10, "weights_stddev": 0.05},
+]
+root.mnist.decision.max_epochs = 10
+root.mnist.decision.fail_iterations = 50
+root.mnist.gd.learning_rate = 0.1
+root.mnist.gd.gradient_moment = 0.9
+root.mnist.gd.weights_decay = 0.0
+
+
+class MnistWorkflow(StandardWorkflow):
+    """All2AllTanh(100) → All2AllSoftmax(10)."""
+
+
+def _load_idx(path: str):
+    """Minimal IDX (ubyte) reader for on-disk MNIST files."""
+    import gzip
+    import struct
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">I", f.read(4))
+        ndim = magic & 0xFF
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def make_loader() -> FullBatchLoader:
+    cfg = root.mnist.loader
+    if cfg.data_path:
+        data = _load_idx(f"{cfg.data_path}/train-images-idx3-ubyte.gz")
+        labels = _load_idx(f"{cfg.data_path}/train-labels-idx1-ubyte.gz")
+        x = (data.astype(np.float32) - 127.5) / 127.5
+        n_valid = int(cfg.n_validation)
+        n_train = len(x) - n_valid
+        loader = FullBatchLoader(minibatch_size=cfg.minibatch_size)
+        loader.load_data = lambda: loader.bind_arrays(  # type: ignore
+            x, labels.astype(np.int64), 0, n_valid, n_train)
+        return loader
+    return SyntheticClassifierLoader(
+        n_classes=10, sample_shape=(28, 28),
+        n_validation=cfg.n_validation, n_train=cfg.n_train,
+        minibatch_size=cfg.minibatch_size)
+
+
+def create_workflow() -> MnistWorkflow:
+    return MnistWorkflow(
+        layers=root.mnist.layers,
+        loader=make_loader(),
+        loss="softmax", n_classes=10,
+        decision_config=root.mnist.decision.to_dict(),
+        gd_config=root.mnist.gd.to_dict(),
+        name="MnistWorkflow")
+
+
+def run(load, main):
+    """Reference module convention: `load` builds the workflow (or restores
+    a snapshot), `main` initializes + runs it."""
+    load(create_workflow)
+    main()

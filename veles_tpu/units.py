@@ -144,6 +144,21 @@ class Unit(Logger):
     def is_initialized(self) -> bool:
         return self._initialized
 
+    # -- pickling (whole-workflow snapshots, parity: reference Snapshotter
+    # pickled the entire unit graph; SURVEY.md §5.4) ------------------------
+
+    def __getstate__(self):
+        """Drop transient state: attributes prefixed `_fn` hold jitted
+        callables (rebuilt by initialize()); `_initialized` is reset so a
+        restored workflow re-initializes (re-jits, re-acquires device)."""
+        d = {k: v for k, v in self.__dict__.items()
+             if not k.startswith("_fn")}
+        d["_initialized"] = False
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
     def __repr__(self) -> str:
         return f"<{type(self).__name__} {self.name!r}>"
 

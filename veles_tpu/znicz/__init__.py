@@ -1,0 +1,8 @@
+"""Znicz: the neural-network engine — forward units, paired gradient units,
+evaluators, and the decision (training-loop controller) unit.
+
+Parity: reference `veles/znicz/` package (named in BASELINE.json:4). Every
+forward unit class has a matching gradient unit registered via
+`nn_units.MATCHED_GD` (the reference used a `MatchingObject` metaclass
+registry — SURVEY.md §2.8).
+"""

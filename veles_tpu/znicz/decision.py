@@ -1,0 +1,81 @@
+"""Decision unit: the training-loop controller living INSIDE the graph.
+
+Parity: reference `veles/znicz/decision.py` (`DecisionBase`/`DecisionGD`) —
+consumes evaluator stats per minibatch class, detects epoch boundaries,
+tracks the best validation error and an `improved` flag (gates the
+Snapshotter), and raises `complete` on stop conditions: `max_epochs`
+reached, or no validation improvement for `fail_iterations` epochs.
+The `complete` Bool gates the workflow's loop-back Repeater link and the
+EndPoint — the training loop is data, not driver code (SURVEY.md §0).
+
+Host-only unit: epoch bookkeeping is control flow, not tensor math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
+from veles_tpu.mutable import Bool
+
+
+class DecisionBase(AcceleratedUnit):
+    def __init__(self, workflow=None, max_epochs: Optional[int] = None,
+                 fail_iterations: int = 100, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.max_epochs = max_epochs
+        self.fail_iterations = fail_iterations
+        self.complete = Bool(False, name=f"{self.name}.complete")
+        self.improved = Bool(False, name=f"{self.name}.improved")
+        self.epoch_number = 0
+        # linked from the loader at wiring time:
+        #   minibatch_class, last_minibatch, class_lengths, epoch_ended
+
+
+class DecisionGD(DecisionBase):
+    """Supervised-training decision driven by an evaluator's n_err/loss."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        # linked from the evaluator at wiring time: n_err, loss
+        self.epoch_n_err = [0.0, 0.0, 0.0]       # per class (test/valid/train)
+        self.epoch_metrics = [None, None, None]  # last completed epoch's
+        self.best_validation_err = None
+        self.best_epoch = 0
+        self._accum = [0.0, 0.0, 0.0]
+        self._epochs_since_improvement = 0
+
+    def numpy_run(self) -> None:
+        cls = int(self.minibatch_class)
+        self._accum[cls] += float(self.n_err)
+        self.improved <<= False
+        if not bool(self.last_minibatch):
+            return
+        # end of this class's pass
+        self.epoch_n_err[cls] = self._accum[cls]
+        self._accum[cls] = 0.0
+        if cls == VALIDATION or (cls == TRAIN and
+                                 self.class_lengths[VALIDATION] == 0):
+            err = self.epoch_n_err[cls]
+            if (self.best_validation_err is None
+                    or err < self.best_validation_err):
+                self.best_validation_err = err
+                self.best_epoch = self.epoch_number
+                self.improved <<= True
+                self._epochs_since_improvement = 0
+            else:
+                self._epochs_since_improvement += 1
+        if cls == TRAIN:
+            self.epoch_metrics = list(self.epoch_n_err)
+            self.epoch_number += 1
+            self.info(
+                "epoch %d: train_err=%g valid_err=%g test_err=%g best=%s",
+                self.epoch_number, self.epoch_n_err[TRAIN],
+                self.epoch_n_err[VALIDATION], self.epoch_n_err[TEST],
+                self.best_validation_err)
+            if ((self.max_epochs is not None
+                 and self.epoch_number >= self.max_epochs)
+                    or self._epochs_since_improvement
+                    >= self.fail_iterations):
+                self.complete <<= True

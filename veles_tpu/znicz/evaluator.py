@@ -1,0 +1,115 @@
+"""Evaluator units: loss + error derivative + metrics.
+
+Parity: reference `veles/znicz/evaluator.py` — `EvaluatorSoftmax`
+(cross-entropy over All2AllSoftmax probabilities, n_err count, confusion
+matrix, max-error tracking) and `EvaluatorMSE`.
+
+TPU-first: the metric math runs jitted on device; only the scalar metrics
+the Decision unit consumes (n_err, loss) cross to host, once per minibatch
+in granular mode (the fused train step keeps even those on device across a
+whole epoch — see standard_workflow.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from veles_tpu.accelerated_units import XLAUnit
+from veles_tpu.memory import Array
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+
+
+class EvaluatorBase(XLAUnit):
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.input = Array()        # network output (probs for softmax)
+        self.err_output = Array()   # derivative handed to the GD chain
+        self.loss = 0.0
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Consumes probabilities + integer labels; emits err wrt logits
+    (probs − onehot, batch-mean-scaled), n_err, loss, confusion matrix."""
+
+    def __init__(self, workflow=None, n_classes: int = 10,
+                 compute_confusion: bool = True, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_classes = n_classes
+        self.compute_confusion = compute_confusion
+        self.labels = Array()
+        self.n_err = 0
+        self.confusion_matrix = Array(
+            np.zeros((n_classes, n_classes), np.int64))
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.err_output or self.err_output.shape != self.input.shape:
+            self.err_output.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(
+            lambda p, l: ox.softmax_ce(p, l, self.n_classes))
+        return None
+
+    def numpy_run(self) -> None:
+        loss, err, n_err, conf = ref.softmax_ce(
+            self.input.mem, self.labels.mem, self.n_classes)
+        self.loss = loss
+        self.err_output.mem = err
+        self.n_err = n_err
+        if self.compute_confusion:
+            self.confusion_matrix.map_write()
+            self.confusion_matrix.mem += conf
+
+    def xla_run(self) -> None:
+        d = self.device
+        loss, err, n_err, conf = self._fn(self.input.devmem(d),
+                                          self.labels.devmem(d))
+        self.err_output.set_devmem(err)
+        # scalars cross to host here: the Decision unit is host-side logic
+        self.loss = float(loss)
+        self.n_err = int(n_err)
+        if self.compute_confusion:
+            self.confusion_matrix.map_write()
+            self.confusion_matrix.mem += np.asarray(conf)
+
+    def reset_metrics(self) -> None:
+        self.confusion_matrix.reset(
+            np.zeros((self.n_classes, self.n_classes), np.int64))
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error evaluator (autoencoders, regression)."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.target = Array()
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.err_output or self.err_output.shape != self.input.shape:
+            self.err_output.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(ox.mse)
+        return None
+
+    def numpy_run(self) -> None:
+        loss, err = ref.mse(self.input.mem, self.target.mem)
+        self.loss = loss
+        self.err_output.mem = err
+        self.n_err = loss  # Decision tracks MSE as the "error" metric
+
+    def xla_run(self) -> None:
+        d = self.device
+        loss, err = self._fn(self.input.devmem(d), self.target.devmem(d))
+        self.err_output.set_devmem(err)
+        self.loss = float(loss)
+        self.n_err = self.loss

@@ -1,0 +1,119 @@
+"""Gradient units for fully-connected layers.
+
+Parity: reference `veles/znicz/gd.py` — `GradientDescent` (linear twin),
+`GDTanh`, `GDRELU`, `GDStrictRELU`, `GDSigmoid`, `GDSoftmax` (the softmax
+twin receives err wrt LOGITS from EvaluatorSoftmax — probs−onehot — so its
+activation derivative is identity, exactly the reference convention).
+
+TPU-first: backward + momentum/decay weight update is ONE jitted function
+per unit; XLA fuses the two matmuls (dW, err_input) with the update
+arithmetic. Velocity buffers live on device across steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.ops.optim import SGDConfig, sgd_update
+from veles_tpu.znicz import all2all
+from veles_tpu.znicz.nn_units import GradientDescentBase, register_gd
+
+
+@register_gd(all2all.All2All)
+class GradientDescent(GradientDescentBase):
+    """Backward for All2All-family layers. `activation` mirrors the forward
+    twin and drives the output-expressed derivative (ops.reference
+    act_backward semantics)."""
+
+    activation = "linear"
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.weights:
+            return False
+        self._ensure_velocity()
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        act = self.activation
+        cfg = SGDConfig(lr=self.learning_rate,
+                        momentum=self.gradient_moment,
+                        weight_decay=self.weights_decay,
+                        l1_decay=self.l1_decay,
+                        lr_bias_mult=self.learning_rate_bias)
+
+        def step(x, w, b, y, err_y, vw, vb, lr_scale):
+            x2 = x.reshape(x.shape[0], -1)
+            pre = ox.act_backward(act, y, err_y)
+            pre2 = pre.reshape(pre.shape[0], -1)
+            grads = {"w": x2.T @ pre2, "b": pre2.sum(axis=0)}
+            err_x = (pre2 @ w.T).reshape(x.shape)
+            new_p, new_v = sgd_update({"w": w, "b": b}, grads,
+                                      {"w": vw, "b": vb}, cfg, lr_scale)
+            return (err_x, new_p["w"], new_p["b"], new_v["w"], new_v["b"])
+
+        self._fn = self.jit(step, donate_argnums=(5, 6))
+        return None
+
+    def numpy_run(self) -> None:
+        y2 = self.output.mem.reshape(len(self.output), -1)
+        ey2 = self.err_output.mem.reshape(len(self.err_output), -1)
+        err_x, dw, db = ref.all2all_backward(
+            self.input.mem, self.weights.mem, y2, ey2, self.activation)
+        w, vw = self._sgd_host(self.weights.mem, dw, self.vel_w.mem, False)
+        b, vb = self._sgd_host(self.bias.mem, db, self.vel_b.mem, True)
+        self.err_input.mem = err_x
+        self.weights.mem = w
+        self.bias.mem = b
+        self.vel_w.mem = vw
+        self.vel_b.mem = vb
+
+    def xla_run(self) -> None:
+        d = self.device
+        y2 = self.output.devmem(d).reshape(len(self.output), -1)
+        ey2 = self.err_output.devmem(d).reshape(len(self.err_output), -1)
+        err_x, w, b, vw, vb = self._fn(
+            self.input.devmem(d), self.weights.devmem(d),
+            self.bias.devmem(d), y2, ey2,
+            self.vel_w.devmem(d), self.vel_b.devmem(d),
+            jnp.float32(self.lr_scale))
+        self.err_input.set_devmem(err_x)
+        self.weights.set_devmem(w)
+        self.bias.set_devmem(b)
+        self.vel_w.set_devmem(vw)
+        self.vel_b.set_devmem(vb)
+
+
+@register_gd(all2all.All2AllTanh)
+class GDTanh(GradientDescent):
+    activation = "tanh"
+
+
+@register_gd(all2all.All2AllRELU)
+class GDRELU(GradientDescent):
+    activation = "relu"
+
+
+@register_gd(all2all.All2AllStrictRELU)
+class GDStrictRELU(GradientDescent):
+    activation = "strictrelu"
+
+
+@register_gd(all2all.All2AllSigmoid)
+class GDSigmoid(GradientDescent):
+    activation = "sigmoid"
+
+
+@register_gd(all2all.All2AllSoftmax)
+class GDSoftmax(GradientDescent):
+    """err_output from EvaluatorSoftmax is already wrt logits
+    (probs − onehot), so the derivative pass-through is identity."""
+
+    activation = "linear"

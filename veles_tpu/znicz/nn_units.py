@@ -1,0 +1,179 @@
+"""NN base units: Forward (weight-holding layers) and GradientDescentBase.
+
+Parity: reference `veles/znicz/nn_units.py` (`Forward`: uniform/gaussian
+weight fills with `weights_stddev`; `GradientDescentBase`: learning_rate,
+gradient_moment (momentum), L1/L2 weight decay, per-layer multipliers;
+`NNWorkflow`). The forward/GD pairing registry mirrors the reference's
+`MatchingObject` metaclass (SURVEY.md §2.8).
+
+TPU-first notes:
+- Weight init happens on host (numpy, seeded via veles_tpu.prng) and is
+  transferred once; all per-step compute is a jitted XLA function.
+- The GD units' weight update is expressed through `ops.optim.sgd_update`
+  so the whole backward+update chain fuses into one XLA computation (the
+  reference ran a separate hand-written weight-update kernel per layer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import XLAUnit
+from veles_tpu.memory import Array
+
+#: forward unit class -> its gradient unit class (filled by register_gd).
+MATCHED_GD: Dict[type, type] = {}
+
+
+def register_gd(forward_cls: type):
+    """Class decorator pairing a GD unit with its forward unit (parity:
+    the reference's MatchingObject metaclass registry)."""
+
+    def deco(gd_cls: type) -> type:
+        MATCHED_GD[forward_cls] = gd_cls
+        return gd_cls
+
+    return deco
+
+
+def gd_for(forward_cls: type) -> type:
+    """Resolve the gradient unit class for a forward unit class, walking the
+    MRO so subclasses inherit their base's pairing."""
+    for cls in forward_cls.__mro__:
+        if cls in MATCHED_GD:
+            return MATCHED_GD[cls]
+    raise KeyError(f"no GD unit registered for {forward_cls.__name__}")
+
+
+class Forward(XLAUnit):
+    """Base of all weight-holding forward layers.
+
+    Attributes (reference `Forward` contract):
+    - `input`, `output`: activation Arrays (output allocated at initialize);
+    - `weights`, `bias`: parameter Arrays, host-initialized with
+      `weights_filling` ("uniform" | "gaussian") and `weights_stddev`
+      (uniform fills draw from ±stddev·√3 so the std matches gaussian fills).
+    """
+
+    def __init__(self, workflow=None,
+                 weights_filling: str = "uniform",
+                 weights_stddev: Optional[float] = None,
+                 bias_filling: str = "uniform",
+                 bias_stddev: Optional[float] = None,
+                 include_bias: bool = True,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.weights_filling = weights_filling
+        self.weights_stddev = weights_stddev
+        self.bias_filling = bias_filling
+        self.bias_stddev = bias_stddev
+        self.include_bias = include_bias
+        self.input = Array()
+        self.output = Array()
+        self.weights = Array()
+        self.bias = Array()
+
+    # -- parameter init helpers ----------------------------------------------
+
+    def _fill(self, shape: Tuple[int, ...], filling: str,
+              stddev: float, dtype=np.float32) -> np.ndarray:
+        gen = prng.get()
+        if filling == "uniform":
+            lim = stddev * np.sqrt(3.0)
+            return gen.fill_uniform(shape, -lim, lim, dtype)
+        if filling == "gaussian":
+            return gen.fill_normal(shape, 0.0, stddev, dtype)
+        raise ValueError(f"unknown filling {filling!r}")
+
+    def default_stddev(self, fan_in: int) -> float:
+        """LeCun-style 1/√fan_in when the config gave no stddev."""
+        return 1.0 / np.sqrt(max(fan_in, 1))
+
+    def init_params(self, w_shape: Tuple[int, ...], fan_in: int,
+                    dtype=np.float32) -> None:
+        if not self.weights:
+            stddev = self.weights_stddev or self.default_stddev(fan_in)
+            self.weights.reset(self._fill(w_shape, self.weights_filling,
+                                          stddev, dtype))
+        if self.include_bias and not self.bias:
+            bstd = self.bias_stddev or self.weights_stddev \
+                or self.default_stddev(fan_in)
+            self.bias.reset(self._fill((w_shape[-1],), self.bias_filling,
+                                       bstd, dtype))
+        elif not self.include_bias and not self.bias:
+            self.bias.reset(np.zeros((w_shape[-1],), dtype))
+
+    # -- pytree view (fused/sharded train step, veles_tpu.parallel) ----------
+
+    def param_arrays(self) -> Dict[str, Array]:
+        """The unit's trainable parameters as named Arrays."""
+        return {"weights": self.weights, "bias": self.bias}
+
+
+class GradientDescentBase(XLAUnit):
+    """Base of all gradient units.
+
+    Consumes `err_output` (dL/d output of its forward twin), produces
+    `err_input` (dL/d input) and applies the SGD update to the twin's
+    parameters in place. Hyperparameters follow the reference:
+    `learning_rate`, `gradient_moment` (momentum), `weights_decay` (L2),
+    `l1_decay`, `learning_rate_bias` multiplier (reference used 2× lr on
+    biases), `gradient_accumulation` via `apply_gradients` gate.
+    """
+
+    def __init__(self, workflow=None,
+                 learning_rate: float = 0.01,
+                 gradient_moment: float = 0.0,
+                 weights_decay: float = 0.0,
+                 l1_decay: float = 0.0,
+                 learning_rate_bias: float = 2.0,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.learning_rate = learning_rate
+        self.gradient_moment = gradient_moment
+        self.weights_decay = weights_decay
+        self.l1_decay = l1_decay
+        self.learning_rate_bias = learning_rate_bias
+        #: runtime-scalable lr multiplier (driven by the lr_adjust unit).
+        self.lr_scale = 1.0
+        self.err_output = Array()
+        self.err_input = Array()
+        # velocity buffers (momentum), allocated lazily
+        self.vel_w = Array()
+        self.vel_b = Array()
+
+    def link_forward(self, fwd: Forward) -> "GradientDescentBase":
+        """Wire the standard data links to the forward twin (parity: the
+        reference StandardWorkflow linked weights/bias/input/output)."""
+        self.link_attrs(fwd, "weights", "bias", "input", "output")
+        return self
+
+    # -- update math (host path; XLA path fuses via ops.optim) ---------------
+
+    def _sgd_host(self, p: np.ndarray, g: np.ndarray, v: np.ndarray,
+                  bias: bool) -> Tuple[np.ndarray, np.ndarray]:
+        lr = self.learning_rate * self.lr_scale
+        if bias:
+            lr *= self.learning_rate_bias
+        if self.weights_decay:
+            g = g + self.weights_decay * p
+        if self.l1_decay:
+            g = g + self.l1_decay * np.sign(p)
+        v_new = self.gradient_moment * v - lr * g
+        return p + v_new, v_new
+
+    def _ensure_velocity(self) -> None:
+        if not self.vel_w and self.weights:
+            self.vel_w.reset(np.zeros(self.weights.shape,
+                                      self.weights.dtype))
+        if not self.vel_b and self.bias:
+            self.vel_b.reset(np.zeros(self.bias.shape, self.bias.dtype))
+
+
+class NNWorkflow:
+    """Marker/mixin for workflows whose units form forward+GD chains
+    (parity: reference `NNWorkflow`); see standard_workflow.py for the
+    declarative builder and the fused train-step compiler."""

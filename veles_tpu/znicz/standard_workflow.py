@@ -1,0 +1,162 @@
+"""StandardWorkflow: declarative model builder.
+
+Parity: reference `veles/znicz/standard_workflow.py` — builds
+`loader → forwards… → evaluator → decision → gds…(reverse) → (loop)` from a
+declarative `layers` list (`root.<model>.layers` in sample configs), with
+the Decision's `complete` Bool gating the loop-back Repeater and EndPoint.
+
+Layer dicts: {"type": <name>, ...kwargs}. Types live in the LAYER_TYPES
+registry: the all2all family + softmax here; conv/pooling/normalization/
+dropout modules append theirs when imported. An unknown type raises with
+the currently-registered list.
+
+TPU-first: the same graph can run granular (one jitted XLA computation per
+unit — the debuggable mode, and the numpy golden mode for tests) or FUSED —
+`build_fused_step()` compiles the entire forward+backward+update chain into
+ONE donated XLA computation per minibatch, optionally sharded over a device
+mesh (veles_tpu.parallel). That single fused step is the analog of the
+reference's whole hot loop of §3.1 kernel enqueues.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Repeater, Workflow
+from veles_tpu.znicz import all2all, gd  # noqa: F401 (gd registers pairs)
+from veles_tpu.znicz.decision import DecisionGD
+from veles_tpu.znicz.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from veles_tpu.znicz.nn_units import Forward, gd_for
+
+#: layer-type name -> forward unit class (conv/pool types appended by
+#: veles_tpu.znicz.conv/pooling at import time to avoid import cycles).
+LAYER_TYPES: Dict[str, type] = {
+    "all2all": all2all.All2All,
+    "all2all_tanh": all2all.All2AllTanh,
+    "all2all_relu": all2all.All2AllRELU,
+    "all2all_strictrelu": all2all.All2AllStrictRELU,
+    "all2all_sigmoid": all2all.All2AllSigmoid,
+    "softmax": all2all.All2AllSoftmax,
+}
+
+
+class StandardWorkflow(Workflow):
+    """loader + declarative layer list -> full supervised training graph."""
+
+    def __init__(self, workflow=None,
+                 layers: Sequence[Dict[str, Any]] = (),
+                 loader: Optional[Loader] = None,
+                 loss: str = "softmax",
+                 n_classes: int = 10,
+                 decision_config: Optional[Dict[str, Any]] = None,
+                 gd_config: Optional[Dict[str, Any]] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.layers_config = list(layers)
+        self.loss = loss
+        self.n_classes = n_classes
+        self.repeater = Repeater(self, name="repeater")
+        assert loader is not None, "StandardWorkflow needs a loader"
+        self.loader = loader
+        if loader.workflow is not self:
+            self.add_unit(loader)
+            loader.workflow = self
+
+        # -- forwards --------------------------------------------------------
+        self.forwards: List[Forward] = []
+        prev: Unit = self.loader
+        prev_attr = "minibatch_data"
+        for spec in self.layers_config:
+            spec = dict(spec)
+            kind = spec.pop("type")
+            if kind not in LAYER_TYPES:
+                raise ValueError(
+                    f"unknown layer type {kind!r}; registered types: "
+                    f"{sorted(LAYER_TYPES)}")
+            fwd = LAYER_TYPES[kind](self, **spec)
+            fwd.link_attrs(prev, ("input", prev_attr))
+            self.forwards.append(fwd)
+            prev, prev_attr = fwd, "output"
+
+        # -- evaluator ------------------------------------------------------
+        if loss == "softmax":
+            self.evaluator = EvaluatorSoftmax(self, n_classes=n_classes)
+            self.evaluator.link_attrs(self.loader,
+                                      ("labels", "minibatch_labels"))
+        elif loss == "mse":
+            self.evaluator = EvaluatorMSE(self)
+            self.evaluator.link_attrs(self.loader,
+                                      ("target", "minibatch_labels"))
+        else:
+            raise ValueError(f"unknown loss {loss!r}")
+        self.evaluator.link_attrs(prev, ("input", "output"))
+
+        # -- decision -------------------------------------------------------
+        self.decision = DecisionGD(self, **(decision_config or {}))
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "last_minibatch", "class_lengths")
+        self.decision.link_attrs(self.evaluator, "n_err", "loss")
+
+        # -- gradient chain (reverse order) ---------------------------------
+        gd_kw = gd_config or {}
+        self.gds: List[Unit] = []
+        err_src: Unit = self.evaluator
+        err_attr = "err_output"
+        for fwd in reversed(self.forwards):
+            g = gd_for(type(fwd))(self, **gd_kw)
+            g.link_forward(fwd)
+            g.link_attrs(err_src, ("err_output", err_attr))
+            self.gds.append(g)
+            err_src, err_attr = g, "err_input"
+
+        # -- control wiring --------------------------------------------------
+        # start → repeater → loader → fwds → evaluator → decision → gds
+        #   … last gd → repeater (loop); decision → end_point when complete
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        prev_u: Unit = self.loader
+        for fwd in self.forwards:
+            fwd.link_from(prev_u)
+            prev_u = fwd
+        self.evaluator.link_from(prev_u)
+        self.decision.link_from(self.evaluator)
+        prev_u = self.decision
+        for g in self.gds:
+            g.link_from(prev_u)
+            prev_u = g
+        self.repeater.link_from(prev_u)
+        self.end_point.link_from(self.decision)
+        self._wire_gates()
+
+    def _wire_gates(self) -> None:
+        """(Re)build the derived gate Bools. Called from __init__ AND from
+        initialize(): pickle snapshots freeze derived Bools to plain values
+        (Bool.__getstate__ drops the closure), so a restored workflow must
+        re-derive them or gates stay stuck at their snapshot-time values
+        (e.g. gate_skip frozen True → silently no more weight updates)."""
+        # skip weight updates on test/validation minibatches; freeze the
+        # chain entirely once training completed
+        for g in self.gds:
+            g.gate_skip = self.loader.not_train | self.decision.complete
+        self.end_point.gate_block = ~self.decision.complete
+        # once complete, the loop-back pulse must die at the repeater
+        self.repeater.gate_block = self.decision.complete
+
+    # -- conveniences --------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs: Any) -> None:
+        self._wire_gates()
+        super().initialize(device=device, **kwargs)
+
+    def run_epochs(self, n: Optional[int] = None, device=None) -> None:
+        """Initialize (if needed) and run until the decision completes."""
+        if n is not None:
+            self.decision.max_epochs = n
+        if not self.is_initialized:
+            self.initialize(device=device)
+        self.run()
