@@ -1,0 +1,150 @@
+"""Conv/pooling/LRN/activation/dropout unit families: cross-backend
+equivalence at workflow scale plus op-level checks for the stochastic
+pooling sampler (whose RNG is backend-specific by nature — SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build_convnet(max_epochs=2, layers=None):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(8, 8, 1), n_validation=80, n_train=240,
+        minibatch_size=40, noise=0.5)
+    return StandardWorkflow(
+        layers=layers or [
+            {"type": "conv_tanh", "n_kernels": 6, "kx": 3, "ky": 3,
+             "padding": (1, 1), "weights_stddev": 0.1},
+            {"type": "max_pooling", "ksize": (2, 2)},
+            {"type": "softmax", "output_sample_shape": 4,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.05, "gradient_moment": 0.9},
+        name="TestConvNet")
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_convnet_trains(device_cls):
+    wf = build_convnet(max_epochs=2)
+    wf.initialize(device=device_cls())
+    wf.run()
+    assert wf.decision.epoch_number == 2
+    # 4 classes, 80 validation samples → chance is 60 errors
+    assert wf.decision.best_validation_err <= 30, \
+        f"validation errors too high: {wf.decision.best_validation_err}"
+
+
+def test_convnet_backends_agree():
+    wf_np = build_convnet(max_epochs=1)
+    wf_np.initialize(device=NumpyDevice())
+    wf_np.run()
+    wf_x = build_convnet(max_epochs=1)
+    wf_x.initialize(device=XLADevice())
+    wf_x.run()
+    np.testing.assert_allclose(
+        wf_np.forwards[0].weights.mem, wf_x.forwards[0].weights.mem,
+        rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(
+        wf_np.forwards[-1].weights.mem, wf_x.forwards[-1].weights.mem,
+        rtol=2e-3, atol=2e-4)
+    assert wf_np.decision.epoch_metrics[1] == pytest.approx(
+        wf_x.decision.epoch_metrics[1], abs=3)
+
+
+def test_deep_stack_wires_and_agrees():
+    """conv → LRN → avg_pool → standalone activation → dropout(0) → softmax:
+    every new unit family in one graph; ratio-0 dropout keeps the two
+    backends' trajectories comparable."""
+    layers = [
+        {"type": "conv_strictrelu", "n_kernels": 4, "kx": 3, "ky": 3,
+         "weights_stddev": 0.1},
+        {"type": "lrn", "n": 3},
+        {"type": "avg_pooling", "ksize": (2, 2)},
+        {"type": "activation_tanh"},
+        {"type": "dropout", "dropout_ratio": 0.0},
+        {"type": "softmax", "output_sample_shape": 4,
+         "weights_stddev": 0.05},
+    ]
+    wf_np = build_convnet(max_epochs=1, layers=list(layers))
+    wf_np.initialize(device=NumpyDevice())
+    wf_np.run()
+    wf_x = build_convnet(max_epochs=1, layers=list(layers))
+    wf_x.initialize(device=XLADevice())
+    wf_x.run()
+    np.testing.assert_allclose(
+        wf_np.forwards[0].weights.mem, wf_x.forwards[0].weights.mem,
+        rtol=2e-3, atol=3e-4)
+
+
+def test_dropout_trains_and_is_identity_on_eval():
+    layers = [
+        {"type": "all2all_tanh", "output_sample_shape": 16,
+         "weights_stddev": 0.05},
+        {"type": "dropout", "dropout_ratio": 0.5},
+        {"type": "softmax", "output_sample_shape": 4,
+         "weights_stddev": 0.05},
+    ]
+    wf = build_convnet(max_epochs=2, layers=layers)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    assert wf.decision.best_validation_err <= 40
+    drop = wf.forwards[1]
+    # after the run the last minibatches were validation → identity pass
+    # is exercised; spot-check directly:
+    drop.minibatch_class = 0  # TEST
+    drop.input.mem = np.ones(drop.input.shape, np.float32)
+    drop.run()
+    np.testing.assert_array_equal(np.asarray(drop.output.mem),
+                                  np.ones(drop.input.shape, np.float32))
+
+
+def test_stochastic_pooling_ops():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    x[0, :2, :2, 0] = -1.0  # make one window all-nonpositive for ch 0
+    y_np, idx_np = ref.stochastic_pool_forward(
+        x, np.random.RandomState(7), (2, 2), (2, 2))
+    y_x, idx_x = jax.jit(lambda v, k: ox.stochastic_pool_forward_with_idx(
+        v, k, (2, 2), (2, 2)))(x, jax.random.key(3))
+    y_x, idx_x = np.asarray(y_x), np.asarray(idx_x)
+    for y, idx in ((y_np, idx_np), (y_x, idx_x)):
+        assert y.shape == (2, 3, 3, 3)
+        # dead window yields exactly 0 and the sentinel offset
+        assert y[0, 0, 0, 0] == 0.0 and idx[0, 0, 0, 0] == x.size
+        # winners are real elements: gathering at idx reproduces y
+        alive = idx < x.size
+        np.testing.assert_allclose(x.ravel()[idx[alive]], y[alive],
+                                   rtol=1e-6)
+        # sampled values must be positive (prob ∝ positive magnitude)
+        assert (y[alive] > 0).all()
+    # backward: scatter restores err only at winners; dead windows drop
+    err_y = np.ones_like(y_np)
+    err_x = ref.stochastic_pool_backward(err_y, idx_np, x.shape)
+    assert err_x.sum() == pytest.approx(idx_np[idx_np < x.size].size)
+
+
+def test_maxabs_pooling_unit_equivalence():
+    from veles_tpu.znicz.pooling import MaxAbsPooling
+    prng.seed_all(1)
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 7, 5, 2).astype(np.float32)
+    u_np = MaxAbsPooling(ksize=(3, 3), stride=(2, 2))
+    u_np.input.reset(x.copy())
+    u_np.initialize(device=NumpyDevice())
+    u_np.run()
+    u_x = MaxAbsPooling(ksize=(3, 3), stride=(2, 2))
+    u_x.input.reset(x.copy())
+    u_x.initialize(device=XLADevice())
+    u_x.run()
+    np.testing.assert_allclose(np.asarray(u_x.output.mem), u_np.output.mem,
+                               rtol=1e-6)

@@ -218,6 +218,54 @@ def maxpool_backward(err_y: np.ndarray, idx: np.ndarray,
     return err_x.reshape(x_shape)
 
 
+def stochastic_pool_forward(x: np.ndarray, rng: np.random.RandomState,
+                            ksize: Tuple[int, int], stride: Tuple[int, int]
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stochastic pooling (Zeiler & Fergus; reference StochasticPooling):
+    sample a window element with probability ∝ its positive magnitude;
+    all-nonpositive windows yield 0. Returns (y, flat winner offsets into x;
+    `x.size` marks dead windows — the backward scatter skips those).
+
+    Sampling is host-RNG-driven so it cannot match the XLA path
+    sample-for-sample; tests assert distributional/structural properties
+    instead (the reference had the same OpenCL-vs-numpy RNG split)."""
+    n, h, w, c = x.shape
+    ky, kx = ksize
+    sy, sx = stride
+    oh, ow = _pool_windows(x, ky, kx, sy, sx)
+    y = np.zeros((n, oh, ow, c), x.dtype)
+    idx = np.full((n, oh, ow, c), x.size, np.int64)
+    for i in range(oh):
+        for j in range(ow):
+            y0, x0 = i * sy, j * sx
+            win = x[:, y0:y0 + ky, x0:x0 + kx, :]
+            wh = win.shape[1] * win.shape[2]
+            flat = win.reshape(n, wh, c)
+            pos = np.maximum(flat, 0.0)
+            tot = pos.sum(axis=1)                       # (n, c)
+            cum = np.cumsum(pos, axis=1)
+            u = rng.random_sample((n, 1, c)) * tot[:, None, :]
+            am = (cum > u).argmax(axis=1)               # first bin past u
+            picked = np.take_along_axis(flat, am[:, None, :], 1)[:, 0, :]
+            alive = tot > 0
+            y[:, i, j, :] = np.where(alive, picked, 0.0)
+            dy, dx = np.unravel_index(am, (win.shape[1], win.shape[2]))
+            nn = np.arange(n)[:, None]
+            cc = np.arange(c)[None, :]
+            off = ((nn * h + (y0 + dy)) * w + (x0 + dx)) * c + cc
+            idx[:, i, j, :] = np.where(alive, off, x.size)
+    return y, idx
+
+
+def stochastic_pool_backward(err_y: np.ndarray, idx: np.ndarray,
+                             x_shape: Tuple[int, ...]) -> np.ndarray:
+    """Scatter err to the sampled winners; `x.size` offsets (dead windows)
+    land in a scratch slot that is dropped."""
+    err_x = np.zeros(int(np.prod(x_shape)) + 1, err_y.dtype)
+    np.add.at(err_x, idx.ravel(), err_y.ravel())
+    return err_x[:-1].reshape(x_shape)
+
+
 def avgpool_forward(x: np.ndarray, ksize: Tuple[int, int],
                     stride: Tuple[int, int]) -> np.ndarray:
     n, h, w, c = x.shape

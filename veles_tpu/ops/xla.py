@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 TANH_A = 1.7159
@@ -141,23 +142,82 @@ def _ceil_pads(h, w, ky, kx, sy, sx):
     return oh, ow, (oh - 1) * sy + ky - h, (ow - 1) * sx + kx - w
 
 
+def _flat_offsets(choice, n, h, w, c, oh, ow, stride, kx):
+    """Flat offsets into an (n,h,w,c) input from per-window winner indices
+    `choice` (index within the ky*kx window, shape (n,oh,ow,c)). THE offset
+    convention: the backward scatter (pool_scatter) and the numpy golden
+    twins in ops.reference must agree with this formula."""
+    sy, sx = stride
+    dy, dx = choice // kx, choice % kx
+    ii = jnp.arange(oh)[None, :, None, None] * sy
+    jj = jnp.arange(ow)[None, None, :, None] * sx
+    nn = jnp.arange(n)[:, None, None, None]
+    cc = jnp.arange(c)[None, None, None, :]
+    return ((nn * h + (ii + dy)) * w + (jj + dx)) * c + cc
+
+
 def maxpool_forward(x, ksize: Tuple[int, int], stride: Tuple[int, int],
                     use_abs: bool = False):
+    """reduce_window max pooling. Init/pad values are HOST scalars on
+    purpose: a jnp.array init becomes a traced constant under jit and
+    breaks reverse-mode linearization of reduce_window (the fused train
+    step differentiates through this)."""
     ky, kx = ksize
     sy, sx = stride
     n, h, w, c = x.shape
     _, _, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
     pads = [(0, 0, 0), (0, eh, 0), (0, ew, 0), (0, 0, 0)]
+    dt = np.dtype(x.dtype)
     if use_abs:
         # keep the signed value of the max-|·| element (MaxAbsPooling)
-        xp = lax.pad(x, jnp.array(0.0, x.dtype), pads)
+        xp = lax.pad(x, np.zeros((), dt)[()], pads)
         return lax.reduce_window(
-            xp, jnp.array(0.0, x.dtype),
+            xp, np.zeros((), dt)[()],
             lambda a, b: jnp.where(jnp.abs(a) >= jnp.abs(b), a, b),
             (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
-    xp = lax.pad(x, jnp.array(-jnp.inf, x.dtype), pads)
-    return lax.reduce_window(xp, jnp.array(-jnp.inf, x.dtype), lax.max,
+    ninf = np.asarray(-np.inf, dt)[()]
+    xp = lax.pad(x, ninf, pads)
+    return lax.reduce_window(xp, ninf, lax.max,
                              (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
+
+
+def maxpool_forward_with_idx(x, ksize: Tuple[int, int],
+                             stride: Tuple[int, int], use_abs: bool = False):
+    """Max pooling that also records flat winner offsets into x (reference
+    parity: the kernels emitted argmax offsets for the backward scatter).
+    Patches-based — used by the granular MaxPooling unit; the fused path
+    uses the reduce_window flavor above."""
+    ky, kx = ksize
+    sy, sx = stride
+    n, h, w, c = x.shape
+    _, _, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
+    patches = lax.conv_general_dilated_patches(
+        x, (ky, kx), (sy, sx), padding=[(0, eh), (0, ew)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    p = patches.reshape(n, oh, ow, c, ky * kx)
+    # mask out padded slots so they never win (pad fills with 0)
+    in_h = jnp.arange(oh)[:, None, None] * sy + \
+        (jnp.arange(ky * kx)[None, None, :] // kx)
+    in_w = jnp.arange(ow)[None, :, None] * sx + \
+        (jnp.arange(ky * kx)[None, None, :] % kx)
+    valid = (in_h < h) & (in_w < w)          # (oh, ow, ky*kx)
+    key = jnp.abs(p) if use_abs else p
+    key = jnp.where(valid[None, :, :, None, :], key, -jnp.inf)
+    choice = key.argmax(-1)
+    y = jnp.take_along_axis(p, choice[..., None], -1)[..., 0]
+    return y, _flat_offsets(choice, n, h, w, c, oh, ow, stride, kx)
+
+
+def pool_scatter(err_y, idx, x_shape):
+    """Backward scatter shared by max/maxabs/stochastic pooling: route err
+    to the recorded winners; out-of-range sentinel offsets drop."""
+    size = 1
+    for s in x_shape:
+        size *= s
+    flat = jnp.zeros(size, err_y.dtype)
+    flat = flat.at[idx.ravel()].add(err_y.ravel(), mode="drop")
+    return flat.reshape(x_shape)
 
 
 def avgpool_forward(x, ksize: Tuple[int, int], stride: Tuple[int, int]):
@@ -168,20 +228,28 @@ def avgpool_forward(x, ksize: Tuple[int, int], stride: Tuple[int, int]):
     n, h, w, c = x.shape
     _, _, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
     pads = [(0, 0, 0), (0, eh, 0), (0, ew, 0), (0, 0, 0)]
-    xp = lax.pad(x, jnp.array(0.0, x.dtype), pads)
-    ssum = lax.reduce_window(xp, jnp.array(0.0, x.dtype), lax.add,
+    zero = np.zeros((), np.dtype(x.dtype))[()]  # host scalar: stays a
+    # compile-time constant so reverse-mode through reduce_window works
+    # under jit (see maxpool_forward)
+    xp = lax.pad(x, zero, pads)
+    ssum = lax.reduce_window(xp, zero, lax.add,
                              (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
-    ones = lax.pad(jnp.ones_like(x), jnp.array(0.0, x.dtype), pads)
-    cnt = lax.reduce_window(ones, jnp.array(0.0, x.dtype), lax.add,
+    ones = lax.pad(jnp.ones_like(x), zero, pads)
+    cnt = lax.reduce_window(ones, zero, lax.add,
                             (1, ky, kx, 1), (1, sy, sx, 1), "VALID")
     return ssum / cnt
 
 
-def stochastic_pool_forward(x, key, ksize: Tuple[int, int],
-                            stride: Tuple[int, int]):
+def stochastic_pool_forward_with_idx(x, key, ksize: Tuple[int, int],
+                                     stride: Tuple[int, int]):
     """Stochastic pooling (Zeiler & Fergus; reference StochasticPooling):
     sample a window element with probability proportional to its positive
-    magnitude; falls back to 0 where the window is all-nonpositive."""
+    magnitude; falls back to 0 where the window is all-nonpositive.
+
+    Also returns flat winner offsets into x (same convention as the
+    reference's max-pooling offsets; `x.size` marks dead all-nonpositive
+    windows — scatter with mode="drop" ignores them), so the paired GD unit
+    can route gradients without re-sampling."""
     ky, kx = ksize
     sy, sx = stride
     n, h, w, c = x.shape
@@ -201,7 +269,15 @@ def stochastic_pool_forward(x, key, ksize: Tuple[int, int],
     logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-30)), -jnp.inf)
     choice = (logp + g).argmax(-1)
     picked = jnp.take_along_axis(p, choice[..., None], -1)[..., 0]
-    return jnp.where(tot[..., 0] > 0, picked, 0.0)
+    alive = tot[..., 0] > 0
+    y = jnp.where(alive, picked, 0.0)
+    idx = _flat_offsets(choice, n, h, w, c, oh, ow, stride, kx)
+    return y, jnp.where(alive, idx, x.size)
+
+
+def stochastic_pool_forward(x, key, ksize: Tuple[int, int],
+                            stride: Tuple[int, int]):
+    return stochastic_pool_forward_with_idx(x, key, ksize, stride)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -215,7 +291,7 @@ def lrn_forward(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
     half = n // 2
     # window-sum across channels via reduce_window on the last axis
     ssum = lax.reduce_window(
-        sq, jnp.array(0.0, x.dtype), lax.add,
+        sq, np.zeros((), np.dtype(x.dtype))[()], lax.add,
         (1,) * (x.ndim - 1) + (n,), (1,) * x.ndim,
         [(0, 0)] * (x.ndim - 1) + [(half, half)])
     return x * (k + alpha * ssum) ** (-beta)
@@ -228,7 +304,8 @@ def lrn_forward(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
 
 def make_dropout_mask(key, shape, drop_prob: float, dtype=jnp.float32):
     keep = 1.0 - drop_prob
-    return (jax.random.uniform(key, shape) < keep).astype(dtype) / dtype(keep)
+    return ((jax.random.uniform(key, shape) < keep).astype(dtype)
+            / np.asarray(keep, dtype)[()])
 
 
 def dropout_forward(x, mask):

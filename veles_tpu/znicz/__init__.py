@@ -6,3 +6,10 @@ forward unit class has a matching gradient unit registered via
 `nn_units.MATCHED_GD` (the reference used a `MatchingObject` metaclass
 registry — SURVEY.md §2.8).
 """
+
+# Importing the op-unit modules registers their layer types and GD pairs
+# (standard_workflow first: the others append to its LAYER_TYPES).
+from veles_tpu.znicz import standard_workflow  # noqa: F401, E402
+from veles_tpu.znicz import (  # noqa: F401, E402
+    activation, all2all, conv, dropout, gd, gd_conv, gd_pooling,
+    normalization, pooling)

@@ -1,0 +1,124 @@
+"""Standalone activation units (forward + backward pairs).
+
+Parity: reference `veles/znicz/activation.py` — `ActivationTanh`,
+`ActivationRELU` (softplus flavor), `ActivationStrictRELU`,
+`ActivationSigmoid`, `ActivationLog` (asinh) as separate graph units,
+used when an activation is not fused into an All2All/Conv layer
+(SURVEY.md §2.8).
+
+TPU-first: each is a trivially-jitted elementwise fn; XLA fuses it into
+whatever producer/consumer surrounds it, so the standalone-unit granularity
+costs nothing in the fused train step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
+
+
+class ActivationForward(Forward):
+    """y = act(x), shape-preserving, no parameters."""
+
+    activation = "linear"
+
+    def param_arrays(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(partial(ox.act_forward, self.activation))
+        return None
+
+    def numpy_run(self) -> None:
+        self.output.mem = ref.act_forward(self.activation, self.input.mem)
+
+    def xla_run(self) -> None:
+        self.output.set_devmem(self._fn(self.input.devmem(self.device)))
+
+
+class ActivationTanh(ActivationForward):
+    activation = "tanh"
+
+
+class ActivationRELU(ActivationForward):
+    activation = "relu"
+
+
+class ActivationStrictRELU(ActivationForward):
+    activation = "strictrelu"
+
+
+class ActivationSigmoid(ActivationForward):
+    activation = "sigmoid"
+
+
+class ActivationLog(ActivationForward):
+    activation = "log"
+
+
+@register_gd(ActivationForward)
+class ActivationBackward(GradientDescentBase):
+    """err_input = act'(y)·err_output. The derivative is expressed from the
+    forward OUTPUT (reference memory model: pre-activations not retained);
+    the log flavor additionally needs the input, which stays linked."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.activation = "linear"
+
+    def link_forward(self, fwd):
+        self.activation = fwd.activation
+        self.link_attrs(fwd, "input", "output")
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.input:
+            return False
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        act = self.activation
+
+        def step(y, err_y, x):
+            return ox.act_backward(act, y, err_y, x)
+
+        self._fn = self.jit(step)
+        return None
+
+    def numpy_run(self) -> None:
+        self.err_input.mem = ref.act_backward(
+            self.activation, self.output.mem, self.err_output.mem,
+            self.input.mem)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.err_input.set_devmem(
+            self._fn(self.output.devmem(d), self.err_output.devmem(d),
+                     self.input.devmem(d)))
+
+
+# -- layer-type registration --------------------------------------------------
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({
+    "activation_tanh": ActivationTanh,
+    "activation_relu": ActivationRELU,
+    "activation_strictrelu": ActivationStrictRELU,
+    "activation_sigmoid": ActivationSigmoid,
+    "activation_log": ActivationLog,
+})

@@ -1,0 +1,105 @@
+"""Convolutional forward units.
+
+Parity: reference `veles/znicz/conv.py` — `Conv` (linear), `ConvTanh`,
+`ConvRELU` (softplus flavor), `ConvStrictRELU`, `ConvSigmoid`; stride /
+padding "sliding window" semantics, implicit-GEMM kernels (SURVEY.md §2.8).
+
+TPU-first: layouts are NHWC/HWIO (what XLA tiles best onto the MXU) and the
+whole conv+bias+activation is one jitted `lax.conv_general_dilated` call —
+the reference's hand-blocked OpenCL/CUDA implicit-GEMM kernels have no
+analog here by design.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward
+
+
+class Conv(Forward):
+    """y = act(conv2d(x, W) + b); x: (N,H,W,C), W: (ky,kx,C,n_kernels)."""
+
+    activation = "linear"
+
+    def __init__(self, workflow=None, n_kernels: int = 16,
+                 kx: int = 3, ky: int = 3,
+                 stride: Tuple[int, int] = (1, 1),
+                 padding: Tuple[int, int] = (0, 0),
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_kernels = n_kernels
+        self.kx = kx
+        self.ky = ky
+        self.stride = tuple(stride)
+        self.padding = tuple(padding)
+
+    def output_hw(self) -> Tuple[int, int]:
+        _, h, w, _ = self.input.shape
+        sy, sx = self.stride
+        ph, pw = self.padding
+        return ((h + 2 * ph - self.ky) // sy + 1,
+                (w + 2 * pw - self.kx) // sx + 1)
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, h, w, c = self.input.shape
+        fan_in = self.kx * self.ky * c
+        self.init_params((self.ky, self.kx, c, self.n_kernels), fan_in)
+        oh, ow = self.output_hw()
+        if not self.output or self.output.shape != (n, oh, ow, self.n_kernels):
+            self.output.reset(np.zeros((n, oh, ow, self.n_kernels),
+                                       np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(partial(
+            ox.conv2d_forward, stride=self.stride, padding=self.padding,
+            activation=self.activation))
+        return None
+
+    def numpy_run(self) -> None:
+        self.output.mem = ref.conv2d_forward(
+            self.input.mem, self.weights.mem, self.bias.mem,
+            self.stride, self.padding, self.activation)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.output.set_devmem(self._fn(
+            self.input.devmem(d), self.weights.devmem(d),
+            self.bias.devmem(d)))
+
+
+class ConvTanh(Conv):
+    activation = "tanh"
+
+
+class ConvRELU(Conv):
+    activation = "relu"
+
+
+class ConvStrictRELU(Conv):
+    activation = "strictrelu"
+
+
+class ConvSigmoid(Conv):
+    activation = "sigmoid"
+
+
+# -- layer-type registration (import-time side effect; see standard_workflow
+#    docstring for the cycle-avoidance rationale) -----------------------------
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({
+    "conv": Conv,
+    "conv_tanh": ConvTanh,
+    "conv_relu": ConvRELU,
+    "conv_strictrelu": ConvStrictRELU,
+    "conv_sigmoid": ConvSigmoid,
+})

@@ -1,0 +1,126 @@
+"""Dropout units.
+
+Parity: reference `veles/znicz/dropout.py` — `DropoutForward` (device-RNG
+mask kernel, `dropout_ratio`), `DropoutBackward` (same mask applied to the
+error flow). Dropout is identity on validation/test minibatches
+(SURVEY.md §2.8).
+
+TPU-first: the mask comes from `jax.random` (counter-based, reproducible
+from the snapshot seed) on the XLA path and the host PRNG on the numpy
+golden path — the same RNG split the reference had between its xorshift
+device kernel and numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.memory import Array
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
+
+
+class DropoutForward(Forward):
+    """y = x·mask while training (mask pre-scaled by 1/keep); identity on
+    validation/test minibatches. `minibatch_class` is linked from the
+    loader by StandardWorkflow (link_loader hook)."""
+
+    def __init__(self, workflow=None, dropout_ratio: float = 0.5,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.dropout_ratio = dropout_ratio
+        self.mask = Array()
+        self.minibatch_class = TRAIN
+
+    def param_arrays(self):
+        return {}
+
+    def link_loader(self, loader) -> None:
+        self.link_attrs(loader, "minibatch_class")
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    @property
+    def training(self) -> bool:
+        return self.minibatch_class == TRAIN
+
+    def xla_init(self):
+        ratio = self.dropout_ratio
+
+        def fwd(x, key):
+            mask = ox.make_dropout_mask(key, x.shape, ratio, x.dtype)
+            return x * mask, mask
+
+        self._fn = self.jit(fwd)
+        return None
+
+    def numpy_run(self) -> None:
+        if not self.training:
+            self.output.mem = self.input.mem.copy()
+            return
+        self.mask.mem = ref.make_dropout_mask(
+            prng.get().state, self.input.shape, self.dropout_ratio)
+        self.output.mem = ref.dropout_forward(self.input.mem, self.mask.mem)
+
+    def xla_run(self) -> None:
+        d = self.device
+        if not self.training:
+            self.output.set_devmem(self.input.devmem(d))
+            return
+        y, mask = self._fn(self.input.devmem(d), prng.get().next_key())
+        self.output.set_devmem(y)
+        self.mask.set_devmem(mask)
+
+
+@register_gd(DropoutForward)
+class DropoutBackward(GradientDescentBase):
+    """err_input = err_output·mask (identity when the forward ran in
+    eval mode — but the GD chain only runs on TRAIN minibatches anyway)."""
+
+    def link_forward(self, fwd):
+        self.link_attrs(fwd, "input", "output", "mask")
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.input:
+            return False
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(lambda err, mask: err * mask)
+        return None
+
+    def numpy_run(self) -> None:
+        if not self.mask:  # no training forward ran yet: identity
+            self.err_input.mem = self.err_output.mem.copy()
+            return
+        self.err_input.mem = ref.dropout_backward(self.err_output.mem,
+                                                  self.mask.mem)
+
+    def xla_run(self) -> None:
+        d = self.device
+        if not self.mask:  # no training forward ran yet: identity
+            self.err_input.set_devmem(self.err_output.devmem(d))
+            return
+        self.err_input.set_devmem(
+            self._fn(self.err_output.devmem(d), self.mask.devmem(d)))
+
+
+# -- layer-type registration --------------------------------------------------
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({
+    "dropout": DropoutForward,
+})

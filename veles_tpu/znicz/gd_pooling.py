@@ -1,0 +1,108 @@
+"""Gradient units for pooling layers.
+
+Parity: reference `veles/znicz/gd_pooling.py` — `GDMaxPooling` (scatter via
+the offsets stored by the forward), `GDMaxAbsPooling`, `GDAvgPooling`
+(uniform spread), plus the stochastic-pooling backward (SURVEY.md §2.8).
+
+TPU-first: max/maxabs/stochastic backwards scatter err at the flat winner
+offsets their forward recorded (`ox.pool_scatter` — one code shape for all
+three); the avg backward is `jax.vjp` of the forward reduce_window. Both
+replace the reference's hand-written scatter kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz import pooling
+from veles_tpu.znicz.nn_units import GradientDescentBase, register_gd
+
+
+class GDPoolingBase(GradientDescentBase):
+    """No trainable parameters: only err routing. Captures the twin's
+    geometry in link_forward."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.ksize = (2, 2)
+        self.stride = (2, 2)
+
+    def link_forward(self, fwd):
+        self.ksize = fwd.ksize
+        self.stride = fwd.stride
+        self.link_attrs(fwd, "input", "output")
+        if hasattr(fwd, "input_offset"):
+            self.link_attrs(fwd, "input_offset")
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.input:
+            return False
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+
+class GDScatterPoolingBase(GDPoolingBase):
+    """Shared backward for pooling flavors whose forward records flat
+    winner offsets (max/maxabs/stochastic): err scatters to the winners;
+    sentinel offsets (input.size — dead stochastic windows) drop."""
+
+    def xla_init(self):
+        shape = tuple(self.input.shape)
+        self._fn = self.jit(lambda err_y, idx: ox.pool_scatter(
+            err_y, idx, shape))
+        return None
+
+    def numpy_run(self) -> None:
+        self.err_input.mem = ref.stochastic_pool_backward(
+            self.err_output.mem, self.input_offset.mem, self.input.shape)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.err_input.set_devmem(
+            self._fn(self.err_output.devmem(d), self.input_offset.devmem(d)))
+
+
+@register_gd(pooling.MaxPooling)
+class GDMaxPooling(GDScatterPoolingBase):
+    pass
+
+
+@register_gd(pooling.MaxAbsPooling)
+class GDMaxAbsPooling(GDScatterPoolingBase):
+    pass
+
+
+@register_gd(pooling.StochasticPooling)
+class GDStochasticPooling(GDScatterPoolingBase):
+    pass
+
+
+@register_gd(pooling.AvgPooling)
+class GDAvgPooling(GDPoolingBase):
+    def xla_init(self):
+        ksize, stride = self.ksize, self.stride
+
+        def step(x, err_y):
+            _, vjp = jax.vjp(
+                lambda v: ox.avgpool_forward(v, ksize, stride), x)
+            (err_x,) = vjp(err_y)
+            return err_x
+
+        self._fn = self.jit(step)
+        return None
+
+    def numpy_run(self) -> None:
+        self.err_input.mem = ref.avgpool_backward(
+            self.err_output.mem, self.input.shape, self.ksize, self.stride)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.err_input.set_devmem(
+            self._fn(self.input.devmem(d), self.err_output.devmem(d)))

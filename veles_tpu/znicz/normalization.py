@@ -1,0 +1,111 @@
+"""Local response normalization units (AlexNet-style, across channels).
+
+Parity: reference `veles/znicz/normalization.py` — forward + dedicated
+backward kernel (SURVEY.md §2.8; "normalization" named in BASELINE.json:4).
+
+TPU-first: forward is a reduce_window over the channel axis inside jit; the
+backward is `jax.vjp` of the forward (SURVEY.md §7 listed LRN backward as a
+Pallas candidate — vjp-of-reduce_window fuses well enough on XLA that no
+hand kernel is needed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
+
+
+class LRNormalizerForward(Forward):
+    """y = x · (k + α·Σ_window x²)^(−β), window of n channels."""
+
+    def __init__(self, workflow=None, k: float = 2.0, alpha: float = 1e-4,
+                 beta: float = 0.75, n: int = 5, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.k = k
+        self.alpha = alpha
+        self.beta = beta
+        self.n = n
+
+    def param_arrays(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(partial(ox.lrn_forward, k=self.k,
+                                    alpha=self.alpha, beta=self.beta,
+                                    n=self.n))
+        return None
+
+    def numpy_run(self) -> None:
+        self.output.mem = ref.lrn_forward(self.input.mem, self.k, self.alpha,
+                                          self.beta, self.n)
+
+    def xla_run(self) -> None:
+        self.output.set_devmem(self._fn(self.input.devmem(self.device)))
+
+
+@register_gd(LRNormalizerForward)
+class LRNormalizerBackward(GradientDescentBase):
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.k = 2.0
+        self.alpha = 1e-4
+        self.beta = 0.75
+        self.n = 5
+
+    def link_forward(self, fwd):
+        self.k, self.alpha, self.beta, self.n = (fwd.k, fwd.alpha, fwd.beta,
+                                                 fwd.n)
+        self.link_attrs(fwd, "input", "output")
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.input:
+            return False
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        fwd = partial(ox.lrn_forward, k=self.k, alpha=self.alpha,
+                      beta=self.beta, n=self.n)
+
+        def step(x, err_y):
+            _, vjp = jax.vjp(fwd, x)
+            (err_x,) = vjp(err_y)
+            return err_x
+
+        self._fn = self.jit(step)
+        return None
+
+    def numpy_run(self) -> None:
+        self.err_input.mem = ref.lrn_backward(
+            self.input.mem, self.err_output.mem, self.k, self.alpha,
+            self.beta, self.n)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.err_input.set_devmem(
+            self._fn(self.input.devmem(d), self.err_output.devmem(d)))
+
+
+# -- layer-type registration --------------------------------------------------
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({
+    "norm": LRNormalizerForward,
+    "lrn": LRNormalizerForward,
+})

@@ -80,6 +80,8 @@ class StandardWorkflow(Workflow):
                     f"{sorted(LAYER_TYPES)}")
             fwd = LAYER_TYPES[kind](self, **spec)
             fwd.link_attrs(prev, ("input", prev_attr))
+            if hasattr(fwd, "link_loader"):  # dropout needs minibatch_class
+                fwd.link_loader(self.loader)
             self.forwards.append(fwd)
             prev, prev_attr = fwd, "output"
 
