@@ -1,0 +1,170 @@
+"""Tests for veles_tpu.parallel: the fused train step and its sharded
+modes (SURVEY.md §4 "multi-device tests on a single host" — here an
+8-device virtual CPU mesh from conftest.py).
+
+Equivalence ladder:
+  granular XLA path  ==  fused local step  ==  shard_map DP over 8 devices
+                                           ==  GSPMD DP×TP over 4×2 mesh
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import XLADevice
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.parallel import make_mesh
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build(minibatch_size=48, max_epochs=2, layers=None):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=10, sample_shape=(8, 8), n_validation=96, n_train=480,
+        minibatch_size=minibatch_size, noise=0.6)
+    return StandardWorkflow(
+        layers=layers or [
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "weights_stddev": 0.05},
+            {"type": "softmax", "output_sample_shape": 10,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=10,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="FusedTest")
+
+
+def first_batch(wf):
+    wf.initialize(device=XLADevice())
+    ld = wf.loader
+    # walk the schedule to the first TRAIN minibatch
+    from veles_tpu.loader.base import TRAIN
+    while True:
+        ld.run()
+        if ld.minibatch_class == TRAIN:
+            return ld.minibatch_data.mem.copy(), ld.minibatch_labels.mem.copy()
+
+
+def test_fused_matches_granular_one_step():
+    """One fused step == one granular forward+backward+update pass on the
+    same minibatch with the same initial weights."""
+    wf_g = build()
+    x, y = first_batch(wf_g)
+    # granular: run the chain by hand on exactly this minibatch
+    wf_g.loader.minibatch_data.reset(x)
+    wf_g.loader.minibatch_labels.reset(y)
+    for fwd in wf_g.forwards:
+        fwd.run()
+    wf_g.evaluator.run()
+    for g in wf_g.gds:
+        g.run()
+
+    wf_f = build()
+    first_batch(wf_f)  # same seeds -> same init weights & same first batch
+    step = wf_f.build_fused_step()
+    state = step.init_state()
+    state, (loss, n_err) = step.train(state, x, y)
+    step.write_back(state)
+
+    for uf, ug in zip(wf_f.forwards, wf_g.forwards):
+        np.testing.assert_allclose(uf.weights.mem, ug.weights.mem,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(uf.bias.mem, ug.bias.mem,
+                                   rtol=1e-5, atol=1e-6)
+    assert float(loss) == pytest.approx(float(wf_g.evaluator.loss), rel=1e-4)
+    assert int(n_err) == int(wf_g.evaluator.n_err)
+
+
+@pytest.mark.parametrize("mesh_kw,mode", [
+    (dict(), "dp"),                 # 8-way data parallel, shard_map+pmean
+    (dict(model=2), "gspmd"),       # 4×2 DP×TP via named shardings
+    (dict(model=4, data=2), "gspmd"),
+])
+def test_sharded_matches_local(mesh_kw, mode, eight_devices):
+    """The sharded step computes the SAME update as the local step: the
+    all-reduce of per-shard mean grads == global mean grad."""
+    wf_a = build()
+    x, y = first_batch(wf_a)
+    step_a = wf_a.build_fused_step()          # local single-device
+    sa = step_a.init_state()
+    sa, (loss_a, err_a) = step_a.train(sa, x, y)
+
+    wf_b = build()
+    first_batch(wf_b)
+    mesh = make_mesh(**mesh_kw)
+    step_b = wf_b.build_fused_step(mesh=mesh, mode=mode)
+    sb = step_b.init_state()
+    sb, (loss_b, err_b) = step_b.train(sb, x, y)
+
+    assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
+    assert int(err_a) == int(err_b)
+    for pa, pb in zip(sa["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_run_fused_trains_and_decision_tracks(eight_devices):
+    """run_fused drives the real Loader/Decision units: trains to low
+    error on the 8-device DP mesh and leaves weights written back."""
+    wf = build(max_epochs=3)
+    mesh = make_mesh()
+    w0 = None
+    wf.initialize(device=XLADevice())
+    w0 = wf.forwards[0].weights.mem.copy()
+    wf.run_fused(mesh=mesh, mode="dp")
+    assert wf.decision.epoch_number == 3
+    assert wf.decision.best_validation_err <= 20, \
+        wf.decision.best_validation_err
+    assert not np.allclose(wf.forwards[0].weights.mem, w0)
+
+
+def test_fused_conv_net_with_dropout_trains(eight_devices):
+    """Conv+pool+LRN+dropout chain end-to-end under the fused DP step
+    (dropout keys decorrelate per shard; eval minibatches skip dropout)."""
+    prng.seed_all(77)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(8, 8, 1), n_validation=64, n_train=320,
+        minibatch_size=32, noise=0.4)
+    wf = StandardWorkflow(
+        layers=[
+            {"type": "conv_strictrelu", "n_kernels": 8, "kx": 3, "ky": 3,
+             "weights_stddev": 0.1},
+            {"type": "maxabs_pooling", "ksize": (2, 2)},
+            {"type": "dropout", "dropout_ratio": 0.2},
+            {"type": "softmax", "output_sample_shape": 4,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 3, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.05, "gradient_moment": 0.9},
+        name="FusedConv")
+    wf.run_fused(mesh=make_mesh(), mode="dp")
+    assert wf.decision.best_validation_err <= 24, \
+        wf.decision.best_validation_err
+
+
+def test_mse_loss_fused():
+    """MSE (autoencoder-style) fused path: identity target reconstruction
+    error decreases."""
+    prng.seed_all(5)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(6, 6), n_validation=32, n_train=160,
+        minibatch_size=32, noise=0.3, autoencoder=True)
+    wf = StandardWorkflow(
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "weights_stddev": 0.1},
+            {"type": "all2all", "output_sample_shape": (6, 6),
+             "weights_stddev": 0.1},
+        ],
+        loader=loader, loss="mse",
+        decision_config={"max_epochs": 15, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.02, "gradient_moment": 0.9},
+        name="FusedAE")
+    wf.run_fused()
+    # reconstruction MSE (summed per validation pass) falls well below the
+    # ~35/minibatch starting point
+    assert wf.decision.best_validation_err < 5.0, wf.decision.epoch_metrics

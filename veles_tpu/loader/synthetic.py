@@ -48,16 +48,20 @@ class SyntheticClassifierLoader(FullBatchLoader):
                  sample_shape: Tuple[int, ...] = (28, 28),
                  n_test: int = 0, n_validation: int = 200,
                  n_train: int = 1000, noise: float = 0.35,
-                 data_seed: int = 4242, **kwargs) -> None:
+                 data_seed: int = 4242, autoencoder: bool = False,
+                 **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.n_classes = n_classes
         self.sample_shape = tuple(sample_shape)
         self.split = (n_test, n_validation, n_train)
         self.noise = noise
         self.data_seed = data_seed
+        #: targets = inputs (MSE reconstruction workflows)
+        self.autoencoder = autoencoder
 
     def load_data(self) -> None:
         data, labels = make_classification(
             self.split, self.n_classes, self.sample_shape, self.noise,
             self.data_seed)
-        self.bind_arrays(data, labels, *self.split)
+        self.bind_arrays(data, data.copy() if self.autoencoder else labels,
+                         *self.split)

@@ -1,0 +1,273 @@
+"""FusedTrainStep: the whole fwd+bwd+update chain as ONE XLA computation.
+
+Parity note: in the reference, one minibatch = dozens of kernel enqueues
+(§3.1 hot loop) and distributed training = pickled weight deltas over
+ZeroMQ (§3.2). Here the entire StandardWorkflow hot loop compiles into a
+single donated jit step; on a device mesh the batch is sharded over the
+"data" axis and gradient averaging is a `lax.pmean` all-reduce over ICI —
+the north-star replacement (BASELINE.json:5). Tensor parallelism (absent
+in the reference) shards layer output dims over "model" via GSPMD named
+shardings.
+
+Two execution modes:
+- "dp"    — explicit `shard_map` over the data axis with hand-placed
+            pmean/psum collectives (the guaranteed-collectives path used
+            by the scaling harness);
+- "gspmd" — `jax.jit` with NamedSharding annotations on params (model
+            axis) and batch (data axis); XLA's SPMD partitioner inserts
+            the collectives. Composes DP×TP.
+A mesh of one device degrades to plain jit (same code path, collectives
+are no-ops) — SURVEY.md §7: build size-agnostically.
+
+Numerics match the granular unit-by-unit path (tested): grads come from
+`jax.grad` over the same `fused_apply` forward math, and the update is the
+same `ops.optim.sgd_update` the GD units use, with each layer keeping its
+own hyperparameters from its GD twin.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from veles_tpu import prng
+from veles_tpu.ops import optim
+from veles_tpu.ops import xla as ox
+from veles_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _tree_cast(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating)
+        else a, tree)
+
+
+class FusedTrainStep:
+    """Compile a StandardWorkflow's training chain into one sharded step.
+
+    state = {"params": tuple-of-dicts (one per forward layer),
+             "vel":    matching velocity pytree,
+             "key":    jax PRNG key,
+             "lr_scale": traced scalar (lr_adjust drives it, no retrace)}
+    """
+
+    def __init__(self, workflow, mesh=None, mode: str = "auto",
+                 donate: bool = True,
+                 compute_dtype: Optional[Any] = None) -> None:
+        self.wf = workflow
+        self.mesh = mesh
+        self.forwards = list(workflow.forwards)
+        self.loss_kind = workflow.loss
+        self.n_classes = getattr(workflow, "n_classes", None)
+        self.compute_dtype = compute_dtype
+        if self.loss_kind == "softmax" and not getattr(
+                self.forwards[-1], "fused_emits_logits", False):
+            raise ValueError(
+                "fused softmax loss needs an All2AllSoftmax final layer "
+                "(it emits logits for log-softmax CE)")
+        # pair each forward with its GD twin's hyperparams (gds is built in
+        # reverse order by StandardWorkflow)
+        gds = list(workflow.gds)
+        n = len(self.forwards)
+        self.cfgs: List[optim.SGDConfig] = []
+        for i in range(n):
+            g = gds[n - 1 - i]
+            self.cfgs.append(optim.SGDConfig(
+                lr=getattr(g, "learning_rate", 0.0),
+                momentum=getattr(g, "gradient_moment", 0.0),
+                weight_decay=getattr(g, "weights_decay", 0.0),
+                l1_decay=getattr(g, "l1_decay", 0.0),
+                lr_bias_mult=getattr(g, "learning_rate_bias", 1.0)))
+        if mode == "auto":
+            if mesh is None:
+                mode = "local"
+            elif MODEL_AXIS in mesh.axis_names \
+                    and mesh.shape[MODEL_AXIS] > 1:
+                mode = "gspmd"
+            else:
+                mode = "dp"
+        self.mode = mode
+        self.donate = donate
+        self._train_fn = None
+        self._eval_fn = None
+
+    # -- state <-> unit Arrays ----------------------------------------------
+
+    def init_state(self) -> Dict[str, Any]:
+        params = tuple(
+            {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
+            for u in self.forwards)
+        vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+        state = {"params": params, "vel": vel,
+                 "key": prng.get().next_key(),
+                 "lr_scale": jnp.float32(1.0)}
+        if self.mode == "gspmd":
+            state = self._shard_state(state)
+        return state
+
+    def write_back(self, state: Dict[str, Any]) -> None:
+        """Copy fused-state params back into the unit Arrays so granular
+        mode, snapshots and the C++ exporter see the trained weights."""
+        for u, p in zip(self.forwards, state["params"]):
+            for k, arr in u.param_arrays().items():
+                arr.reset(np.asarray(p[k]))
+
+    # -- forward chain -------------------------------------------------------
+
+    def _forward(self, params, x, key, train: bool):
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            params = _tree_cast(params, self.compute_dtype)
+        for i, u in enumerate(self.forwards):
+            k = jax.random.fold_in(key, i) if u.fused_needs_key else None
+            x = u.fused_apply(params[i], x, key=k, train=train)
+        if self.compute_dtype is not None:
+            x = x.astype(jnp.float32)
+        return x
+
+    def _loss_metrics(self, params, x, y, key, train: bool):
+        out = self._forward(params, x, key, train)
+        if self.loss_kind == "softmax":
+            loss = ox.ce_loss_from_logits(out, y, self.n_classes)
+            n_err = (out.argmax(axis=-1) != y).sum()
+        else:
+            loss, _ = ox.mse(out, y)
+            n_err = loss
+        return loss, n_err
+
+    # -- step bodies ---------------------------------------------------------
+
+    def _train_body(self, state, x, y, *, axis: Optional[str]):
+        step_key = state["key"]
+        n_shards = 1 if axis is None else self.mesh.shape[axis]
+        if axis is not None:  # decorrelate dropout/stochastic-pool per shard
+            step_key = jax.random.fold_in(step_key, lax.axis_index(axis))
+
+        def lf(p):
+            loss, n_err = self._loss_metrics(p, x, y, step_key, True)
+            # Under shard_map the params are unvarying (replicated), so the
+            # transpose of their broadcast IS a psum over the data axis —
+            # jax inserts the gradient all-reduce automatically (vma
+            # semantics). Scaling the objective by 1/n_shards makes that
+            # psum of per-shard mean-losses the exact global-mean gradient:
+            # THE north-star collective (BASELINE.json:5), placed by
+            # autodiff right where the reference shipped pickled deltas.
+            return loss / n_shards, (loss, n_err)
+
+        (_, (loss, n_err)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state["params"])
+        if axis is not None:
+            loss = lax.pmean(loss, axis)
+            n_err = (lax.psum(n_err, axis)
+                     if self.loss_kind == "softmax"
+                     else lax.pmean(n_err, axis))
+        new_params, new_vel = [], []
+        for p, g, v, cfg in zip(state["params"], grads, state["vel"],
+                                self.cfgs):
+            if p:
+                np_, nv_ = optim.sgd_update(p, g, v, cfg,
+                                            lr_scale=state["lr_scale"])
+            else:
+                np_, nv_ = p, v
+            new_params.append(np_)
+            new_vel.append(nv_)
+        # advance the carried key identically on every shard (fold_in of
+        # the *unfolded* state key keeps it replicated)
+        new_key = jax.random.fold_in(state["key"], 1)
+        new_state = {"params": tuple(new_params), "vel": tuple(new_vel),
+                     "key": new_key, "lr_scale": state["lr_scale"]}
+        return new_state, loss, n_err
+
+    def _eval_body(self, params, x, y, *, axis: Optional[str]):
+        key = jax.random.PRNGKey(0)  # unused: eval paths need no RNG
+        loss, n_err = self._loss_metrics(params, x, y, key, False)
+        if axis is not None:
+            loss = lax.pmean(loss, axis)
+            n_err = (lax.psum(n_err, axis)
+                     if self.loss_kind == "softmax"
+                     else lax.pmean(n_err, axis))
+        return loss, n_err
+
+    # -- compilation ---------------------------------------------------------
+
+    def _build(self) -> None:
+        donate = (0,) if self.donate else ()
+        if self.mode == "local":
+            self._train_fn = jax.jit(
+                lambda s, x, y: self._train_body(s, x, y, axis=None),
+                donate_argnums=donate)
+            self._eval_fn = jax.jit(
+                lambda p, x, y: self._eval_body(p, x, y, axis=None))
+        elif self.mode == "dp":
+            mesh = self.mesh
+            train = jax.shard_map(
+                lambda s, x, y: self._train_body(s, x, y, axis=DATA_AXIS),
+                mesh=mesh,
+                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P(), P()))
+            evalf = jax.shard_map(
+                lambda p, x, y: self._eval_body(p, x, y, axis=DATA_AXIS),
+                mesh=mesh,
+                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P()))
+            self._train_fn = jax.jit(train, donate_argnums=donate)
+            self._eval_fn = jax.jit(evalf)
+        elif self.mode == "gspmd":
+            mesh = self.mesh
+            xsh = NamedSharding(mesh, P(DATA_AXIS))
+            self._train_fn = jax.jit(
+                lambda s, x, y: self._train_body(s, x, y, axis=None),
+                in_shardings=(self._state_shardings(), xsh, xsh),
+                donate_argnums=donate)
+            self._eval_fn = jax.jit(
+                lambda p, x, y: self._eval_body(p, x, y, axis=None),
+                in_shardings=(self._param_shardings(), xsh, xsh))
+        else:
+            raise ValueError(f"unknown mode {self.mode!r}")
+
+    # -- GSPMD shardings: params TP-sharded over "model", batch over "data" --
+
+    def _param_spec(self, a) -> P:
+        m = self.mesh.shape[MODEL_AXIS]
+        if a.ndim >= 1 and a.shape[-1] % m == 0:
+            # shard the output dim (weights) / the only dim (biases);
+            # non-divisible params stay replicated — XLA would pad-shard
+            # them inefficiently, and they are small by definition
+            return P(*([None] * (a.ndim - 1) + [MODEL_AXIS]))
+        return P()
+
+    def _param_shardings(self):
+        return tuple(
+            {k: NamedSharding(self.mesh, self._param_spec(np.asarray(a.mem)))
+             for k, a in u.param_arrays().items()}
+            for u in self.forwards)
+
+    def _state_shardings(self):
+        psh = self._param_shardings()
+        repl = NamedSharding(self.mesh, P())
+        return {"params": psh, "vel": psh, "key": repl, "lr_scale": repl}
+
+    def _shard_state(self, state):
+        return jax.device_put(state, self._state_shardings())
+
+    # -- public API ----------------------------------------------------------
+
+    def train(self, state, x, y):
+        """One fused training step. Returns (new_state, (loss, n_err))."""
+        if self._train_fn is None:
+            self._build()
+        new_state, loss, n_err = self._train_fn(state, jnp.asarray(x),
+                                                jnp.asarray(y))
+        return new_state, (loss, n_err)
+
+    def evaluate(self, state, x, y):
+        """Forward-only metrics (validation/test minibatches)."""
+        if self._eval_fn is None:
+            self._build()
+        return self._eval_fn(state["params"], jnp.asarray(x), jnp.asarray(y))

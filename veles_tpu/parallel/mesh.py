@@ -1,0 +1,75 @@
+"""Device-mesh construction.
+
+The canonical axes (used by FusedTrainStep, attention units and the
+launcher):
+
+- "data"  — data parallelism: batch sharded, gradients pmean-ed (the
+            north-star all-reduce replacing the reference's master–slave
+            averaging, SURVEY.md §2.4);
+- "model" — tensor parallelism: layer output dims sharded (absent in the
+            reference — a capability the TPU build adds);
+- "seq"   — sequence/context parallelism: ring attention over ICI
+            (veles_tpu.ops.attention).
+
+Meshes are built size-agnostically from `jax.devices()` so the same code
+runs on 1 dev chip, an 8-device CPU test mesh, and a v5e-64 pod
+(SURVEY.md §7 hard-parts list).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def mesh_shape(n_devices: int, model: int = 1, seq: int = 1,
+               data: Optional[int] = None) -> Dict[str, int]:
+    """Resolve an axis-size dict; `data` defaults to whatever is left."""
+    if n_devices % (model * seq):
+        raise ValueError(
+            f"{n_devices} devices not divisible by model({model})*seq({seq})")
+    if data is None:
+        data = n_devices // (model * seq)
+    if data * model * seq != n_devices:
+        raise ValueError(
+            f"data({data})*model({model})*seq({seq}) != {n_devices} devices")
+    return {DATA_AXIS: data, MODEL_AXIS: model, SEQ_AXIS: seq}
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              model: int = 1, seq: int = 1,
+              data: Optional[int] = None) -> Mesh:
+    """Build a (data, model, seq) mesh over `devices` (default: all).
+
+    Axis order puts "model" and "seq" innermost so their collectives ride
+    the fastest links (ICI neighbors), and "data" outermost so the gradient
+    all-reduce tolerates the slower hops — the standard TPU layout recipe.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = mesh_shape(len(devices), model=model, seq=seq, data=data)
+    arr = np.asarray(devices).reshape(
+        shape[DATA_AXIS], shape[SEQ_AXIS], shape[MODEL_AXIS])
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    """A trivial 1-device mesh (dev chip / tests): the same FusedTrainStep
+    code path, collectives become no-ops."""
+    return make_mesh(jax.devices()[:1])
+
+
+def largest_pow2_data(n: Optional[int] = None) -> int:
+    """Largest power-of-two device count usable as a pure-DP mesh (bench
+    convenience for odd host configurations)."""
+    if n is None:
+        n = len(jax.devices())
+    return 2 ** int(math.log2(n))

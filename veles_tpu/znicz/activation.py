@@ -42,6 +42,9 @@ class ActivationForward(Forward):
         self._fn = self.jit(partial(ox.act_forward, self.activation))
         return None
 
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return ox.act_forward(self.activation, x)
+
     def numpy_run(self) -> None:
         self.output.mem = ref.act_forward(self.activation, self.input.mem)
 

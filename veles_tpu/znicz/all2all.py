@@ -57,6 +57,11 @@ class All2All(Forward):
                                     activation=self.activation))
         return None
 
+    def fused_apply(self, params, x, *, key=None, train=True):
+        y = ox.all2all_forward(x, params["weights"], params["bias"],
+                               self.activation)
+        return y.reshape((-1,) + self.output_sample_shape)
+
     def numpy_run(self) -> None:
         self.output.mem = ref.all2all_forward(
             self.input.mem, self.weights.mem, self.bias.mem,
@@ -116,3 +121,10 @@ class All2AllSoftmax(All2All):
                               self.bias.devmem(d))
         self.output.set_devmem(probs)
         self.max_idx.set_devmem(idx)
+
+    #: the fused train step takes logits and uses log-softmax CE directly
+    #: (numerically identical gradient to the granular probs path).
+    fused_emits_logits = True
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return ox.all2all_forward(x, params["weights"], params["bias"])

@@ -64,6 +64,10 @@ class Conv(Forward):
             activation=self.activation))
         return None
 
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return ox.conv2d_forward(x, params["weights"], params["bias"],
+                                 self.stride, self.padding, self.activation)
+
     def numpy_run(self) -> None:
         self.output.mem = ref.conv2d_forward(
             self.input.mem, self.weights.mem, self.bias.mem,

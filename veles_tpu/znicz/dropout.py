@@ -54,6 +54,14 @@ class DropoutForward(Forward):
     def training(self) -> bool:
         return self.minibatch_class == TRAIN
 
+    fused_needs_key = True
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        if not train:
+            return x
+        mask = ox.make_dropout_mask(key, x.shape, self.dropout_ratio, x.dtype)
+        return x * mask
+
     def xla_init(self):
         ratio = self.dropout_ratio
 

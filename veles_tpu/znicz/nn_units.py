@@ -112,6 +112,22 @@ class Forward(XLAUnit):
         """The unit's trainable parameters as named Arrays."""
         return {"weights": self.weights, "bias": self.bias}
 
+    #: set on layers whose fused_apply needs a PRNG key (dropout,
+    #: stochastic pooling); the fused step folds a per-layer key in.
+    fused_needs_key = False
+
+    def fused_apply(self, params: Dict[str, Any], x, *, key=None,
+                    train: bool = True):
+        """Pure jnp forward for the fused/sharded train step
+        (veles_tpu.parallel.FusedTrainStep). `params` holds jnp arrays
+        keyed like `param_arrays()`. Static layer config (stride, ksize,
+        activation...) is read from `self` — it is compile-time constant.
+
+        Must be differentiable wrt `params` and `x`: the fused step takes
+        grads with jax.grad instead of running the granular GD units."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support the fused train step")
+
 
 class GradientDescentBase(XLAUnit):
     """Base of all gradient units.

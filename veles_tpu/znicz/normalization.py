@@ -49,6 +49,9 @@ class LRNormalizerForward(Forward):
                                     n=self.n))
         return None
 
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return ox.lrn_forward(x, self.k, self.alpha, self.beta, self.n)
+
     def numpy_run(self) -> None:
         self.output.mem = ref.lrn_forward(self.input.mem, self.k, self.alpha,
                                           self.beta, self.n)

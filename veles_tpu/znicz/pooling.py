@@ -68,6 +68,15 @@ class MaxPooling(Pooling):
                                     use_abs=self.use_abs))
         return None
 
+    def fused_apply(self, params, x, *, key=None, train=True):
+        if self.use_abs:
+            # the custom-comparator reduce_window has no reverse-mode rule;
+            # the patches/argmax formulation differentiates (gather vjp)
+            return ox.maxpool_forward_with_idx(x, self.ksize, self.stride,
+                                               use_abs=True)[0]
+        # reduce_window flavor: differentiable, no offsets materialized
+        return ox.maxpool_forward(x, self.ksize, self.stride, False)
+
     def numpy_run(self) -> None:
         y, idx = ref.maxpool_forward(self.input.mem, self.ksize, self.stride,
                                      self.use_abs)
@@ -90,6 +99,9 @@ class AvgPooling(Pooling):
                                     stride=self.stride))
         return None
 
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return ox.avgpool_forward(x, self.ksize, self.stride)
+
     def numpy_run(self) -> None:
         self.output.mem = ref.avgpool_forward(self.input.mem, self.ksize,
                                               self.stride)
@@ -107,10 +119,17 @@ class StochasticPooling(Pooling):
         super().__init__(workflow, **kwargs)
         self.input_offset = Array()
 
+    fused_needs_key = True
+
     def xla_init(self):
         self._fn = self.jit(partial(ox.stochastic_pool_forward_with_idx,
                                     ksize=self.ksize, stride=self.stride))
         return None
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        if not train:  # deterministic at eval: average pooling stand-in
+            return ox.avgpool_forward(x, self.ksize, self.stride)
+        return ox.stochastic_pool_forward(x, key, self.ksize, self.stride)
 
     def numpy_run(self) -> None:
         y, idx = ref.stochastic_pool_forward(

@@ -162,3 +162,49 @@ class StandardWorkflow(Workflow):
         if not self.is_initialized:
             self.initialize(device=device)
         self.run()
+
+    # -- fused/sharded execution (veles_tpu.parallel) -------------------------
+
+    def build_fused_step(self, mesh=None, mode: str = "auto",
+                         compute_dtype=None):
+        """Compile the whole forward+backward+update chain into one donated
+        XLA step, optionally sharded over `mesh` (data/model axes). See
+        parallel.fused.FusedTrainStep."""
+        from veles_tpu.parallel.fused import FusedTrainStep
+        return FusedTrainStep(self, mesh=mesh, mode=mode,
+                              compute_dtype=compute_dtype)
+
+    def run_fused(self, epochs: Optional[int] = None, device=None,
+                  mesh=None, mode: str = "auto", compute_dtype=None) -> None:
+        """Train with the fused step while keeping the graph semantics:
+        the real Loader drives minibatches and the real Decision unit does
+        the epoch/stop bookkeeping (so snapshot gating, best-error tracking
+        and the `complete` Bool behave exactly as in granular mode)."""
+        from veles_tpu.loader.base import TRAIN
+        if epochs is not None:
+            self.decision.max_epochs = epochs
+        if not self.is_initialized:
+            self.initialize(device=device)
+        step = self.build_fused_step(mesh=mesh, mode=mode,
+                                     compute_dtype=compute_dtype)
+        state = step.init_state()
+        loader, ev, dec = self.loader, self.evaluator, self.decision
+        # the fused step uploads (sharded) itself; the loader's granular-path
+        # device push would be a second, wasted H2D transfer per minibatch
+        prev_on_device, loader.on_device = loader.on_device, False
+        while not bool(dec.complete):
+            loader.run()
+            x = loader.minibatch_data.mem
+            y = loader.minibatch_labels.mem
+            if loader.minibatch_class == TRAIN:
+                state, (loss, n_err) = step.train(state, x, y)
+            else:
+                loss, n_err = step.evaluate(state, x, y)
+            # feed the Decision through the evaluator's linked attrs
+            ev.loss = float(loss)
+            ev.n_err = (int(n_err) if self.loss == "softmax"
+                        else float(n_err))
+            dec.run()
+        loader.on_device = prev_on_device
+        step.write_back(state)
+        self.fused_state = state
