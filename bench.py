@@ -1,0 +1,80 @@
+"""Benchmark harness: AlexNet training throughput, samples/sec/chip.
+
+Protocol (BASELINE.md): full Krizhevsky geometry (227x227x3, batch 128),
+fused train step (forward+backward+update in ONE donated XLA computation),
+bf16 compute with f32 master weights, synthetic device-resident batch.
+Warmup steps first (compile + cache), then timed windows; prints ONE JSON
+line with the median-window throughput.
+
+vs_baseline: the reference's published numbers are unrecoverable (empty
+mount, BASELINE.json "published": {}); the denominator is this repo's own
+round-1 measured floor so later rounds show progress against it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Round-1 measured floor (samples/sec/chip, single v5e chip), measured
+# 2026-07-29 on TPU v5 lite via this harness. Later rounds report
+# vs_baseline against it so progress/regressions are visible.
+ROUND1_FLOOR = 8622.0
+
+BATCH = 128
+WARMUP = 4
+WINDOWS = 3
+STEPS_PER_WINDOW = 20
+
+
+def main() -> None:
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.samples.alexnet import create_workflow
+
+    prng.seed_all(1234)
+    wf = create_workflow(minibatch_size=BATCH, n_train=2 * BATCH,
+                         n_validation=BATCH)
+    wf.initialize(device=None)
+    step = wf.build_fused_step(compute_dtype="bfloat16")
+    state = step.init_state()
+
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randn(BATCH, 227, 227, 3).astype(np.float32))
+    y = jax.device_put(rng.randint(0, 64, BATCH))
+
+    def sync(st):
+        # block_until_ready is not a reliable barrier through the remote
+        # PJRT tunnel; a scalar device_get is. Fetch one param element.
+        np.asarray(st["params"][-1]["bias"][:1])
+
+    for _ in range(WARMUP):
+        state, _ = step.train(state, x, y)
+    sync(state)
+
+    rates = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS_PER_WINDOW):
+            state, _ = step.train(state, x, y)
+        sync(state)
+        dt = time.perf_counter() - t0
+        rates.append(BATCH * STEPS_PER_WINDOW / dt)
+
+    value = float(np.median(rates))
+    n_chips = jax.local_device_count()
+    per_chip = value / n_chips
+    print(json.dumps({
+        "metric": "alexnet_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / ROUND1_FLOOR, 3) if ROUND1_FLOOR
+        else 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -60,7 +60,6 @@ class FusedTrainStep:
     def __init__(self, workflow, mesh=None, mode: str = "auto",
                  donate: bool = True,
                  compute_dtype: Optional[Any] = None) -> None:
-        self.wf = workflow
         self.mesh = mesh
         self.forwards = list(workflow.forwards)
         self.loss_kind = workflow.loss
@@ -92,6 +91,15 @@ class FusedTrainStep:
                 mode = "gspmd"
             else:
                 mode = "dp"
+        if mode in ("dp", "gspmd"):
+            if mesh is None:
+                raise ValueError(f"mode={mode!r} requires a mesh")
+            mb = getattr(workflow.loader, "minibatch_size", None)
+            n_data = mesh.shape.get(DATA_AXIS, 1)
+            if mb is not None and mb % n_data:
+                raise ValueError(
+                    f"minibatch_size {mb} not divisible by the mesh data "
+                    f"axis ({n_data} shards)")
         self.mode = mode
         self.donate = donate
         self._train_fn = None

@@ -18,7 +18,7 @@ runs on 1 dev chip, an 8-device CPU test mesh, and a v5e-64 pod
 
 from __future__ import annotations
 
-import math
+
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -59,17 +59,3 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     arr = np.asarray(devices).reshape(
         shape[DATA_AXIS], shape[SEQ_AXIS], shape[MODEL_AXIS])
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
-
-
-def single_device_mesh() -> Mesh:
-    """A trivial 1-device mesh (dev chip / tests): the same FusedTrainStep
-    code path, collectives become no-ops."""
-    return make_mesh(jax.devices()[:1])
-
-
-def largest_pow2_data(n: Optional[int] = None) -> int:
-    """Largest power-of-two device count usable as a pure-DP mesh (bench
-    convenience for odd host configurations)."""
-    if n is None:
-        n = len(jax.devices())
-    return 2 ** int(math.log2(n))

@@ -192,19 +192,35 @@ class StandardWorkflow(Workflow):
         # the fused step uploads (sharded) itself; the loader's granular-path
         # device push would be a second, wasted H2D transfer per minibatch
         prev_on_device, loader.on_device = loader.on_device, False
-        while not bool(dec.complete):
-            loader.run()
-            x = loader.minibatch_data.mem
-            y = loader.minibatch_labels.mem
-            if loader.minibatch_class == TRAIN:
-                state, (loss, n_err) = step.train(state, x, y)
-            else:
-                loss, n_err = step.evaluate(state, x, y)
-            # feed the Decision through the evaluator's linked attrs
-            ev.loss = float(loss)
-            ev.n_err = (int(n_err) if self.loss == "softmax"
-                        else float(n_err))
-            dec.run()
-        loader.on_device = prev_on_device
-        step.write_back(state)
-        self.fused_state = state
+        try:
+            # Metrics accumulate ON DEVICE across each class pass (lazy
+            # scalar adds); the single host sync happens at last_minibatch,
+            # so device execution pipelines across minibatches (the
+            # evaluator docstring's fused-mode contract).
+            acc_loss = acc_err = None
+            while not bool(dec.complete):
+                loader.run()
+                x = loader.minibatch_data.mem
+                y = loader.minibatch_labels.mem
+                if loader.minibatch_class == TRAIN:
+                    state, (loss, n_err) = step.train(state, x, y)
+                else:
+                    loss, n_err = step.evaluate(state, x, y)
+                acc_loss = loss if acc_loss is None else acc_loss + loss
+                acc_err = n_err if acc_err is None else acc_err + n_err
+                if bool(loader.last_minibatch):
+                    # Decision's improvement/stop logic only reads totals
+                    # at the class-pass boundary; feeding the accumulated
+                    # sum here (zeros in between) preserves its semantics.
+                    ev.loss = float(acc_loss)
+                    ev.n_err = (int(acc_err) if self.loss == "softmax"
+                                else float(acc_err))
+                    acc_loss = acc_err = None
+                else:
+                    ev.loss = 0.0
+                    ev.n_err = 0
+                dec.run()
+        finally:
+            loader.on_device = prev_on_device
+            step.write_back(state)
+            self.fused_state = state
