@@ -36,15 +36,25 @@ def main() -> None:
     from veles_tpu.samples.alexnet import create_workflow
 
     prng.seed_all(1234)
-    wf = create_workflow(minibatch_size=BATCH, n_train=2 * BATCH,
-                         n_validation=BATCH)
+    # On a multi-chip host, shard the data axis over every local chip so
+    # the per-chip division below matches where the work actually ran; a
+    # single chip uses the unsharded fast path.
+    n_chips = jax.local_device_count()
+    mesh = None
+    batch = BATCH
+    if n_chips > 1:
+        from veles_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(jax.devices(), data=n_chips)
+        batch = BATCH * n_chips
+    wf = create_workflow(minibatch_size=batch, n_train=2 * batch,
+                         n_validation=batch)
     wf.initialize(device=None)
-    step = wf.build_fused_step(compute_dtype="bfloat16")
+    step = wf.build_fused_step(mesh=mesh, compute_dtype="bfloat16")
     state = step.init_state()
 
     rng = np.random.RandomState(0)
-    x = jax.device_put(rng.randn(BATCH, 227, 227, 3).astype(np.float32))
-    y = jax.device_put(rng.randint(0, 64, BATCH))
+    x = jax.device_put(rng.randn(batch, 227, 227, 3).astype(np.float32))
+    y = jax.device_put(rng.randint(0, 64, batch))
 
     def sync(st):
         # block_until_ready is not a reliable barrier through the remote
@@ -62,10 +72,9 @@ def main() -> None:
             state, _ = step.train(state, x, y)
         sync(state)
         dt = time.perf_counter() - t0
-        rates.append(BATCH * STEPS_PER_WINDOW / dt)
+        rates.append(batch * STEPS_PER_WINDOW / dt)
 
     value = float(np.median(rates))
-    n_chips = jax.local_device_count()
     per_chip = value / n_chips
     print(json.dumps({
         "metric": "alexnet_train_samples_per_sec_per_chip",

@@ -1,0 +1,177 @@
+"""Service layer tests: Snapshotter (checkpoint/resume with metric-stamped
+compressed files), CLI/Launcher (config import + dotted overrides +
+run(load, main)), web status JSON (SURVEY.md §2.5, §2.9)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.snapshotter import Snapshotter
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build(tmp_path=None, max_epochs=2, snapshot=False):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=5, sample_shape=(6, 6), n_validation=50, n_train=200,
+        minibatch_size=50, noise=0.5)
+    snap_cfg = None
+    if snapshot:
+        snap_cfg = {"prefix": "t", "directory": str(tmp_path),
+                    "compression": "gz"}
+    return StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.05},
+                {"type": "softmax", "output_sample_shape": 5,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=5,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        snapshot_config=snap_cfg, name="SvcTest")
+
+
+# ---------------------------------------------------------------------------
+# Snapshotter
+# ---------------------------------------------------------------------------
+
+
+def test_snapshotter_writes_stamped_compressed_file(tmp_path):
+    wf = build(tmp_path, max_epochs=2, snapshot=True)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    files = sorted(os.listdir(tmp_path))
+    assert files, "no snapshot written despite improvements"
+    assert all(f.startswith("t_") and f.endswith(".pickle.gz")
+               for f in files)
+    # stamp embeds the best validation error at write time
+    assert wf.snapshotter.destination in [str(tmp_path / f) for f in files]
+
+
+def test_snapshotter_resume_continues_training(tmp_path):
+    wf = build(tmp_path, max_epochs=2, snapshot=True)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    path = wf.snapshotter.destination
+    wf2 = Snapshotter.import_(path)
+    assert wf2.decision.epoch_number >= 1
+    # continue for 2 more epochs from the restored state
+    start_epoch = wf2.decision.epoch_number
+    wf2.decision.max_epochs = start_epoch + 2
+    wf2.decision.complete <<= False
+    wf2.initialize(device=NumpyDevice())
+    wf2.run()
+    assert wf2.decision.epoch_number == start_epoch + 2
+    # restored weights kept training (not re-initialized): error no worse
+    assert wf2.decision.best_validation_err <= wf.decision.best_validation_err
+
+
+def test_snapshotter_keep_last_prunes(tmp_path):
+    wf = build(tmp_path, max_epochs=4, snapshot=True)
+    wf.snapshotter.keep_last = 1
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    files = os.listdir(tmp_path)
+    assert len(files) == 1
+
+
+def test_snapshot_import_sniffs_codec(tmp_path):
+    wf = build(tmp_path, max_epochs=1, snapshot=True)
+    wf.snapshotter.compression = "xz"
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    path = wf.snapshotter.destination
+    assert path.endswith(".xz")
+    wf2 = Snapshotter.import_(path)
+    # snapshots fire at validation improvement (before the train pass ends),
+    # so the restored best error is set even when epoch_number is still 0
+    assert wf2.decision.best_validation_err is not None
+
+
+def test_snapshotter_fires_in_fused_mode(tmp_path):
+    """run_fused bypasses the pulse graph; snapshot gating must still
+    happen (with params written back first) on improved epochs."""
+    wf = build(tmp_path, max_epochs=2, snapshot=True)
+    wf.run_fused()
+    files = os.listdir(tmp_path)
+    assert files, "fused mode wrote no snapshots"
+    wf2 = Snapshotter.import_(wf.snapshotter.destination)
+    # momentum state went into the snapshot via the GD twins' velocity
+    # arrays, so a resumed fused run starts with optimizer state intact
+    assert any(np.abs(g.vel_w.mem).sum() > 0 for g in wf2.gds)
+    start = wf2.decision.epoch_number
+    wf2.decision.max_epochs = start + 1
+    wf2.decision.complete <<= False
+    wf2.run_fused()
+    assert wf2.decision.epoch_number == start + 1
+
+
+# ---------------------------------------------------------------------------
+# CLI / Launcher
+# ---------------------------------------------------------------------------
+
+
+def test_cli_runs_sample_with_overrides(tmp_path):
+    from veles_tpu.__main__ import main
+    from veles_tpu.config import root
+    wf_file = tmp_path / "wf.py"
+    wf_file.write_text(
+        "from veles_tpu.samples.mnist import run  # noqa\n")
+    cfg_file = tmp_path / "cfg.py"
+    cfg_file.write_text(
+        "from veles_tpu.config import root\n"
+        "root.mnist.loader.n_train = 200\n"
+        "root.mnist.loader.n_validation = 100\n")
+    code = main([str(wf_file), str(cfg_file),
+                 "root.mnist.decision.max_epochs=1",
+                 "root.mnist.loader.minibatch_size=50",
+                 "-b", "numpy", "-r", "42", "--no-stats"])
+    assert code == 0
+    assert root.mnist.decision.max_epochs == 1
+    assert root.mnist.loader.n_train == 200
+
+
+def test_launcher_snapshot_roundtrip(tmp_path):
+    from veles_tpu.launcher import Launcher
+    wf = build(tmp_path, max_epochs=1, snapshot=True)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    path = wf.snapshotter.destination
+    launcher = Launcher(snapshot=path, stats=False)
+    restored, loaded = launcher.load(lambda: None)
+    assert loaded is True
+    assert restored.decision.best_validation_err is not None
+
+
+# ---------------------------------------------------------------------------
+# Web status
+# ---------------------------------------------------------------------------
+
+
+def test_web_status_serves_workflow_json(tmp_path):
+    from veles_tpu.web_status import WebStatusServer, workflow_status
+    wf = build(tmp_path, max_epochs=1)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    status = workflow_status(wf)
+    assert status["epoch"] == 1
+    assert any(u["name"] == "repeater" for u in status["units"])
+
+    srv = WebStatusServer(wf, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status.json", timeout=5) as r:
+            remote = json.loads(r.read())
+        assert remote["epoch"] == 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/", timeout=5) as r:
+            assert b"veles_tpu" in r.read()
+    finally:
+        srv.stop()

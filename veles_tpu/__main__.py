@@ -1,0 +1,105 @@
+"""CLI entry: `python -m veles_tpu [flags] workflow.py [config.py] [root.x=y ...]`.
+
+Parity: reference `veles/__main__.py` (SURVEY.md §2.9) — imports the config
+module (which mutates the global `root`), applies trailing dotted-path
+overrides, builds a Launcher (standalone / coordinator `-l` / worker `-m`),
+imports the workflow module and calls its `run(load, main)`.
+
+Flags map 1:1 where the concept survives the TPU redesign; the reference's
+backend-selection flags become `--backend numpy|xla` (golden host path vs
+jit path), and master/slave become distributed coordinator/worker roles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+from veles_tpu import prng
+from veles_tpu.launcher import Launcher, apply_overrides
+from veles_tpu.logger import set_verbosity
+
+
+def _import_file(path: str, name: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise SystemExit(f"cannot import {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="Run a workflow: veles_tpu workflow.py [config.py] "
+                    "[root.path.key=value ...]")
+    p.add_argument("workflow", help="workflow module (.py) with run(load, main)")
+    p.add_argument("config", nargs="?", default="",
+                   help="config module (.py) mutating the global root")
+    p.add_argument("overrides", nargs="*", default=[],
+                   help="trailing root.a.b=value overrides")
+    p.add_argument("-s", "--snapshot", default="",
+                   help="resume from a snapshot file")
+    p.add_argument("-b", "--backend", default="xla",
+                   choices=("xla", "numpy"),
+                   help="compute backend (numpy = golden host path)")
+    p.add_argument("-r", "--random-seed", type=int, default=None,
+                   help="seed all PRNGs for a deterministic run")
+    p.add_argument("-l", "--listen", default="",
+                   help="distributed coordinator bind address host:port")
+    p.add_argument("-m", "--master", default="",
+                   help="join a distributed coordinator at host:port")
+    p.add_argument("--process-id", type=int, default=0,
+                   help="this process's index in the distributed job")
+    p.add_argument("--n-processes", type=int, default=1,
+                   help="total process count in the distributed job")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="-v info, -vv debug")
+    p.add_argument("--no-stats", action="store_true",
+                   help="skip the per-unit run-time table")
+    p.add_argument("-w", "--web-status", action="store_true",
+                   help="serve the status dashboard while running")
+    p.add_argument("--web-port", type=int, default=8090)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if "=" in args.config:
+        # `veles_tpu wf.py root.a.b=1` with config omitted: argparse binds
+        # the first override to the config positional — reroute it
+        args.overrides.insert(0, args.config)
+        args.config = ""
+    set_verbosity(args.verbose)
+    if args.random_seed is not None:
+        prng.seed_all(args.random_seed)
+
+    # Import order matters: the workflow module registers its root DEFAULTS
+    # at import time, so it must run before the config module and the CLI
+    # overrides or it would clobber them (reference §3.1: defaults live with
+    # the sample, config.py + trailing args win).
+    wf_path = os.path.abspath(args.workflow)
+    module = _import_file(wf_path, "veles_workflow")
+    if not hasattr(module, "run"):
+        raise SystemExit(f"{args.workflow} has no run(load, main) entry")
+    if args.config:
+        _import_file(args.config, "veles_config")
+    apply_overrides(args.overrides)
+
+    from veles_tpu.backends import make_device
+    device = make_device(args.backend)
+
+    launcher = Launcher(
+        snapshot=args.snapshot, listen=args.listen, master=args.master,
+        process_id=args.process_id, n_processes=args.n_processes,
+        device=device, stats=not args.no_stats,
+        web_status=args.web_status, web_port=args.web_port)
+    return launcher.run_module(module)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Live interaction: drop a REPL into a running workflow.
+
+Parity: reference `veles/interaction.py` (`Shell` unit) + the vendored
+manhole (SURVEY.md §2.5) — an IPython console embedded mid-graph so a
+researcher can poke at live weights between epochs. Here: a `Shell` unit
+that opens a stdlib `code.InteractiveConsole` (IPython if importable) with
+the workflow in scope, gated like any unit so it can be wired to fire once
+per epoch; non-interactive sessions (no tty) skip it.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+from veles_tpu.units import Unit
+
+
+class Shell(Unit):
+    """Interactive console over the live workflow. `ctx` adds extra names."""
+
+    def __init__(self, workflow=None, ctx: dict = None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.ctx = dict(ctx or {})
+        self.interactive_only = True
+
+    def run(self) -> None:
+        if self.interactive_only and not sys.stdin.isatty():
+            self.debug("no tty; skipping interactive shell")
+            return
+        ns = {"workflow": self.workflow, "shell": self}
+        ns.update(self.ctx)
+        banner = ("veles_tpu shell — `workflow` is the live workflow; "
+                  "Ctrl-D resumes the run")
+        try:
+            import IPython
+            IPython.embed(user_ns=ns, banner1=banner)
+        except ImportError:
+            import code
+            code.InteractiveConsole(ns).interact(banner=banner)

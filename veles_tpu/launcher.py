@@ -1,0 +1,126 @@
+"""Launcher: orchestration of a workflow run.
+
+Parity: reference `veles/launcher.py` (SURVEY.md §2.9) — mode selection
+(standalone / master / slave), workflow registration, lifecycle (initialize,
+run, shutdown, exit codes), auxiliary services (web status, graphics).
+
+TPU-first mapping of the reference's roles:
+- standalone  -> single-process run on the local device(s);
+- master (-l) -> distributed COORDINATOR (`jax.distributed.initialize`
+  process 0) — the reference's Twisted job server has no analog because
+  gradient averaging is an in-graph ICI all-reduce, not a host protocol;
+- slave (-m)  -> distributed WORKER process joining the coordinator.
+All processes run the same SPMD program; there is no per-unit job/update
+pickling (reference §3.2) to orchestrate.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Optional
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+from veles_tpu.snapshotter import Snapshotter
+
+
+class Launcher(Logger):
+    """Drives one workflow: load (or restore), initialize, run, report."""
+
+    def __init__(self, snapshot: str = "",
+                 listen: str = "", master: str = "",
+                 process_id: int = 0, n_processes: int = 1,
+                 device: Any = None, stats: bool = True,
+                 web_status: bool = False, web_port: int = 8090,
+                 **kwargs: Any) -> None:
+        super().__init__()
+        self.snapshot_path = snapshot
+        self.listen = listen            # coordinator address to bind
+        self.master = master            # coordinator address to join
+        self.process_id = process_id
+        self.n_processes = n_processes
+        self.device = device
+        self.show_stats = stats
+        self.web_status_enabled = web_status
+        self.web_port = web_port
+        self.workflow = None
+        self.snapshot_loaded = False
+        self._web = None
+
+    # -- distributed bootstrap ----------------------------------------------
+
+    @property
+    def mode(self) -> str:
+        if self.listen:
+            return "coordinator"
+        if self.master:
+            return "worker"
+        return "standalone"
+
+    def boot_distributed(self) -> None:
+        """Multi-host init over DCN (reference master/slave -> JAX
+        coordinator/worker; see parallel.distributed)."""
+        if self.mode == "standalone":
+            return
+        from veles_tpu.parallel.distributed import initialize_distributed
+        addr = self.listen or self.master
+        initialize_distributed(coordinator=addr,
+                               process_id=self.process_id,
+                               n_processes=self.n_processes)
+
+    # -- the reference's run(load, main) module convention --------------------
+
+    def load(self, workflow_factory: Callable, **kwargs: Any):
+        """Build the workflow, or restore it from `--snapshot`.
+        Returns (workflow, snapshot_was_loaded)."""
+        if self.snapshot_path:
+            self.info("restoring snapshot %s", self.snapshot_path)
+            self.workflow = Snapshotter.import_(self.snapshot_path)
+            self.snapshot_loaded = True
+        else:
+            self.workflow = workflow_factory(**kwargs)
+            self.snapshot_loaded = False
+        return self.workflow, self.snapshot_loaded
+
+    def main(self, **kwargs: Any) -> int:
+        """Initialize + run the loaded workflow; returns an exit code."""
+        if self.workflow is None:
+            raise RuntimeError("Launcher.main() before load()")
+        self.boot_distributed()
+        if self.web_status_enabled:
+            from veles_tpu.web_status import WebStatusServer
+            self._web = WebStatusServer(self.workflow, port=self.web_port)
+            self._web.start()
+        try:
+            self.workflow.initialize(device=self.device, **kwargs)
+            self.workflow.run()
+        except KeyboardInterrupt:
+            self.warning("interrupted; stopping workflow")
+            self.workflow.stop()
+            return 130
+        finally:
+            if self._web is not None:
+                self._web.stop()
+            if self.show_stats and hasattr(self.workflow, "print_stats"):
+                self.workflow.print_stats()
+        return 0
+
+    def run_module(self, module) -> int:
+        """Invoke a sample module's `run(load, main)` entry."""
+        status = {"code": 0}
+
+        def main(**kwargs: Any) -> None:
+            status["code"] = self.main(**kwargs)
+
+        module.run(self.load, main)
+        return status["code"]
+
+
+def apply_overrides(args) -> None:
+    """Apply trailing CLI `root.a.b=value` overrides to the global root."""
+    from veles_tpu.config import parse_override
+    for arg in args:
+        dotted, value = parse_override(arg)
+        if dotted.startswith("root."):
+            dotted = dotted[len("root."):]
+        root.override(dotted, value)

@@ -30,6 +30,12 @@ def setup_logging(level: int = logging.INFO, stream=None) -> None:
     _initialized = True
 
 
+def set_verbosity(count: int) -> None:
+    """CLI -v mapping: 0 -> warning, 1 -> info, 2+ -> debug."""
+    level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(count, 2)]
+    setup_logging(level)
+
+
 class Logger:
     """Mixin: `self.logger` is a child of the "veles" logger named after the
     concrete class (plus the instance's `name` attribute when present)."""

@@ -1,0 +1,114 @@
+"""Multi-host distributed bootstrap + scaling-efficiency harness.
+
+Parity: the reference's distributed layer is a Twisted TCP control plane +
+ZeroMQ pickle data plane doing asynchronous parameter-server averaging
+(reference `veles/server.py`/`veles/client.py`, SURVEY.md §2.4). The
+TPU-native replacement has NO hand-written transport: gradient averaging is
+a `psum` over ICI inside the compiled step (parallel/fused.py), and
+multi-host coordination is `jax.distributed.initialize` over DCN. What
+remains of master/slave is process-role bookkeeping, kept here so the
+Launcher's `-l`/`-m` flags behave like the reference's.
+
+Semantics change (documented, SURVEY.md §7 "hard parts"): the reference's
+updates were asynchronous/stale; this build is synchronous SPMD. Slave
+drop/rejoin becomes "restart the job from the last snapshot" — mid-step
+elasticity is meaningless when every step is a collective.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+_initialized = False
+
+
+def initialize_distributed(coordinator: str, process_id: int = 0,
+                           n_processes: int = 1) -> None:
+    """Join (or found, for process 0) a multi-host JAX job over DCN.
+    Maps the reference's master (-l) / slave (-m) to coordinator/worker:
+    every process runs the same SPMD program afterwards."""
+    global _initialized
+    if _initialized or n_processes <= 1:
+        return
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def is_coordinator() -> bool:
+    import jax
+    return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# scaling-efficiency harness (BASELINE.json north star: >=90% on v5e-64)
+# ---------------------------------------------------------------------------
+
+
+def measure_throughput(step_fn, state, batch_fn, *, warmup: int = 3,
+                       steps: int = 20) -> float:
+    """Samples/sec of `step_fn(state, x, y) -> (state, aux)` fed by
+    `batch_fn() -> (x, y)`. Blocks on the final state to close the async
+    dispatch pipeline."""
+    import jax
+
+    for _ in range(warmup):
+        x, y = batch_fn()
+        state, _ = step_fn(state, x, y)
+    jax.block_until_ready(state)
+    n_samples = 0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        x, y = batch_fn()
+        state, _ = step_fn(state, x, y)
+        n_samples += x.shape[0]
+    jax.block_until_ready(state)
+    return n_samples / (time.perf_counter() - t0)
+
+
+def scaling_efficiency(workflow, *, mesh_devices=None, batch_per_chip: int,
+                       warmup: int = 3, steps: int = 20) -> Dict[str, Any]:
+    """Weak-scaling harness: samples/sec/chip on 1 chip vs on the full mesh.
+
+    Honest-reporting contract (SURVEY.md §7): with a single local device the
+    result is trivially 100% and `measured_chips` says so — the number only
+    means something when run on a real multi-chip slice.
+    """
+    import jax
+    import numpy as np
+
+    from veles_tpu.parallel.mesh import make_mesh
+
+    devices = mesh_devices if mesh_devices is not None else jax.devices()
+    n = len(devices)
+
+    def bench_on(n_chips: int) -> float:
+        mesh = make_mesh(devices[:n_chips], data=n_chips)
+        step = workflow.build_fused_step(mesh=mesh)
+        state = step.init_state()
+        batch = n_chips * batch_per_chip
+        shape = workflow.loader.minibatch_data.shape[1:]
+        rng = np.random.RandomState(0)
+        x = rng.randn(batch, *shape).astype(np.float32)
+        y = rng.randint(0, workflow.n_classes, batch)
+
+        def batch_fn():
+            return x, y
+
+        return measure_throughput(step.train, state, batch_fn,
+                                  warmup=warmup, steps=steps)
+
+    per_chip_1 = bench_on(1)
+    per_chip_n = bench_on(n) / n if n > 1 else per_chip_1
+    eff = per_chip_n / per_chip_1 if per_chip_1 > 0 else 0.0
+    return {
+        "chips": n,
+        "measured_chips": n,
+        "samples_per_sec_per_chip_1": per_chip_1,
+        "samples_per_sec_per_chip_n": per_chip_n,
+        "scaling_efficiency": eff,
+        "trivial": n == 1,
+    }

@@ -75,6 +75,7 @@ class FusedTrainStep:
         gds = list(workflow.gds)
         n = len(self.forwards)
         self.cfgs: List[optim.SGDConfig] = []
+        self.gd_units = [gds[n - 1 - i] for i in range(n)]
         for i in range(n):
             g = gds[n - 1 - i]
             self.cfgs.append(optim.SGDConfig(
@@ -91,15 +92,8 @@ class FusedTrainStep:
                 mode = "gspmd"
             else:
                 mode = "dp"
-        if mode in ("dp", "gspmd"):
-            if mesh is None:
-                raise ValueError(f"mode={mode!r} requires a mesh")
-            mb = getattr(workflow.loader, "minibatch_size", None)
-            n_data = mesh.shape.get(DATA_AXIS, 1)
-            if mb is not None and mb % n_data:
-                raise ValueError(
-                    f"minibatch_size {mb} not divisible by the mesh data "
-                    f"axis ({n_data} shards)")
+        if mode in ("dp", "gspmd") and mesh is None:
+            raise ValueError(f"mode={mode!r} requires a mesh")
         self.mode = mode
         self.donate = donate
         self._train_fn = None
@@ -111,7 +105,22 @@ class FusedTrainStep:
         params = tuple(
             {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
             for u in self.forwards)
-        vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+        vel_keys = {"weights": "vel_w", "bias": "vel_b"}
+
+        def seed_vel(u, g, p):
+            # resume from the GD twin's velocity buffers when present
+            # (written by write_back / restored from a snapshot)
+            out = {}
+            for k, a in p.items():
+                varr = getattr(g, vel_keys.get(k, ""), None)
+                if varr is not None and varr:
+                    out[k] = jnp.asarray(varr.mem)
+                else:
+                    out[k] = jnp.zeros_like(a)
+            return out
+
+        vel = tuple(seed_vel(u, g, p) for u, g, p in
+                    zip(self.forwards, self.gd_units, params))
         state = {"params": params, "vel": vel,
                  "key": prng.get().next_key(),
                  "lr_scale": jnp.float32(1.0)}
@@ -121,10 +130,36 @@ class FusedTrainStep:
 
     def write_back(self, state: Dict[str, Any]) -> None:
         """Copy fused-state params back into the unit Arrays so granular
-        mode, snapshots and the C++ exporter see the trained weights."""
-        for u, p in zip(self.forwards, state["params"]):
+        mode, snapshots and the C++ exporter see the trained weights.
+
+        Tolerates donated-away buffers: if a step failed mid-dispatch the
+        state it consumed is already deleted — skip those arrays (the unit
+        Arrays keep their last written-back values) instead of raising a
+        secondary error that would mask the original one."""
+        vel_keys = {"weights": "vel_w", "bias": "vel_b"}
+        for u, g, p, v in zip(self.forwards, self.gd_units,
+                              state["params"], state["vel"]):
             for k, arr in u.param_arrays().items():
-                arr.reset(np.asarray(p[k]))
+                try:
+                    arr.reset(np.asarray(p[k]))
+                    # momentum velocities land in the GD twin so a snapshot
+                    # resumes with optimizer state intact (reference parity:
+                    # whole-workflow pickle includes optimizer state)
+                    if k in vel_keys and hasattr(g, vel_keys[k]):
+                        getattr(g, vel_keys[k]).reset(np.asarray(v[k]))
+                except RuntimeError:
+                    return  # donated/deleted state: nothing recoverable
+
+    def _check_batch(self, n: int) -> None:
+        """The actual fed batch must divide the data axis (checked per call
+        so callers that feed their own batches — e.g. the scaling harness —
+        are validated on what they actually feed, not the loader's size)."""
+        if self.mode in ("dp", "gspmd"):
+            n_data = self.mesh.shape.get(DATA_AXIS, 1)
+            if n % n_data:
+                raise ValueError(
+                    f"batch of {n} not divisible by the mesh data axis "
+                    f"({n_data} shards)")
 
     # -- forward chain -------------------------------------------------------
 
@@ -270,6 +305,7 @@ class FusedTrainStep:
         """One fused training step. Returns (new_state, (loss, n_err))."""
         if self._train_fn is None:
             self._build()
+        self._check_batch(np.shape(x)[0])
         new_state, loss, n_err = self._train_fn(state, jnp.asarray(x),
                                                 jnp.asarray(y))
         return new_state, (loss, n_err)
@@ -278,4 +314,5 @@ class FusedTrainStep:
         """Forward-only metrics (validation/test minibatches)."""
         if self._eval_fn is None:
             self._build()
+        self._check_batch(np.shape(x)[0])
         return self._eval_fn(state["params"], jnp.asarray(x), jnp.asarray(y))

@@ -11,9 +11,10 @@ TPU-first: NHWC layouts; training runs through the fused sharded step
 weights; data-parallel gradient all-reduce over the mesh "data" axis, and
 optional tensor parallelism over "model" for the wide FC layers.
 
-Data note: zero-egress environment — defaults to the deterministic
-synthetic ImageNet-shaped dataset; point `root.alexnet.loader.data_path`
-at an on-disk dataset for real runs.
+Data note: zero-egress environment — trains on the deterministic synthetic
+ImageNet-shaped dataset (loader/synthetic.py). For an on-disk image tree,
+build the workflow with an ImageDirectoryLoader (loader/image.py) instead
+of the synthetic loader.
 """
 
 from __future__ import annotations

@@ -1,0 +1,158 @@
+"""Snapshotter: checkpoint/resume service unit.
+
+Parity: reference `veles/snapshotter.py` (`Snapshotter`, SURVEY.md §2.5,
+§5.4) — a unit, gated by the Decision's `improved` Bool, that pickles the
+ENTIRE workflow object graph (topology + weights + optimizer state + RNG +
+epoch counters) with gzip/bz2/xz compression; filenames embed the current
+metric; `Snapshotter.import_()` / CLI `--snapshot` restores and training
+continues.
+
+TPU-first notes:
+- Device arrays are host-resident by pickle time: `Array.__getstate__`
+  maps device buffers back to numpy (the reference's exact trick), and
+  `Unit.__getstate__` drops jitted callables (rebuilt on initialize()).
+- A fused-step state pytree (`workflow.fused_state`) is written back into
+  the unit Arrays by `StandardWorkflow.run_fused` before snapshot time, so
+  both execution modes produce interchangeable snapshots.
+"""
+
+from __future__ import annotations
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import time
+from typing import Any, Optional
+
+from veles_tpu.units import Unit
+
+#: compression name -> (module opener, filename suffix)
+_CODECS = {
+    "": (open, ""),
+    "gz": (gzip.open, ".gz"),
+    "bz2": (bz2.open, ".bz2"),
+    "xz": (lzma.open, ".xz"),
+}
+
+
+def _open_codec(compression: str):
+    try:
+        return _CODECS[compression]
+    except KeyError:
+        raise ValueError(
+            f"unknown compression {compression!r}; one of {sorted(_CODECS)}")
+
+
+class SnapshotterBase(Unit):
+    """Common machinery: serialize `self.workflow` to a stamped file."""
+
+    def __init__(self, workflow=None, prefix: str = "wf",
+                 directory: str = ".", compression: str = "gz",
+                 interval: int = 1, time_interval: float = 0.0,
+                 keep_last: int = 0, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.prefix = prefix
+        self.directory = directory
+        self.compression = compression
+        #: fire every `interval`-th run (epoch), like the reference's skip
+        self.interval = interval
+        #: minimum seconds between snapshots (0 = no rate limit)
+        self.time_interval = time_interval
+        #: keep only the newest N snapshot files (0 = keep all)
+        self.keep_last = keep_last
+        self.suffix = ""            # metric stamp, set by the decision link
+        self.destination = ""       # last written path
+        self._skipped = 0
+        self._last_time = 0.0
+        self._written: list = []
+
+    # -- metric stamp --------------------------------------------------------
+
+    def stamp(self) -> str:
+        """Filename fragment embedding current metrics (reference behavior:
+        snapshot names carry the validation error)."""
+        return self.suffix or time.strftime("%Y%m%d_%H%M%S")
+
+    def link_decision(self, decision) -> "SnapshotterBase":
+        """Gate on `improved` and stamp filenames with the best validation
+        error (the reference StandardWorkflow wiring)."""
+        self.gate_skip = ~decision.improved
+        self._decision = decision
+        return self
+
+    # -- unit protocol -------------------------------------------------------
+
+    def initialize(self, **kwargs: Any):
+        os.makedirs(self.directory, exist_ok=True)
+        return super().initialize(**kwargs)
+
+    def run(self) -> None:
+        self._skipped += 1
+        if self._skipped < self.interval:
+            return
+        now = time.time()
+        if self.time_interval and now - self._last_time < self.time_interval:
+            return
+        self._skipped = 0
+        self._last_time = now
+        dec = getattr(self, "_decision", None)
+        if dec is not None and dec.best_validation_err is not None:
+            err = dec.best_validation_err
+            self.suffix = (f"{err:.6g}" if isinstance(err, float)
+                           else str(err))
+        self.destination = self.export()
+        self.info("snapshot -> %s", self.destination)
+        self._written.append(self.destination)
+        if self.keep_last:
+            while len(self._written) > self.keep_last:
+                stale = self._written.pop(0)
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+
+    def export(self) -> str:
+        raise NotImplementedError
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("_decision", None)  # re-linked by the owner on restore
+        return d
+
+
+class Snapshotter(SnapshotterBase):
+    """Pickle the whole owning workflow (compressed)."""
+
+    def export(self) -> str:
+        opener, ext = _open_codec(self.compression)
+        path = os.path.join(self.directory,
+                            f"{self.prefix}_{self.stamp()}.pickle{ext}")
+        wf = self.workflow
+        # never try to pickle ourselves mid-write via the workflow's
+        # unit list: Snapshotter state is tiny and picklable, so no
+        # special-casing needed — but a half-written file must not be
+        # importable, hence write-to-temp + atomic rename.
+        tmp = path + ".tmp"
+        with opener(tmp, "wb") as f:
+            pickle.dump(wf, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def import_(path: str):
+        """Restore a workflow from a snapshot file (any supported codec,
+        sniffed by magic bytes, so renamed files still load)."""
+        with open(path, "rb") as f:
+            head = f.read(6)
+        if head[:2] == b"\x1f\x8b":
+            opener = gzip.open
+        elif head[:3] == b"BZh":
+            opener = bz2.open
+        elif head[:6] == b"\xfd7zXZ\x00":
+            opener = lzma.open
+        else:
+            opener = open
+        with opener(path, "rb") as f:
+            return pickle.load(f)

@@ -1,0 +1,107 @@
+"""Web status dashboard: live workflow progress over HTTP.
+
+Parity: reference `veles/web_status.py` + `web/` (SURVEY.md §2.5) — a
+dashboard showing the running workflow, per-unit progress, and (in
+distributed mode) cluster membership. The reference used Tornado + a JS
+frontend; here a stdlib `http.server` on a daemon thread serves a
+self-contained page that polls a JSON endpoint — no extra dependency, same
+information.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>veles_tpu status</title><style>
+body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+table{border-collapse:collapse}td,th{padding:.3em .8em;border:1px solid #444}
+th{text-align:left;background:#222}h1{font-size:1.2em}
+</style></head><body>
+<h1>veles_tpu — workflow status</h1>
+<div id="meta"></div>
+<table id="units"><thead><tr><th>unit</th><th>runs</th><th>time (s)</th>
+</tr></thead><tbody></tbody></table>
+<script>
+async function tick(){
+  const r = await fetch('/status.json'); const s = await r.json();
+  document.getElementById('meta').textContent =
+    `workflow: ${s.workflow}  stopped: ${s.stopped}  ` +
+    (s.epoch != null ? `epoch: ${s.epoch}  best_err: ${s.best_err}` : '');
+  const tb = document.querySelector('#units tbody'); tb.innerHTML = '';
+  for (const u of s.units){
+    const tr = document.createElement('tr');
+    tr.innerHTML = `<td>${u.name}</td><td>${u.runs}</td>` +
+                   `<td>${u.time.toFixed(3)}</td>`;
+    tb.appendChild(tr);
+  }
+}
+setInterval(tick, 1000); tick();
+</script></body></html>"""
+
+
+def workflow_status(workflow) -> Dict[str, Any]:
+    """The JSON the dashboard (and tests) read."""
+    status: Dict[str, Any] = {
+        "workflow": getattr(workflow, "name", type(workflow).__name__),
+        "stopped": bool(getattr(workflow, "stopped", False)),
+        "epoch": None,
+        "best_err": None,
+        "units": [
+            {"name": u.name, "runs": u.run_count,
+             "time": round(u.run_time, 6)}
+            for u in getattr(workflow, "units", [])
+        ],
+    }
+    decision = getattr(workflow, "decision", None)
+    if decision is not None:
+        status["epoch"] = decision.epoch_number
+        status["best_err"] = decision.best_validation_err
+    return status
+
+
+class WebStatusServer:
+    """Serve `/` (dashboard page) and `/status.json` on a daemon thread."""
+
+    def __init__(self, workflow, host: str = "127.0.0.1",
+                 port: int = 8090) -> None:
+        self.workflow = workflow
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        wf = self.workflow
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path.startswith("/status.json"):
+                    body = json.dumps(workflow_status(wf)).encode()
+                    ctype = "application/json"
+                else:
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass  # keep the training log clean
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="web-status")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -55,6 +55,7 @@ class StandardWorkflow(Workflow):
                  n_classes: int = 10,
                  decision_config: Optional[Dict[str, Any]] = None,
                  gd_config: Optional[Dict[str, Any]] = None,
+                 snapshot_config: Optional[Dict[str, Any]] = None,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.layers_config = list(layers)
@@ -116,6 +117,13 @@ class StandardWorkflow(Workflow):
             self.gds.append(g)
             err_src, err_attr = g, "err_input"
 
+        # -- snapshotter (optional; gated on validation improvement) ---------
+        self.snapshotter = None
+        if snapshot_config is not None:
+            from veles_tpu.snapshotter import Snapshotter
+            self.snapshotter = Snapshotter(self, **snapshot_config)
+            # gating (link_decision) happens in _wire_gates below
+
         # -- control wiring --------------------------------------------------
         # start → repeater → loader → fwds → evaluator → decision → gds
         #   … last gd → repeater (loop); decision → end_point when complete
@@ -133,6 +141,8 @@ class StandardWorkflow(Workflow):
             prev_u = g
         self.repeater.link_from(prev_u)
         self.end_point.link_from(self.decision)
+        if self.snapshotter is not None:
+            self.snapshotter.link_from(self.decision)
         self._wire_gates()
 
     def _wire_gates(self) -> None:
@@ -148,6 +158,8 @@ class StandardWorkflow(Workflow):
         self.end_point.gate_block = ~self.decision.complete
         # once complete, the loop-back pulse must die at the repeater
         self.repeater.gate_block = self.decision.complete
+        if self.snapshotter is not None:
+            self.snapshotter.link_decision(self.decision)
 
     # -- conveniences --------------------------------------------------------
 
@@ -220,6 +232,12 @@ class StandardWorkflow(Workflow):
                     ev.loss = 0.0
                     ev.n_err = 0
                 dec.run()
+                # fused mode bypasses the pulse graph, so the snapshot
+                # gating is applied here by hand: same improved-gated
+                # behavior as granular mode (run_fused's contract)
+                if self.snapshotter is not None and bool(dec.improved):
+                    step.write_back(state)
+                    self.snapshotter.run()
         finally:
             loader.on_device = prev_on_device
             step.write_back(state)
