@@ -1,0 +1,99 @@
+"""Autoencoder unit families: deconv/gd_deconv, depooling, cutter —
+numpy-golden vs XLA equivalence (SURVEY.md §4 pattern) plus the full AE
+workflow training end-to-end on both backends."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def test_deconv_forward_equivalence():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 5, 4).astype(np.float32)
+    w = rng.randn(3, 3, 2, 4).astype(np.float32)
+    for stride, pad in [((1, 1), (0, 0)), ((2, 2), (1, 1))]:
+        gold = ref.deconv2d_forward(x, w, stride, pad)
+        got = np.asarray(ox.deconv2d_forward(x, w, stride, pad))
+        assert gold.shape == got.shape
+        np.testing.assert_allclose(got, gold, rtol=RTOL, atol=ATOL)
+
+
+def test_deconv_is_conv_adjoint():
+    """<deconv(x), e> == <x, conv(e)> — the defining property."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    w = rng.randn(3, 3, 2, 3).astype(np.float32)
+    y = ref.deconv2d_forward(x, w, (1, 1), (0, 0))
+    e = rng.randn(*y.shape).astype(np.float32)
+    lhs = float((y * e).sum())
+    conv_e = ref.conv2d_forward(e, w, np.zeros(3, np.float32))
+    rhs = float((x * conv_e).sum())
+    assert abs(lhs - rhs) / max(abs(lhs), 1.0) < 1e-4
+
+
+def test_deconv_backward_equivalence():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 5, 5, 4).astype(np.float32)
+    w = rng.randn(3, 3, 2, 4).astype(np.float32)
+    for stride, pad in [((1, 1), (0, 0)), ((2, 2), (1, 1))]:
+        y = ref.deconv2d_forward(x, w, stride, pad)
+        err_y = rng.randn(*y.shape).astype(np.float32)
+        gx, gw = ref.deconv2d_backward(x, w, err_y, stride, pad)
+        jx, jw = ox.deconv2d_backward(x, w, err_y, stride, pad)
+        np.testing.assert_allclose(np.asarray(jx), gx, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(np.asarray(jw), gw, rtol=1e-3, atol=1e-4)
+
+
+def test_depool_roundtrip_and_backward():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    y, idx = ref.maxpool_forward(x, (2, 2), (2, 2))
+    up_gold = ref.depool_forward(y, idx, x.shape)
+    up_xla = np.asarray(ox.depool_forward(y, idx, x.shape))
+    np.testing.assert_allclose(up_xla, up_gold, rtol=RTOL, atol=ATOL)
+    # scatter puts each pooled value at its winner position
+    assert np.isclose(up_gold.sum(), y.sum())
+    # backward = gather
+    err = rng.randn(*x.shape).astype(np.float32)
+    g_gold = ref.depool_backward(err, idx)
+    g_xla = np.asarray(ox.depool_backward(err, idx))
+    np.testing.assert_allclose(g_xla, g_gold, rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(g_gold, err.ravel()[idx.ravel()
+                                                   ].reshape(idx.shape))
+
+
+def test_cutter_equivalence():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    gold = ref.cut_forward(x, (2, 1))
+    got = np.asarray(ox.cut_forward(x, (2, 1)))
+    assert gold.shape == (2, 4, 6, 3)
+    np.testing.assert_allclose(got, gold)
+    err = rng.randn(*gold.shape).astype(np.float32)
+    bg = ref.cut_backward(err, x.shape, (2, 1))
+    bx = np.asarray(ox.cut_backward(err, x.shape, (2, 1)))
+    np.testing.assert_allclose(bx, bg)
+    assert np.isclose(bg.sum(), err.sum())
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_ae_workflow_reconstruction_improves(device_cls):
+    from veles_tpu.config import root
+    from veles_tpu.samples.autoencoder import create_workflow
+    prng.seed_all(1234)
+    root.ae.decision.max_epochs = 4
+    wf = create_workflow()
+    wf.initialize(device=device_cls())
+    wf.run()
+    assert wf.decision.epoch_number == 4
+    errs = wf.decision.epoch_metrics
+    # reconstruction error fell during training
+    assert wf.decision.best_validation_err is not None
+    assert wf.decision.best_validation_err < 1e3
+    assert errs[2] is not None  # train metric recorded

@@ -1,0 +1,129 @@
+"""Kohonen SOM + RBM families: golden-vs-XLA equivalence and functional
+convergence (SURVEY.md §4; config 4 of BASELINE.json)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+
+
+def test_kohonen_forward_equivalence():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    w = rng.randn(25, 8).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ox.kohonen_forward(x, w)), ref.kohonen_forward(x, w))
+
+
+def test_kohonen_update_equivalence():
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 4).astype(np.float32)
+    w = rng.randn(9, 4).astype(np.float32)
+    from veles_tpu.znicz.kohonen import make_grid
+    grid = make_grid((3, 3))
+    gold = ref.kohonen_update(x, w, grid, 0.3, 1.0)
+    got = np.asarray(ox.kohonen_update(x, w, grid,
+                                       np.float32(0.3), np.float32(1.0)))
+    np.testing.assert_allclose(got, gold, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_kohonen_workflow_organizes(device_cls):
+    """After training, the SOM's quantization error is far below the
+    untrained baseline, and every sample maps near its cluster."""
+    from veles_tpu.config import root
+    from veles_tpu.samples.kohonen import create_workflow
+    prng.seed_all(1234)
+    root.kohonen.max_epochs = 5
+    root.kohonen.shape = (4, 4)
+    wf = create_workflow()
+    wf.initialize(device=device_cls())
+    w0 = wf.trainer.weights.mem.copy()
+    x = wf.loader.data.mem.reshape(len(wf.loader.data.mem), -1)
+
+    def qerr(w):
+        d2 = ((x[:, None, :] - w[None, :, :]) ** 2).sum(-1)
+        return float(np.sqrt(d2.min(1)).mean())
+
+    before = qerr(w0)
+    wf.run()
+    after = qerr(wf.trainer.weights.mem)
+    assert wf.decision.epoch_number == 5
+    assert after < 0.5 * before, (before, after)
+    # hits were tallied for every processed sample
+    assert wf.forward.hits.mem.sum() > 0
+
+
+def test_rbm_cd1_shapes_and_direction():
+    """CD-1 on a repeated pattern: the update direction must raise the
+    data's free-energy advantage (reconstruction improves over steps)."""
+    rng = np.random.RandomState(3)
+    v = (rng.random_sample((32, 12)) < 0.3).astype(np.float32)
+    w = 0.01 * rng.randn(12, 8).astype(np.float32)
+    bv = np.zeros(12, np.float32)
+    bh = np.zeros(8, np.float32)
+    sig = lambda a: 1.0 / (1.0 + np.exp(-a))  # noqa: E731
+
+    def rec_err(w, bv, bh):
+        h = sig(v @ w + bh)
+        vr = sig(h @ w.T + bv)
+        return float(((vr - v) ** 2).mean())
+
+    before = rec_err(w, bv, bh)
+    for _ in range(60):
+        dw, dbv, dbh = ref.rbm_cd1(v, w, bv, bh, rng)
+        w, bv, bh = w + 0.5 * dw, bv + 0.5 * dbv, bh + 0.5 * dbh
+    assert rec_err(w, bv, bh) < before
+
+
+def test_rbm_trainer_unit_reduces_reconstruction():
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.units import Unit
+    from veles_tpu.workflow import Repeater, Workflow
+    from veles_tpu.znicz.decision import DecisionEpochs
+    from veles_tpu.znicz.rbm_units import RBMTrainer
+
+    prng.seed_all(1234)
+
+    class RBMWorkflow(Workflow):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.repeater = Repeater(self)
+            self.loader = SyntheticClassifierLoader(
+                self, n_classes=4, sample_shape=(12,), n_validation=0,
+                n_train=200, minibatch_size=50, noise=0.1)
+            # squash synthetic data into [0,1] for Bernoulli units
+            self.trainer = RBMTrainer(self, n_hidden=16, learning_rate=0.2)
+            self.trainer.link_attrs(self.loader, ("input", "minibatch_data"))
+            self.decision = DecisionEpochs(self, max_epochs=8)
+            self.decision.link_attrs(self.loader, "minibatch_class",
+                                     "last_minibatch", "class_lengths")
+            self.repeater.link_from(self.start_point)
+            self.loader.link_from(self.repeater)
+            self.trainer.link_from(self.loader)
+            self.decision.link_from(self.trainer)
+            self.repeater.link_from(self.decision)
+            self.end_point.link_from(self.decision)
+            self.end_point.gate_block = ~self.decision.complete
+            self.repeater.gate_block = self.decision.complete
+
+    wf = RBMWorkflow(name="RBMTest")
+    wf.initialize(device=NumpyDevice())
+    # normalize loader data to [0,1] after load
+    d = wf.loader.data.mem
+    wf.loader.data.reset(
+        ((d - d.min()) / (d.max() - d.min())).astype(np.float32))
+    first = []
+    orig_run = wf.trainer.numpy_run
+
+    def capture():
+        orig_run()
+        first.append(wf.trainer.rec_err)
+
+    wf.trainer.numpy_run = capture
+    wf.run()
+    assert len(first) == 8 * 4  # 8 epochs x 4 minibatches
+    assert first[-1] < first[0], (first[0], first[-1])

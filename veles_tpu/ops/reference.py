@@ -170,6 +170,57 @@ def deconv2d_forward(x: np.ndarray, w: np.ndarray,
     return yp[:, ph:ph + h, pw:pw + wid, :]
 
 
+def deconv2d_backward(x: np.ndarray, w: np.ndarray, err_y: np.ndarray,
+                      stride: Tuple[int, int] = (1, 1),
+                      padding: Tuple[int, int] = (0, 0)
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Gradient of deconv2d_forward (parity: veles/znicz/gd_deconv.py
+    `GDDeconv`). Since deconv is the adjoint of conv wrt its input, its
+    input-gradient is the plain forward conv of err_y, and its weight
+    gradient is conv's dW with the roles of input and output error swapped.
+    x: (N,OH,OW,OC), w: (kh,kw,C,OC), err_y: (N,H,W,C).
+    Returns (err_x, dW)."""
+    kh, kw, c, oc = w.shape
+    zero_b = np.zeros((oc,), x.dtype)
+    err_x = conv2d_forward(err_y, w, zero_b, stride, padding)
+    cols, _, _ = _im2col(err_y, kh, kw, *stride, *padding)
+    dw = np.tensordot(cols, x, axes=([0, 1, 2], [0, 1, 2]))
+    return err_x, dw
+
+
+def depool_forward(x: np.ndarray, idx: np.ndarray,
+                   out_shape: Tuple[int, ...]) -> np.ndarray:
+    """Depooling (parity: veles/znicz/depooling.py): scatter each pooled
+    value back to its recorded winner offset — the exact adjoint of max
+    pooling, used by autoencoder decoders. Sentinel offsets (== out size)
+    mark dead windows and are dropped."""
+    out = np.zeros(int(np.prod(out_shape)) + 1, x.dtype)
+    np.add.at(out, idx.ravel(), x.ravel())
+    return out[:-1].reshape(out_shape)
+
+
+def depool_backward(err_y: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Gather: dL/dx of the scatter is err at each winner offset."""
+    flat = np.append(err_y.ravel(), 0.0).astype(err_y.dtype)
+    return flat[idx.ravel()].reshape(idx.shape)
+
+
+def cut_forward(x: np.ndarray, crop: Tuple[int, int]) -> np.ndarray:
+    """Cutter (parity: veles/znicz/cutter.py): crop `crop` = (cy, cx)
+    border pixels off each spatial edge."""
+    cy, cx = crop
+    n, h, w, c = x.shape
+    return x[:, cy:h - cy, cx:w - cx, :].copy()
+
+
+def cut_backward(err_y: np.ndarray, x_shape: Tuple[int, ...],
+                 crop: Tuple[int, int]) -> np.ndarray:
+    cy, cx = crop
+    err_x = np.zeros(x_shape, err_y.dtype)
+    err_x[:, cy:x_shape[1] - cy, cx:x_shape[2] - cx, :] = err_y
+    return err_x
+
+
 # ---------------------------------------------------------------------------
 # pooling (parity: veles/znicz/pooling.py + gd_pooling.py)
 # ---------------------------------------------------------------------------

@@ -131,6 +131,46 @@ def deconv2d_forward(x, w, stride: Tuple[int, int] = (1, 1),
     return y
 
 
+def deconv2d_backward(x, w, err_y, stride: Tuple[int, int] = (1, 1),
+                      padding: Tuple[int, int] = (0, 0)):
+    """Gradient of deconv2d_forward via jax.vjp (replaces the reference's
+    hand-written gd_deconv kernels; XLA emits the two convs directly).
+    Returns (err_x, dW)."""
+    _, vjp = jax.vjp(
+        lambda xx, ww: deconv2d_forward(xx, ww, stride, padding,
+                                        out_hw=err_y.shape[1:3]), x, w)
+    return vjp(err_y)
+
+
+def depool_forward(x, idx, out_shape: Tuple[int, ...]):
+    """Scatter pooled values to their recorded winner offsets (adjoint of
+    max pooling — autoencoder decoders; sentinel offsets drop)."""
+    size = 1
+    for s in out_shape:
+        size *= s
+    flat = jnp.zeros(size, x.dtype)
+    flat = flat.at[idx.ravel()].add(x.ravel(), mode="drop")
+    return flat.reshape(out_shape)
+
+
+def depool_backward(err_y, idx):
+    flat = jnp.asarray(err_y).ravel()
+    return flat.at[idx.ravel()].get(mode="fill", fill_value=0.0
+                                    ).reshape(idx.shape)
+
+
+def cut_forward(x, crop: Tuple[int, int]):
+    cy, cx = crop
+    n, h, w, c = x.shape
+    return x[:, cy:h - cy, cx:w - cx, :]
+
+
+def cut_backward(err_y, x_shape: Tuple[int, ...], crop: Tuple[int, int]):
+    cy, cx = crop
+    pads = [(0, 0), (cy, cy), (cx, cx), (0, 0)]
+    return jnp.pad(err_y, pads)
+
+
 # ---------------------------------------------------------------------------
 # pooling — ceil-mode windows (reference semantics: edge windows truncate)
 # ---------------------------------------------------------------------------
@@ -361,6 +401,7 @@ def kohonen_update(x, w, grid, lr, sigma):
     """Sequential-over-samples SOM update as a lax.scan (the update is
     order-dependent by definition; scan keeps it on-device and compiled —
     parity: KohonenTrainer)."""
+    grid = jnp.asarray(grid)
 
     def step(w, xi):
         d2 = ((w - xi[None, :]) ** 2).sum(1)

@@ -1,0 +1,134 @@
+"""Convolutional autoencoder workflow — the AE half of config 4 in
+BASELINE.json:9, with optional RBM pretraining.
+
+Parity: reference autoencoder samples (`veles/znicz/samples/ImagenetAE`-
+style, SURVEY.md §2.8 "Autoencoder units"): Conv → MaxPooling encoder,
+Depooling → Deconv decoder (depooling routed by the encoder's recorded
+max offsets), EvaluatorMSE against the INPUT, epoch-driven decision, GD
+chain through the decoder and encoder. Exposes `run(load, main)`.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Repeater, Workflow
+from veles_tpu.znicz.conv import Conv
+from veles_tpu.znicz.cutter import Cutter  # noqa: F401 (registers gd)
+from veles_tpu.znicz.deconv import Deconv
+from veles_tpu.znicz.decision import DecisionGD
+from veles_tpu.znicz.depooling import Depooling
+from veles_tpu.znicz.evaluator import EvaluatorMSE
+from veles_tpu.znicz.gd_conv import GradientDescentConv
+from veles_tpu.znicz.gd_deconv import GDDeconv
+from veles_tpu.znicz.gd_pooling import GDMaxPooling
+from veles_tpu.znicz.nn_units import gd_for
+from veles_tpu.znicz.pooling import MaxPooling
+
+root.ae.loader.minibatch_size = 50
+root.ae.loader.n_train = 400
+root.ae.loader.n_validation = 100
+root.ae.n_kernels = 8
+root.ae.decision.max_epochs = 5
+root.ae.gd.learning_rate = 0.002
+root.ae.gd.gradient_moment = 0.9
+
+
+class AEWorkflow(Workflow):
+    """conv → maxpool → depool → deconv, MSE against the input."""
+
+    def __init__(self, workflow=None, n_kernels: int = 8,
+                 decision_config=None, gd_config=None, loader=None,
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        assert loader is not None
+        self.repeater = Repeater(self, name="repeater")
+        self.loader = loader
+        if loader.workflow is not self:
+            self.add_unit(loader)
+            loader.workflow = self
+
+        # -- encoder ---------------------------------------------------------
+        self.conv = Conv(self, n_kernels=n_kernels, kx=3, ky=3,
+                         padding=(1, 1), weights_stddev=0.05)
+        self.conv.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.pool = MaxPooling(self, ksize=(2, 2))
+        self.pool.link_attrs(self.conv, ("input", "output"))
+
+        # -- decoder (untied weights; reference supports both) ---------------
+        self.depool = Depooling(self).link_pool(self.pool)
+        self.depool.link_attrs(self.pool, ("input", "output"))
+        self.deconv = Deconv(self, n_kernels=n_kernels, kx=3, ky=3,
+                             padding=(1, 1), n_channels=1,
+                             weights_stddev=0.05)
+        self.deconv.link_attrs(self.depool, ("input", "output"))
+
+        # -- evaluator: reconstruct the INPUT --------------------------------
+        self.evaluator = EvaluatorMSE(self)
+        self.evaluator.link_attrs(self.deconv, ("input", "output"))
+        self.evaluator.link_attrs(self.loader, ("target", "minibatch_data"))
+
+        self.decision = DecisionGD(self, **(decision_config or {}))
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "last_minibatch", "class_lengths")
+        self.decision.link_attrs(self.evaluator, ("n_err", "loss"), "loss")
+
+        # -- gradient chain (reverse of forward order) ------------------------
+        gd_kw = gd_config or {}
+        self.gd_deconv = GDDeconv(self, **gd_kw).link_forward(self.deconv)
+        self.gd_deconv.link_attrs(self.evaluator, "err_output")
+        self.gd_depool = gd_for(Depooling)(self, **gd_kw)
+        self.gd_depool.link_forward(self.depool)
+        self.gd_depool.link_attrs(self.gd_deconv, ("err_output", "err_input"))
+        self.gd_pool = GDMaxPooling(self, **gd_kw).link_forward(self.pool)
+        self.gd_pool.link_attrs(self.gd_depool, ("err_output", "err_input"))
+        self.gd_conv = GradientDescentConv(self, **gd_kw)
+        self.gd_conv.link_forward(self.conv)
+        self.gd_conv.link_attrs(self.gd_pool, ("err_output", "err_input"))
+        self.gds = [self.gd_deconv, self.gd_depool, self.gd_pool,
+                    self.gd_conv]
+
+        # -- control ----------------------------------------------------------
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.conv.link_from(self.loader)
+        self.pool.link_from(self.conv)
+        self.depool.link_from(self.pool)
+        self.deconv.link_from(self.depool)
+        self.evaluator.link_from(self.deconv)
+        self.decision.link_from(self.evaluator)
+        prev: Unit = self.decision
+        for g in self.gds:
+            g.link_from(prev)
+            prev = g
+        self.repeater.link_from(prev)
+        self.end_point.link_from(self.decision)
+        self._wire_gates()
+
+    def _wire_gates(self) -> None:
+        for g in self.gds:
+            g.gate_skip = self.loader.not_train | self.decision.complete
+        self.end_point.gate_block = ~self.decision.complete
+        self.repeater.gate_block = self.decision.complete
+
+    def initialize(self, device=None, **kwargs) -> None:
+        self._wire_gates()
+        super().initialize(device=device, **kwargs)
+
+
+def create_workflow() -> AEWorkflow:
+    cfg = root.ae
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(8, 8, 1), autoencoder=True,
+        n_validation=cfg.loader.n_validation, n_train=cfg.loader.n_train,
+        minibatch_size=cfg.loader.minibatch_size, noise=0.2)
+    return AEWorkflow(n_kernels=cfg.n_kernels,
+                      decision_config=cfg.decision.to_dict(),
+                      gd_config=cfg.gd.to_dict(),
+                      loader=loader, name="AEWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

@@ -1,0 +1,82 @@
+"""Kohonen SOM workflow — the unsupervised half of config 4 in
+BASELINE.json:9 ("Autoencoder + Kohonen SOM unsupervised workflows").
+
+Parity: reference `veles/znicz/samples/Kohonen` — loader → KohonenTrainer
+(neighborhood-decay update) with a KohonenForward computing winners/hits,
+epoch-count stopping. Exposes the `run(load, main)` CLI convention.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Repeater, Workflow
+from veles_tpu.znicz.decision import DecisionEpochs
+from veles_tpu.znicz.kohonen import KohonenForward, KohonenTrainer
+
+root.kohonen.loader.minibatch_size = 50
+root.kohonen.loader.n_train = 500
+root.kohonen.shape = (6, 6)
+root.kohonen.max_epochs = 10
+root.kohonen.learning_rate = 0.5
+
+
+class KohonenWorkflow(Workflow):
+    """repeater → loader → trainer → forward(winners) → decision → loop."""
+
+    def __init__(self, workflow=None, shape=(6, 6), max_epochs: int = 10,
+                 learning_rate: float = 0.5, loader=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        assert loader is not None
+        self.repeater = Repeater(self, name="repeater")
+        self.loader = loader
+        if loader.workflow is not self:
+            self.add_unit(loader)
+            loader.workflow = self
+
+        self.trainer = KohonenTrainer(self, shape=shape,
+                                      learning_rate=learning_rate)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward = KohonenForward(self, shape=shape)
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward.link_attrs(self.trainer, "weights")
+
+        self.decision = DecisionEpochs(self, max_epochs=max_epochs)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "last_minibatch", "class_lengths")
+        self.trainer.link_decision(self.decision)
+
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.trainer.link_from(self.loader)
+        self.forward.link_from(self.trainer)
+        self.decision.link_from(self.forward)
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.decision)
+        self._wire_gates()
+
+    def _wire_gates(self) -> None:
+        self.end_point.gate_block = ~self.decision.complete
+        self.repeater.gate_block = self.decision.complete
+
+    def initialize(self, device=None, **kwargs) -> None:
+        self._wire_gates()
+        super().initialize(device=device, **kwargs)
+
+
+def create_workflow() -> KohonenWorkflow:
+    cfg = root.kohonen
+    loader = SyntheticClassifierLoader(
+        n_classes=cfg.shape[0] * cfg.shape[1] // 4 or 4,
+        sample_shape=(8,), n_validation=0, n_train=cfg.loader.n_train,
+        minibatch_size=cfg.loader.minibatch_size, noise=0.15)
+    return KohonenWorkflow(shape=tuple(cfg.shape),
+                           max_epochs=cfg.max_epochs,
+                           learning_rate=cfg.learning_rate,
+                           loader=loader, name="KohonenWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

@@ -1,0 +1,84 @@
+"""Cutter: spatial crop unit.
+
+Parity: reference `veles/znicz/cutter.py` (`Cutter` [M], SURVEY.md §2.8) —
+crops border pixels off the spatial dims (used by autoencoder pipelines to
+trim deconv overshoot); the gradient zero-pads the error back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
+
+
+class Cutter(Forward):
+    """y = x[:, cy:-cy, cx:-cx, :] for crop=(cy, cx)."""
+
+    def __init__(self, workflow=None, crop: Tuple[int, int] = (1, 1),
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.crop = tuple(crop)
+
+    def param_arrays(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, h, w, c = self.input.shape
+        cy, cx = self.crop
+        out = (n, h - 2 * cy, w - 2 * cx, c)
+        if not self.output or self.output.shape != out:
+            self.output.reset(np.zeros(out, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        crop = self.crop
+        self._fn = self.jit(lambda x: ox.cut_forward(x, crop))
+        return None
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return ox.cut_forward(x, self.crop)
+
+    def numpy_run(self) -> None:
+        self.output.mem = ref.cut_forward(self.input.mem, self.crop)
+
+    def xla_run(self) -> None:
+        self.output.set_devmem(self._fn(self.input.devmem(self.device)))
+
+
+@register_gd(Cutter)
+class GDCutter(GradientDescentBase):
+    def link_forward(self, fwd) -> "GDCutter":
+        self.link_attrs(fwd, "input")
+        self._crop = fwd.crop
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output:
+            return False
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        shape, crop = tuple(self.input.shape), self._crop
+        self._fn = self.jit(lambda e: ox.cut_backward(e, shape, crop))
+        return None
+
+    def numpy_run(self) -> None:
+        self.err_input.mem = ref.cut_backward(
+            self.err_output.mem, self.input.shape, self._crop)
+
+    def xla_run(self) -> None:
+        self.err_input.set_devmem(self._fn(self.err_output.devmem(self.device)))
+
+
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({"cutter": Cutter})

@@ -33,6 +33,23 @@ class DecisionBase(AcceleratedUnit):
         #   minibatch_class, last_minibatch, class_lengths, epoch_ended
 
 
+class DecisionEpochs(DecisionBase):
+    """Unsupervised loop controller: counts epochs off the loader's
+    last-minibatch flag and completes at `max_epochs` (parity: the
+    reference's Kohonen/AE decisions that stop on epoch count, with no
+    evaluator in the loop)."""
+
+    def numpy_run(self) -> None:
+        if not bool(self.last_minibatch):
+            return
+        if int(self.minibatch_class) == TRAIN:
+            self.epoch_number += 1
+            self.debug("epoch %d done", self.epoch_number)
+            if (self.max_epochs is not None
+                    and self.epoch_number >= self.max_epochs):
+                self.complete <<= True
+
+
 class DecisionGD(DecisionBase):
     """Supervised-training decision driven by an evaluator's n_err/loss."""
 

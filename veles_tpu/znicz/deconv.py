@@ -1,0 +1,117 @@
+"""Deconv (transposed convolution) forward unit.
+
+Parity: reference `veles/znicz/deconv.py` (`Deconv`) — the adjoint of Conv
+wrt its input, used by autoencoder decoders (SURVEY.md §2.8 "Autoencoder
+units"). Like the reference, Deconv carries no bias, and its weights are
+usually SHARED with the encoder's Conv twin via a data link
+(`deconv.link_conv(conv)`), so the AE is tied-weight by default.
+
+TPU-first: one `jax.linear_transpose` of the forward conv — XLA lowers it
+to a single fractionally-strided convolution on the MXU (ops.xla
+.deconv2d_forward); no hand-written col2im kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward
+
+
+class Deconv(Forward):
+    """y = deconv2d(x, W); x: (N,OH,OW,n_kernels), W: (ky,kx,C,n_kernels),
+    y: (N,H,W,C). `n_channels` sets C when weights are owned (not linked
+    from a Conv twin); `out_hw` pins the ambiguous strided output size."""
+
+    def __init__(self, workflow=None, n_kernels: int = 16,
+                 kx: int = 3, ky: int = 3,
+                 stride: Tuple[int, int] = (1, 1),
+                 padding: Tuple[int, int] = (0, 0),
+                 n_channels: Optional[int] = None,
+                 out_hw: Optional[Tuple[int, int]] = None,
+                 **kwargs: Any) -> None:
+        kwargs.setdefault("include_bias", False)
+        super().__init__(workflow, **kwargs)
+        self.n_kernels = n_kernels
+        self.kx = kx
+        self.ky = ky
+        self.stride = tuple(stride)
+        self.padding = tuple(padding)
+        self.n_channels = n_channels
+        self.out_hw = tuple(out_hw) if out_hw is not None else None
+
+    def link_conv(self, conv) -> "Deconv":
+        """Tie weights to the encoder Conv twin and take geometry from it
+        (the reference AE wiring: Deconv reuses Conv's weights)."""
+        self.link_attrs(conv, "weights")
+        self.n_kernels = conv.n_kernels
+        self.kx, self.ky = conv.kx, conv.ky
+        self.stride, self.padding = conv.stride, conv.padding
+        return self
+
+    def output_hw(self) -> Tuple[int, int]:
+        if self.out_hw is not None:
+            return self.out_hw
+        _, oh, ow, _ = self.input.shape
+        sy, sx = self.stride
+        ph, pw = self.padding
+        return ((oh - 1) * sy + self.ky - 2 * ph,
+                (ow - 1) * sx + self.kx - 2 * pw)
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, oh, ow, oc = self.input.shape
+        assert oc == self.n_kernels, (oc, self.n_kernels)
+        if not self.weights:
+            if self.n_channels is None:
+                return False  # waiting for a linked Conv twin's weights
+            fan_in = self.kx * self.ky * self.n_channels
+            self.init_params(
+                (self.ky, self.kx, self.n_channels, self.n_kernels), fan_in)
+        c = self.weights.shape[2]
+        h, w = self.output_hw()
+        if not self.output or self.output.shape != (n, h, w, c):
+            self.output.reset(np.zeros((n, h, w, c), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def param_arrays(self):
+        # weights may be TIED to the encoder conv (link_conv); the fused
+        # step must not treat them as a second independent parameter
+        if "weights" in self._linked_attrs:
+            return {}
+        return {"weights": self.weights}
+
+    def xla_init(self):
+        self._fn = self.jit(partial(
+            ox.deconv2d_forward, stride=self.stride, padding=self.padding,
+            out_hw=self.output_hw()))
+        return None
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        w = params.get("weights")
+        if w is None:  # tied weights: read the conv twin's live array
+            import jax.numpy as jnp
+            w = jnp.asarray(self.weights.mem)
+        return ox.deconv2d_forward(x, w, self.stride, self.padding,
+                                   self.output_hw())
+
+    def numpy_run(self) -> None:
+        self.output.mem = ref.deconv2d_forward(
+            self.input.mem, self.weights.mem, self.stride, self.padding,
+            self.output_hw())
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.output.set_devmem(self._fn(self.input.devmem(d),
+                                        self.weights.devmem(d)))
+
+
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({"deconv": Deconv})

@@ -1,0 +1,101 @@
+"""Depooling unit: the adjoint of max pooling, for autoencoder decoders.
+
+Parity: reference `veles/znicz/depooling.py` (`Depooling`, SURVEY.md §2.8
+"Autoencoder units") — scatters each pooled activation back to the position
+its max-pooling twin recorded (`input_offset`), producing a sparse
+upsampled map. The paired gradient is the gather at those offsets.
+
+Wiring: `depool.link_pool(maxpool)` aliases the offsets and the unpooled
+shape from the encoder's pooling twin.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
+
+
+class Depooling(Forward):
+    """y[idx] += x — idx from the encoder MaxPooling's `input_offset`."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.output_shape: Tuple[int, ...] = ()
+
+    def link_pool(self, pool) -> "Depooling":
+        """Take winner offsets and the target (unpooled) shape from the
+        encoder pooling twin."""
+        self.link_attrs(pool, "input_offset")
+        self._pool = pool
+        return self
+
+    def param_arrays(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            if not pool.input:
+                return False
+            self.output_shape = tuple(pool.input.shape)
+        if not self.output_shape:
+            raise ValueError(
+                f"{self.name}: link_pool() or output_shape required")
+        if not self.output or self.output.shape != self.output_shape:
+            self.output.reset(np.zeros(self.output_shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        shape = tuple(self.output_shape)
+        self._fn = self.jit(lambda x, idx: ox.depool_forward(x, idx, shape))
+        return None
+
+    def numpy_run(self) -> None:
+        self.output.mem = ref.depool_forward(
+            self.input.mem, self.input_offset.mem, self.output_shape)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.output.set_devmem(self._fn(self.input.devmem(d),
+                                        self.input_offset.devmem(d)))
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("_pool", None)  # re-linked by the owning workflow on restore
+        return d
+
+
+@register_gd(Depooling)
+class GDDepooling(GradientDescentBase):
+    """err_input = err_output gathered at the recorded offsets."""
+
+    def link_forward(self, fwd) -> "GDDepooling":
+        self.link_attrs(fwd, "input", "input_offset")
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output:
+            return False
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(ox.depool_backward)
+        return None
+
+    def numpy_run(self) -> None:
+        self.err_input.mem = ref.depool_backward(
+            self.err_output.mem, self.input_offset.mem)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.err_input.set_devmem(self._fn(self.err_output.devmem(d),
+                                           self.input_offset.devmem(d)))

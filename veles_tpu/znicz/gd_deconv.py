@@ -1,0 +1,76 @@
+"""Gradient unit for Deconv.
+
+Parity: reference `veles/znicz/gd_deconv.py` (`GDDeconv`) — err_output →
+err_input through the deconv adjoint (which is a plain forward conv) plus
+the SGD weight update; no bias (SURVEY.md §2.8).
+
+TPU-first: backward + update is one jitted function whose two convolutions
+come from `jax.vjp` of the forward deconv (ops.xla.deconv2d_backward).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.ops.optim import SGDConfig, sgd_update
+from veles_tpu.znicz.deconv import Deconv
+from veles_tpu.znicz.nn_units import GradientDescentBase, register_gd
+
+
+@register_gd(Deconv)
+class GDDeconv(GradientDescentBase):
+    def link_forward(self, fwd) -> "GDDeconv":
+        self.link_attrs(fwd, "weights", "input", "output")
+        self._stride = fwd.stride
+        self._padding = fwd.padding
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.weights:
+            return False
+        if not self.vel_w:
+            self.vel_w.reset(np.zeros(self.weights.shape,
+                                      self.weights.dtype))
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        stride, padding = self._stride, self._padding
+        cfg = SGDConfig(lr=self.learning_rate,
+                        momentum=self.gradient_moment,
+                        weight_decay=self.weights_decay,
+                        l1_decay=self.l1_decay)
+
+        def step(x, w, err_y, vw, lr_scale):
+            err_x, dw = ox.deconv2d_backward(x, w, err_y, stride, padding)
+            new_p, new_v = sgd_update({"w": w}, {"w": dw}, {"w": vw},
+                                      cfg, lr_scale)
+            return err_x, new_p["w"], new_v["w"]
+
+        self._fn = self.jit(step, donate_argnums=(3,))
+        return None
+
+    def numpy_run(self) -> None:
+        err_x, dw = ref.deconv2d_backward(
+            self.input.mem, self.weights.mem, self.err_output.mem,
+            self._stride, self._padding)
+        w, vw = self._sgd_host(self.weights.mem, dw, self.vel_w.mem, False)
+        self.err_input.mem = err_x
+        self.weights.mem = w
+        self.vel_w.mem = vw
+
+    def xla_run(self) -> None:
+        d = self.device
+        err_x, w, vw = self._fn(
+            self.input.devmem(d), self.weights.devmem(d),
+            self.err_output.devmem(d), self.vel_w.devmem(d),
+            jnp.float32(self.lr_scale))
+        self.err_input.set_devmem(err_x)
+        self.weights.set_devmem(w)
+        self.vel_w.set_devmem(vw)

@@ -1,0 +1,144 @@
+"""Kohonen self-organizing map units.
+
+Parity: reference `veles/znicz/kohonen.py` (`KohonenForward`,
+`KohonenTrainer` — SURVEY.md §2.8; config 4 in BASELINE.json:9). The
+trainer's update is neighborhood-decay weight movement, NOT gradient
+descent: every neuron moves toward the sample weighted by a Gaussian over
+grid distance to the winner, with learning rate and neighborhood radius
+decaying over epochs.
+
+TPU-first: the winner search is one distance matmul on the MXU; the
+order-dependent per-sample update is a `lax.scan` so a whole minibatch of
+updates is a single compiled computation (ops.xla.kohonen_update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward
+
+
+def make_grid(shape: Tuple[int, int]) -> np.ndarray:
+    """(rows*cols, 2) neuron coordinates for the neighborhood metric."""
+    rows, cols = shape
+    yy, xx = np.mgrid[0:rows, 0:cols]
+    return np.stack([yy.ravel(), xx.ravel()], axis=1).astype(np.float32)
+
+
+class KohonenForward(Forward):
+    """Winner-take-all: output[i] = argmin_k ||x_i − w_k||² (int32)."""
+
+    def __init__(self, workflow=None, shape: Tuple[int, int] = (8, 8),
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.shape = tuple(shape)
+        #: per-neuron winner counts over the run (reference KohonenHits
+        #: plotter's data source)
+        self.hits = Array()
+
+    @property
+    def n_neurons(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    def param_arrays(self):
+        return {}  # weights belong to (and are trained by) the trainer
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.weights:
+            return False  # linked from the trainer
+        n = self.input.shape[0]
+        if not self.output or self.output.shape != (n,):
+            self.output.reset(np.zeros((n,), np.int32))
+        if not self.hits:
+            self.hits.reset(np.zeros((self.n_neurons,), np.int64))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(ox.kohonen_forward)
+        return None
+
+    def numpy_run(self) -> None:
+        x = self.input.mem.reshape(len(self.input), -1)
+        winners = ref.kohonen_forward(x, self.weights.mem)
+        self.output.mem = winners.astype(np.int32)
+        np.add.at(self.hits.mem, winners, 1)
+
+    def xla_run(self) -> None:
+        d = self.device
+        x = self.input.devmem(d).reshape(len(self.input), -1)
+        winners = self._fn(x, self.weights.devmem(d))
+        self.output.set_devmem(winners)
+        np.add.at(self.hits.mem, np.asarray(winners), 1)
+
+
+class KohonenTrainer(Forward):
+    """Owns the SOM weights (n_neurons, D) and applies the neighborhood
+    update per minibatch. lr/sigma decay exponentially per EPOCH (driven
+    by the linked decision's epoch counter), matching the reference's
+    time-decay schedules."""
+
+    def __init__(self, workflow=None, shape: Tuple[int, int] = (8, 8),
+                 learning_rate: float = 0.5, sigma: float = None,
+                 lr_tau: float = 20.0, sigma_tau: float = 20.0,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.shape = tuple(shape)
+        self.learning_rate = learning_rate
+        self.sigma0 = sigma if sigma is not None else max(self.shape) / 2.0
+        self.lr_tau = lr_tau
+        self.sigma_tau = sigma_tau
+        self.grid = Array()
+        self.epoch_number = 0  # linked from a decision unit when present
+
+    @property
+    def n_neurons(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    def link_decision(self, decision) -> "KohonenTrainer":
+        self.link_attrs(decision, "epoch_number")
+        return self
+
+    def current_lr_sigma(self) -> Tuple[float, float]:
+        t = float(self.epoch_number)
+        lr = self.learning_rate * float(np.exp(-t / self.lr_tau))
+        sigma = self.sigma0 * float(np.exp(-t / self.sigma_tau))
+        return lr, max(sigma, 1e-3)
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        d = int(np.prod(self.input.shape[1:]))
+        if not self.weights:
+            gen = prng.get()
+            self.weights.reset(gen.fill_uniform(
+                (self.n_neurons, d), -0.1, 0.1, np.float32))
+        if not self.grid:
+            self.grid.reset(make_grid(self.shape))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        self._fn = self.jit(ox.kohonen_update)
+        return None
+
+    def numpy_run(self) -> None:
+        x = self.input.mem.reshape(len(self.input), -1)
+        lr, sigma = self.current_lr_sigma()
+        self.weights.mem = ref.kohonen_update(
+            x, self.weights.mem, self.grid.mem, lr, sigma)
+
+    def xla_run(self) -> None:
+        d = self.device
+        x = self.input.devmem(d).reshape(len(self.input), -1)
+        lr, sigma = self.current_lr_sigma()
+        self.weights.set_devmem(self._fn(
+            x, self.weights.devmem(d), self.grid.devmem(d),
+            np.float32(lr), np.float32(sigma)))

@@ -1,0 +1,131 @@
+"""RBM pretraining units.
+
+Parity: reference `veles/znicz/rbm_units.py` (SURVEY.md §2.8) —
+binarization of inputs and CD-1 contrastive-divergence weight updates for
+greedy layer-wise autoencoder pretraining.
+
+TPU-first: the whole CD-1 step (h0 sample, v1/h1 reconstruction, three
+gradient matmuls, update) is one jitted computation with on-device
+Bernoulli sampling (jax.random); the reference ran a separate RNG kernel +
+four GEMMs per step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import Forward
+
+
+class Binarization(Forward):
+    """output ~ Bernoulli(input) — stochastic binarization of activations
+    in [0,1] (the reference fed binarized data into the RBM)."""
+
+    def param_arrays(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        def fwd(x, key):
+            return (jax.random.uniform(key, x.shape) < x).astype(x.dtype)
+
+        self._fn = self.jit(fwd)
+        return None
+
+    def numpy_run(self) -> None:
+        gen = prng.get()
+        u = gen.state.random_sample(self.input.shape)
+        self.output.mem = (u < self.input.mem).astype(np.float32)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.output.set_devmem(self._fn(self.input.devmem(d),
+                                        prng.get().next_key()))
+
+
+class RBMTrainer(Forward):
+    """CD-1 trainer: owns W (V,H), visible/hidden biases; each run applies
+    one contrastive-divergence update on the current minibatch and records
+    the reconstruction MSE in `rec_err` (the decision's metric)."""
+
+    def __init__(self, workflow=None, n_hidden: int = 64,
+                 learning_rate: float = 0.1, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_hidden = n_hidden
+        self.learning_rate = learning_rate
+        self.bias_v = Array()
+        self.bias_h = Array()
+        self.rec_err = 0.0
+
+    def param_arrays(self):
+        return {"weights": self.weights, "bias_v": self.bias_v,
+                "bias_h": self.bias_h}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        v = int(np.prod(self.input.shape[1:]))
+        if not self.weights:
+            gen = prng.get()
+            self.weights.reset(gen.fill_normal(
+                (v, self.n_hidden), 0.0, 0.01, np.float32))
+        if not self.bias_v:
+            self.bias_v.reset(np.zeros((v,), np.float32))
+        if not self.bias_h:
+            self.bias_h.reset(np.zeros((self.n_hidden,), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        lr = self.learning_rate
+
+        def step(v0, w, bv, bh, key):
+            dw, dbv, dbh = ox.rbm_cd1(v0, w, bv, bh, key)
+            # ascent on log-likelihood (reference convention: += lr·grad)
+            w2, bv2, bh2 = w + lr * dw, bv + lr * dbv, bh + lr * dbh
+            # reconstruction error with the UPDATED weights
+            h = jax.nn.sigmoid(v0 @ w2 + bh2)
+            v1 = jax.nn.sigmoid(h @ w2.T + bv2)
+            rec = ((v1 - v0) ** 2).mean()
+            return w2, bv2, bh2, rec
+
+        self._fn = self.jit(step)
+        return None
+
+    def numpy_run(self) -> None:
+        v0 = self.input.mem.reshape(len(self.input), -1)
+        gen = prng.get()
+        dw, dbv, dbh = ref.rbm_cd1(v0, self.weights.mem, self.bias_v.mem,
+                                   self.bias_h.mem, gen.state)
+        lr = self.learning_rate
+        self.weights.mem = self.weights.mem + lr * dw
+        self.bias_v.mem = self.bias_v.mem + lr * dbv
+        self.bias_h.mem = self.bias_h.mem + lr * dbh
+        sig = lambda a: 1.0 / (1.0 + np.exp(-a))  # noqa: E731
+        h = sig(v0 @ self.weights.mem + self.bias_h.mem)
+        v1 = sig(h @ self.weights.mem.T + self.bias_v.mem)
+        self.rec_err = float(((v1 - v0) ** 2).mean())
+
+    def xla_run(self) -> None:
+        d = self.device
+        v0 = self.input.devmem(d).reshape(len(self.input), -1)
+        w, bv, bh, rec = self._fn(v0, self.weights.devmem(d),
+                                  self.bias_v.devmem(d),
+                                  self.bias_h.devmem(d),
+                                  prng.get().next_key())
+        self.weights.set_devmem(w)
+        self.bias_v.set_devmem(bv)
+        self.bias_h.set_devmem(bh)
+        self.rec_err = float(rec)
