@@ -507,3 +507,65 @@ def lstm_step(x: np.ndarray, h: np.ndarray, c: np.ndarray, wx: np.ndarray,
     c_new = f * c + i * g
     h_new = o * np.tanh(c_new)
     return h_new, c_new
+
+
+def lstm_forward(xs: np.ndarray, h0: np.ndarray, c0: np.ndarray,
+                 wx: np.ndarray, wh: np.ndarray, b: np.ndarray
+                 ) -> Tuple[np.ndarray, dict]:
+    """Unrolled forward over time. xs: (T, N, D) -> hs: (T, N, H), plus the
+    per-step cache (gates, cell states) that lstm_backward consumes."""
+    sig = lambda a: 1.0 / (1.0 + np.exp(-a))  # noqa: E731
+    T, n, _ = xs.shape
+    hsz = h0.shape[1]
+    hs = np.zeros((T, n, hsz), xs.dtype)
+    cache = {k: np.zeros((T, n, hsz), xs.dtype)
+             for k in ("i", "f", "g", "o", "c", "hprev", "cprev")}
+    h, c = h0, c0
+    for t in range(T):
+        z = xs[t] @ wx + h @ wh + b
+        i = sig(z[:, 0 * hsz:1 * hsz])
+        f = sig(z[:, 1 * hsz:2 * hsz])
+        g = np.tanh(z[:, 2 * hsz:3 * hsz])
+        o = sig(z[:, 3 * hsz:4 * hsz])
+        cache["hprev"][t], cache["cprev"][t] = h, c
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        for k, v in (("i", i), ("f", f), ("g", g), ("o", o), ("c", c)):
+            cache[k][t] = v
+        hs[t] = h
+    return hs, cache
+
+
+def lstm_backward(xs: np.ndarray, wx: np.ndarray, wh: np.ndarray,
+                  dhs: np.ndarray, cache: dict
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """BPTT through lstm_forward (parity: the reference's char-LSTM
+    backward, which its unit graph unrolled step-by-step on host).
+    dhs: (T, N, H) = dL/dh_t for every step. Returns (dxs, dwx, dwh, db)."""
+    T, n, d = xs.shape
+    hsz = dhs.shape[2]
+    dxs = np.zeros_like(xs)
+    dwx = np.zeros_like(wx)
+    dwh = np.zeros_like(wh)
+    db = np.zeros((4 * hsz,), xs.dtype)
+    dh_next = np.zeros((n, hsz), xs.dtype)
+    dc_next = np.zeros((n, hsz), xs.dtype)
+    for t in range(T - 1, -1, -1):
+        i, f, g, o = (cache[k][t] for k in ("i", "f", "g", "o"))
+        c, cprev, hprev = cache["c"][t], cache["cprev"][t], cache["hprev"][t]
+        tanh_c = np.tanh(c)
+        dh = dhs[t] + dh_next
+        dc = dc_next + dh * o * (1.0 - tanh_c * tanh_c)
+        do = dh * tanh_c
+        df = dc * cprev
+        di = dc * g
+        dg = dc * i
+        dz = np.concatenate([di * i * (1 - i), df * f * (1 - f),
+                             dg * (1 - g * g), do * o * (1 - o)], axis=1)
+        dxs[t] = dz @ wx.T
+        dh_next = dz @ wh.T
+        dc_next = dc * f
+        dwx += xs[t].T @ dz
+        dwh += hprev.T @ dz
+        db += dz.sum(axis=0)
+    return dxs, dwx, dwh, db

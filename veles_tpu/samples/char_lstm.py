@@ -1,0 +1,51 @@
+"""Character-LSTM language-model workflow — config 5 of BASELINE.json:10
+("Character-LSTM text workflow, sequence batching on TPU").
+
+Parity: the reference's char-RNN sample (host-unrolled all2all graph);
+here the recurrence is one `lax.scan` inside jit (znicz/lstm.py) and the
+per-timestep projection + CE ride the standard All2AllSoftmax/Evaluator
+stack over flattened (N·T) predictions. Exposes `run(load, main)`.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root
+from veles_tpu.loader.text import CharSequenceLoader, synthetic_text
+from veles_tpu.znicz import lstm  # noqa: F401 (registers the layer type)
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.char_lstm.loader.minibatch_size = 32
+root.char_lstm.loader.seq_len = 32
+root.char_lstm.loader.n_validation = 40
+root.char_lstm.n_units = 64
+root.char_lstm.decision.max_epochs = 5
+root.char_lstm.decision.fail_iterations = 20
+root.char_lstm.gd.learning_rate = 0.05
+root.char_lstm.gd.gradient_moment = 0.9
+
+
+class CharLSTMWorkflow(StandardWorkflow):
+    """LSTM(H) → All2AllSoftmax(V) over flattened timesteps."""
+
+
+def create_workflow(text: str = None) -> CharLSTMWorkflow:
+    cfg = root.char_lstm
+    loader = CharSequenceLoader(
+        text=text, seq_len=cfg.loader.seq_len,
+        n_validation=cfg.loader.n_validation,
+        minibatch_size=cfg.loader.minibatch_size)
+    return CharLSTMWorkflow(
+        layers=[
+            {"type": "lstm", "n_units": cfg.n_units},
+            {"type": "softmax", "output_sample_shape": loader.n_vocab,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=loader.n_vocab,
+        decision_config=cfg.decision.to_dict(),
+        gd_config=cfg.gd.to_dict(),
+        name="CharLSTMWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

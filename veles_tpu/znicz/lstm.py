@@ -1,0 +1,209 @@
+"""Recurrent units: LSTM over time, char-LM building blocks.
+
+Parity: the reference's char-LSTM workflow (config 5 in BASELINE.json:10,
+"znicz rnn units") built the recurrence OUT OF all2all+activation units
+with explicit per-timestep unrolling in the unit graph, time-stepped on
+host (SURVEY.md §5.7).
+
+TPU-first redesign: the whole sequence is ONE `lax.scan` inside jit
+(ops.xla.lstm_scan) — XLA compiles the time loop, keeps h/c on-chip, and
+batches the three gate matmuls per step onto the MXU; the backward is
+`jax.vjp` through the scan (compiled BPTT) instead of a graph of per-step
+gradient units. The numpy golden twin is a hand-derived BPTT
+(ops.reference.lstm_backward) — the cross-backend equivalence test pins
+them against each other.
+
+Layout: input (N, T, D); output is FLATTENED to (N*T, H) so a standard
+All2All(Softmax) projection + EvaluatorSoftmax consume per-timestep
+predictions unchanged (labels arrive flat from the text loader).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.ops import reference as ref
+from veles_tpu.ops import xla as ox
+from veles_tpu.ops.optim import SGDConfig, sgd_update
+from veles_tpu.znicz.nn_units import (Forward, GradientDescentBase,
+                                      register_gd)
+
+
+class LSTM(Forward):
+    """Scan-compiled LSTM; params wx (D,4H), wh (H,4H), b (4H,)."""
+
+    def __init__(self, workflow=None, n_units: int = 128,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_units = n_units
+        self.wx = Array()
+        self.wh = Array()
+        self.b = Array()
+
+    def param_arrays(self) -> Dict[str, Array]:
+        return {"wx": self.wx, "wh": self.wh, "b": self.b}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, t, d = self.input.shape
+        h = self.n_units
+        if not self.wx:
+            std = self.weights_stddev or self.default_stddev(d)
+            self.wx.reset(self._fill((d, 4 * h), self.weights_filling, std))
+            std_h = self.weights_stddev or self.default_stddev(h)
+            self.wh.reset(self._fill((h, 4 * h), self.weights_filling,
+                                     std_h))
+            b = np.zeros((4 * h,), np.float32)
+            b[h:2 * h] = 1.0  # forget-gate bias init (standard practice)
+            self.b.reset(b)
+        if not self.output or self.output.shape != (n * t, h):
+            self.output.reset(np.zeros((n * t, h), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def _zeros_hc(self, n):
+        h = self.n_units
+        return np.zeros((n, h), np.float32), np.zeros((n, h), np.float32)
+
+    def xla_init(self):
+        def fwd(x, wx, wh, b):
+            n, t, d = x.shape
+            h0 = jnp.zeros((n, self.n_units), x.dtype)
+            hs, _, _ = ox.lstm_scan(x.transpose(1, 0, 2), h0, h0, wx, wh, b)
+            return hs.transpose(1, 0, 2).reshape(n * t, self.n_units)
+
+        self._fn = self.jit(fwd)
+        return None
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        n, t, d = x.shape
+        h0 = jnp.zeros((n, self.n_units), x.dtype)
+        hs, _, _ = ox.lstm_scan(x.transpose(1, 0, 2), h0, h0,
+                                params["wx"], params["wh"], params["b"])
+        return hs.transpose(1, 0, 2).reshape(n * t, self.n_units)
+
+    def numpy_run(self) -> None:
+        x = self.input.mem
+        n, t, d = x.shape
+        h0, c0 = self._zeros_hc(n)
+        hs, cache = ref.lstm_forward(x.transpose(1, 0, 2), h0, c0,
+                                     self.wx.mem, self.wh.mem, self.b.mem)
+        self._cache = cache
+        self.output.mem = hs.transpose(1, 0, 2).reshape(n * t, self.n_units)
+
+    def xla_run(self) -> None:
+        d = self.device
+        self.output.set_devmem(self._fn(
+            self.input.devmem(d), self.wx.devmem(d), self.wh.devmem(d),
+            self.b.devmem(d)))
+
+    def __getstate__(self):
+        st = super().__getstate__()
+        st.pop("_cache", None)  # per-step scratch, rebuilt each forward
+        return st
+
+
+@register_gd(LSTM)
+class GDLSTM(GradientDescentBase):
+    """BPTT + SGD update. XLA path: jax.vjp through the scan, fused with
+    the momentum update; numpy path: the hand-derived golden BPTT."""
+
+    def link_forward(self, fwd: LSTM) -> "GDLSTM":
+        self.link_attrs(fwd, "wx", "wh", "b", "input", "output")
+        self._fwd = fwd
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.wx:
+            return False
+        for vname, p in (("vel_wx", self.wx), ("vel_wh", self.wh),
+                         ("vel_b", self.b)):
+            v = getattr(self, vname, None)
+            if v is None or not v:
+                arr = Array()
+                arr.reset(np.zeros(p.shape, p.dtype))
+                setattr(self, vname, arr)
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        n_units = self._fwd.n_units
+        cfg = SGDConfig(lr=self.learning_rate,
+                        momentum=self.gradient_moment,
+                        weight_decay=self.weights_decay,
+                        l1_decay=self.l1_decay)
+
+        def step(x, wx, wh, b, err_y, vwx, vwh, vb, lr_scale):
+            n, t, d = x.shape
+
+            def fwd(params, xx):
+                h0 = jnp.zeros((n, n_units), xx.dtype)
+                hs, _, _ = ox.lstm_scan(xx.transpose(1, 0, 2), h0, h0,
+                                        params["wx"], params["wh"],
+                                        params["b"])
+                return hs.transpose(1, 0, 2).reshape(n * t, n_units)
+
+            params = {"wx": wx, "wh": wh, "b": b}
+            _, vjp = jax.vjp(fwd, params, x)
+            grads, err_x = vjp(err_y)
+            new_p, new_v = sgd_update(
+                params, grads, {"wx": vwx, "wh": vwh, "b": vb}, cfg,
+                lr_scale)
+            return (err_x, new_p["wx"], new_p["wh"], new_p["b"],
+                    new_v["wx"], new_v["wh"], new_v["b"])
+
+        self._fn = self.jit(step, donate_argnums=(5, 6, 7))
+        return None
+
+    def numpy_run(self) -> None:
+        x = self.input.mem
+        n, t, d = x.shape
+        cache = getattr(self._fwd, "_cache", None)
+        if cache is None:  # forward ran on the other backend: rebuild
+            h0 = np.zeros((n, self._fwd.n_units), np.float32)
+            _, cache = ref.lstm_forward(x.transpose(1, 0, 2), h0, h0,
+                                        self.wx.mem, self.wh.mem,
+                                        self.b.mem)
+        dhs = self.err_output.mem.reshape(n, t, -1).transpose(1, 0, 2)
+        dxs, dwx, dwh, db = ref.lstm_backward(
+            x.transpose(1, 0, 2), self.wx.mem, self.wh.mem, dhs, cache)
+        self.err_input.mem = dxs.transpose(1, 0, 2)
+        for p, g, v in ((self.wx, dwx, self.vel_wx),
+                        (self.wh, dwh, self.vel_wh),
+                        (self.b, db, self.vel_b)):
+            new_p, new_v = self._sgd_host(p.mem, g, v.mem, False)
+            p.mem = new_p
+            v.mem = new_v
+
+    def xla_run(self) -> None:
+        d = self.device
+        out = self._fn(self.input.devmem(d), self.wx.devmem(d),
+                       self.wh.devmem(d), self.b.devmem(d),
+                       self.err_output.devmem(d), self.vel_wx.devmem(d),
+                       self.vel_wh.devmem(d), self.vel_b.devmem(d),
+                       jnp.float32(self.lr_scale))
+        err_x, wx, wh, b, vwx, vwh, vb = out
+        self.err_input.set_devmem(err_x)
+        self.wx.set_devmem(wx)
+        self.wh.set_devmem(wh)
+        self.b.set_devmem(b)
+        self.vel_wx.set_devmem(vwx)
+        self.vel_wh.set_devmem(vwh)
+        self.vel_b.set_devmem(vb)
+
+    def __getstate__(self):
+        st = super().__getstate__()
+        st.pop("_fwd", None)  # re-linked on restore by the workflow
+        return st
+
+
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({"lstm": LSTM})

@@ -151,6 +151,11 @@ class StandardWorkflow(Workflow):
         (Bool.__getstate__ drops the closure), so a restored workflow must
         re-derive them or gates stay stuck at their snapshot-time values
         (e.g. gate_skip frozen True → silently no more weight updates)."""
+        # re-link GD twins to their forwards: link_forward is idempotent,
+        # and units that keep a direct forward reference (GDLSTM._fwd)
+        # drop it from pickles and need it re-established after restore
+        for g, fwd in zip(self.gds, reversed(self.forwards)):
+            g.link_forward(fwd)
         # skip weight updates on test/validation minibatches; freeze the
         # chain entirely once training completed
         for g in self.gds:
