@@ -1,0 +1,39 @@
+"""CIFAR-10-style functional test (config 2): the conv/pool/LRN tower
+trains below chance on both backends and through the fused step, with
+pinned seeds (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice
+
+
+def build(max_epochs=2):
+    from veles_tpu.config import root
+    from veles_tpu.samples.cifar10 import create_workflow
+    prng.seed_all(1234)
+    # shrink to test scale but keep the full layer-type mix
+    root.cifar.loader.n_train = 300
+    root.cifar.loader.n_validation = 100
+    root.cifar.loader.minibatch_size = 50
+    root.cifar.decision.max_epochs = max_epochs
+    return create_workflow()
+
+
+@pytest.mark.parametrize("device_cls", [XLADevice, NumpyDevice])
+def test_cifar_trains_below_chance(device_cls):
+    wf = build(max_epochs=4)
+    wf.initialize(device=device_cls())
+    wf.run()
+    assert wf.decision.epoch_number == 4
+    # 100 validation samples, chance = 90 errors; synthetic prototypes are
+    # separable so conv training must land far below that by epoch 4
+    assert wf.decision.best_validation_err < 30, \
+        wf.decision.best_validation_err
+
+
+def test_cifar_fused_trains():
+    wf = build(max_epochs=4)
+    wf.run_fused()
+    assert wf.decision.best_validation_err < 30
