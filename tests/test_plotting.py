@@ -1,0 +1,89 @@
+"""Plotter units + renderer + results publishing (SURVEY.md §2.5): specs
+render off-thread to files, plotting units read through data links, and a
+workflow wired with epoch-gated plotters trains unaffected."""
+
+import json
+import os
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.plotter import GraphicsRenderer
+from veles_tpu.plotting_units import (AccumulatingPlotter, MatrixPlotter,
+                                      Weights2D)
+from veles_tpu.publishing import write_results
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build(tmp_path, max_epochs=2):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=5, sample_shape=(6, 6), n_validation=50, n_train=200,
+        minibatch_size=50, noise=0.5)
+    return StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.05},
+                {"type": "softmax", "output_sample_shape": 5,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=5,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="PlotTest")
+
+
+def test_renderer_renders_specs_offthread(tmp_path):
+    r = GraphicsRenderer(str(tmp_path))
+    r.start()
+    r.publish({"name": "curve", "kind": "lines",
+               "series": {"train": [3, 2, 1]}})
+    r.publish({"name": "mat", "kind": "matrix",
+               "data": [[1, 0], [0, 1]]})
+    r.publish({"name": "tiles", "kind": "images",
+               "data": [np.eye(4).tolist()] * 3})
+    r.stop()
+    files = sorted(os.listdir(tmp_path))
+    assert len(r.rendered) == 3, r.rendered
+    assert any(f.startswith("curve") for f in files)
+    assert any(f.startswith("mat") for f in files)
+    assert any(f.startswith("tiles") for f in files)
+
+
+def test_workflow_with_plotters_and_results(tmp_path):
+    wf = build(tmp_path, max_epochs=3)
+    renderer = GraphicsRenderer(str(tmp_path / "plots"))
+    renderer.start()
+
+    err_plot = AccumulatingPlotter(wf, plot_name="valid_err",
+                                   label="valid", renderer=renderer)
+    # read the decision's best validation error each epoch
+    err_plot.link_attrs(wf.decision, ("input", "best_validation_err"))
+    conf_plot = MatrixPlotter(wf, plot_name="confusion", renderer=renderer)
+    conf_plot.link_attrs(wf.evaluator, ("input", "confusion_matrix"))
+    w_plot = Weights2D(wf, plot_name="weights", limit=9, renderer=renderer)
+    w_plot.link_attrs(wf.forwards[0], ("input", "weights"))
+
+    # fire once per epoch: after the decision, gated on epoch end; also
+    # wire them BEFORE end_point so the final epoch's plots render before
+    # the pump stops (pulses queued after end_point are dropped)
+    for p in (err_plot, conf_plot, w_plot):
+        p.link_from(wf.decision)
+        p.gate_skip = ~wf.loader.epoch_ended
+        wf.end_point.link_from(p)
+
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    renderer.stop()
+    assert err_plot.run_count == 3      # once per epoch
+    assert len(err_plot.values) == 3
+    plots = os.listdir(tmp_path / "plots")
+    assert any(f.startswith("valid_err") for f in plots)
+    assert any(f.startswith("confusion") for f in plots)
+    assert any(f.startswith("weights") for f in plots)
+
+    out = write_results(wf, str(tmp_path / "results.json"))
+    res = json.load(open(out))
+    assert res["epochs"] == 3
+    assert res["best_validation_err"] is not None
+    assert any(u["name"] == "repeater" for u in res["units"])

@@ -1,0 +1,167 @@
+"""Plotter infrastructure: plotting units + detached renderer.
+
+Parity: reference `veles/plotter.py` + `veles/graphics_server.py` /
+`graphics_client.py` (SURVEY.md §2.5) — plotting units accumulate data in
+the training process and publish plot SPECS to a renderer that runs OFF
+the training thread, so rendering never stalls the hot loop.
+
+TPU-first shape of the same idea: specs go onto a queue consumed by a
+daemon renderer thread (matplotlib Agg → PNG files); with matplotlib
+absent the specs are still recorded and written as JSON, so headless/CI
+runs keep the data. The ZMQ PUB hop of the reference collapses to an
+in-process queue — the isolation that mattered (no rendering on the
+training thread) is preserved, the transport is not load-bearing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from veles_tpu.logger import Logger
+from veles_tpu.units import Unit
+
+
+def _have_matplotlib() -> bool:
+    try:
+        import matplotlib  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+class GraphicsRenderer(Logger):
+    """Daemon-thread consumer of plot specs; renders PNGs (or JSON when
+    matplotlib is unavailable) into `directory`."""
+
+    def __init__(self, directory: str = "plots") -> None:
+        self.directory = directory
+        self._q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self.rendered: List[str] = []
+
+    def start(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="graphics-renderer")
+        self._thread.start()
+
+    def publish(self, spec: Dict[str, Any]) -> None:
+        self._q.put(spec)
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._q.put(None)
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    # -- rendering -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            spec = self._q.get()
+            if spec is None:
+                return
+            try:
+                path = self._render(spec)
+                if path:
+                    self.rendered.append(path)
+            except Exception as e:  # noqa: BLE001 — rendering must never
+                self.warning("render failed: %s", e)   # kill training
+
+    def _render(self, spec: Dict[str, Any]) -> Optional[str]:
+        name = spec["name"]
+        base = os.path.join(self.directory, name)
+        if not _have_matplotlib():
+            path = base + ".json"
+            with open(path, "w") as f:
+                json.dump(spec, f, default=lambda a: getattr(
+                    a, "tolist", lambda: str(a))())
+            return path
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        fig = plt.figure(figsize=(6, 4), dpi=110)
+        ax = fig.add_subplot(111)
+        kind = spec["kind"]
+        if kind == "lines":
+            for label, ys in spec["series"].items():
+                ax.plot(ys, label=label)
+            ax.legend()
+            ax.set_xlabel(spec.get("xlabel", "epoch"))
+            ax.set_ylabel(spec.get("ylabel", ""))
+        elif kind == "matrix":
+            im = ax.imshow(spec["data"], cmap="viridis")
+            fig.colorbar(im, ax=ax)
+        elif kind == "images":
+            import numpy as np
+            plt.close(fig)
+            tiles = spec["data"]
+            n = len(tiles)
+            cols = int(np.ceil(np.sqrt(n)))
+            rows = -(-n // cols)
+            fig, axes = plt.subplots(rows, cols, figsize=(cols, rows),
+                                     dpi=110)
+            axes = np.atleast_1d(axes).ravel()
+            for a in axes:
+                a.axis("off")
+            for a, tile in zip(axes, tiles):
+                t = np.asarray(tile)
+                t = (t - t.min()) / max(float(t.max() - t.min()), 1e-9)
+                a.imshow(t.squeeze(), cmap="gray")
+        else:
+            plt.close(fig)
+            raise ValueError(f"unknown plot kind {kind!r}")
+        ax.set_title(spec.get("title", name))
+        path = base + ".png"
+        fig.savefig(path, bbox_inches="tight")
+        plt.close(fig)
+        return path
+
+
+#: process-wide default renderer (lazily started); units use it unless an
+#: explicit renderer is linked.
+_default_renderer: Optional[GraphicsRenderer] = None
+
+
+def get_renderer(directory: str = "plots") -> GraphicsRenderer:
+    global _default_renderer
+    if _default_renderer is None:
+        _default_renderer = GraphicsRenderer(directory)
+        _default_renderer.start()
+    return _default_renderer
+
+
+class Plotter(Unit):
+    """Base plotting unit: subclasses build a spec in `make_spec()`; firing
+    publishes it to the renderer. Like the reference, plotters are gated
+    (typically on epoch end) so they cost nothing per minibatch."""
+
+    def __init__(self, workflow=None, renderer: Optional[GraphicsRenderer]
+                 = None, directory: str = "plots", **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self._renderer = renderer
+        self.directory = directory
+
+    @property
+    def renderer(self) -> GraphicsRenderer:
+        if self._renderer is None:
+            self._renderer = get_renderer(self.directory)
+        return self._renderer
+
+    def make_spec(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        spec = self.make_spec()
+        if spec is not None:
+            self.renderer.publish(spec)
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["_renderer"] = None  # daemon thread: recreated on demand
+        return d

@@ -1,0 +1,103 @@
+"""Concrete plotting units.
+
+Parity: reference `veles/plotting_units.py` + `veles/znicz/
+nn_plotting_units.py` (SURVEY.md §2.5) — `AccumulatingPlotter` (error
+curves over epochs), `MatrixPlotter` (confusion matrix), `Weights2D`
+(first-layer filter tiles), `KohonenHits` (SOM activation histogram).
+Each reads its source unit through data links, exactly like the
+reference's wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from veles_tpu.plotter import Plotter
+
+
+class AccumulatingPlotter(Plotter):
+    """Appends a scalar each firing and redraws the curve. Link `input`
+    to e.g. the decision's epoch metric; fire it once per epoch."""
+
+    def __init__(self, workflow=None, plot_name: str = "metric",
+                 label: str = "train", **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.plot_name = plot_name
+        self.label = label
+        self.values: List[float] = []
+        self.input = 0.0  # usually a data link
+
+    def make_spec(self) -> Optional[Dict[str, Any]]:
+        v = self.input
+        if v is None:
+            return None
+        self.values.append(float(v))
+        return {"name": self.plot_name, "kind": "lines",
+                "title": self.plot_name,
+                "series": {self.label: list(self.values)},
+                "ylabel": self.plot_name}
+
+
+class MatrixPlotter(Plotter):
+    """Renders a matrix heatmap (confusion matrix from EvaluatorSoftmax)."""
+
+    def __init__(self, workflow=None, plot_name: str = "confusion",
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.plot_name = plot_name
+        self.input = None  # link to evaluator.confusion_matrix (Array)
+
+    def make_spec(self) -> Optional[Dict[str, Any]]:
+        if self.input is None or not self.input:
+            return None
+        return {"name": self.plot_name, "kind": "matrix",
+                "title": self.plot_name,
+                "data": np.asarray(self.input.mem).tolist()}
+
+
+class Weights2D(Plotter):
+    """First-layer filter visualization: tiles each kernel as an image.
+    Link `input` to a Conv/All2All unit's weights Array."""
+
+    def __init__(self, workflow=None, plot_name: str = "weights",
+                 limit: int = 64, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.plot_name = plot_name
+        self.limit = limit
+        self.input = None
+
+    def make_spec(self) -> Optional[Dict[str, Any]]:
+        if self.input is None or not self.input:
+            return None
+        w = np.asarray(self.input.mem)
+        if w.ndim == 4:  # (ky, kx, C, K) conv kernels -> K tiles
+            tiles = [w[:, :, :, k].mean(axis=2)
+                     for k in range(min(w.shape[3], self.limit))]
+        else:  # (fan_in, units) FC weights: square-ish reshape per unit
+            side = int(np.sqrt(w.shape[0]))
+            tiles = [w[:side * side, k].reshape(side, side)
+                     for k in range(min(w.shape[1], self.limit))]
+        return {"name": self.plot_name, "kind": "images",
+                "title": self.plot_name,
+                "data": [t.tolist() for t in tiles]}
+
+
+class KohonenHits(Plotter):
+    """SOM winner-count map (reference znicz KohonenHits). Link `input` to
+    KohonenForward.hits and set `shape` to the SOM grid."""
+
+    def __init__(self, workflow=None, plot_name: str = "kohonen_hits",
+                 shape=(8, 8), **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.plot_name = plot_name
+        self.shape = tuple(shape)
+        self.input = None
+
+    def make_spec(self) -> Optional[Dict[str, Any]]:
+        if self.input is None or not self.input:
+            return None
+        hits = np.asarray(self.input.mem).reshape(self.shape)
+        return {"name": self.plot_name, "kind": "matrix",
+                "title": self.plot_name, "data": hits.tolist()}

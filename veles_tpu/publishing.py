@@ -1,0 +1,43 @@
+"""End-of-run result publishing.
+
+Parity: reference `veles/publishing/` (SURVEY.md §2.5 [L]) — emit a
+machine-readable summary of a finished run (metrics, epochs, per-unit
+timing) for downstream harnesses; the reference's richer backends (wiki,
+confluence) are out of the north-star scope and documented as non-goals.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict
+
+
+def workflow_results(workflow) -> Dict[str, Any]:
+    res: Dict[str, Any] = {
+        "workflow": getattr(workflow, "name", type(workflow).__name__),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "total_time_s": round(getattr(workflow, "run_total_time", 0.0), 3),
+        "units": [
+            {"name": u.name, "runs": u.run_count,
+             "time_s": round(u.run_time, 4)}
+            for u in getattr(workflow, "units", []) if u.run_count
+        ],
+    }
+    dec = getattr(workflow, "decision", None)
+    if dec is not None:
+        res["epochs"] = dec.epoch_number
+        res["best_validation_err"] = dec.best_validation_err
+        res["best_epoch"] = getattr(dec, "best_epoch", None)
+        metrics = getattr(dec, "epoch_metrics", None)
+        if metrics is not None:
+            res["last_epoch_metrics"] = {
+                "test": metrics[0], "validation": metrics[1],
+                "train": metrics[2]}
+    return res
+
+
+def write_results(workflow, path: str = "results.json") -> str:
+    with open(path, "w") as f:
+        json.dump(workflow_results(workflow), f, indent=2)
+    return path

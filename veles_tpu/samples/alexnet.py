@@ -12,9 +12,9 @@ weights; data-parallel gradient all-reduce over the mesh "data" axis, and
 optional tensor parallelism over "model" for the wide FC layers.
 
 Data note: zero-egress environment — trains on the deterministic synthetic
-ImageNet-shaped dataset (loader/synthetic.py). For an on-disk image tree,
-build the workflow with an ImageDirectoryLoader (loader/image.py) instead
-of the synthetic loader.
+ImageNet-shaped dataset (loader/synthetic.py) by default; set
+`root.alexnet.loader.data_path` to a class-per-directory image tree and
+create_workflow builds a prefetching ImageDirectoryLoader instead.
 """
 
 from __future__ import annotations
@@ -29,6 +29,7 @@ root.alexnet.loader.minibatch_size = 128
 root.alexnet.loader.n_validation = 128
 root.alexnet.loader.n_train = 512
 root.alexnet.loader.input_hw = 227
+root.alexnet.loader.data_path = ""
 root.alexnet.n_classes = 1000
 root.alexnet.decision.max_epochs = 10
 root.alexnet.decision.fail_iterations = 10
@@ -83,13 +84,21 @@ def create_workflow(minibatch_size: Optional[int] = None,
     mb = minibatch_size or cfg.loader.minibatch_size
     hw = input_hw or cfg.loader.input_hw
     nc = n_classes or cfg.n_classes
-    loader = SyntheticClassifierLoader(
-        n_classes=min(nc, 64),  # prototype count, not the head width
-        sample_shape=(hw, hw, 3),
-        n_validation=(n_validation if n_validation is not None
-                      else cfg.loader.n_validation),
-        n_train=n_train if n_train is not None else cfg.loader.n_train,
-        minibatch_size=mb, noise=0.5)
+    if cfg.loader.get("data_path"):
+        from veles_tpu.loader.image import ImageDirectoryLoader
+        loader = ImageDirectoryLoader(
+            data_path=cfg.loader.data_path, size_hw=(hw, hw),
+            n_validation=(n_validation if n_validation is not None
+                          else cfg.loader.n_validation),
+            minibatch_size=mb)
+    else:
+        loader = SyntheticClassifierLoader(
+            n_classes=min(nc, 64),  # prototype count, not the head width
+            sample_shape=(hw, hw, 3),
+            n_validation=(n_validation if n_validation is not None
+                          else cfg.loader.n_validation),
+            n_train=n_train if n_train is not None else cfg.loader.n_train,
+            minibatch_size=mb, noise=0.5)
     return AlexNetWorkflow(
         layers=alexnet_layers(nc, width_mult, fc_width),
         loader=loader, loss="softmax", n_classes=nc,
