@@ -1,0 +1,134 @@
+"""Attention ops: single-device reference + sequence-parallel forms.
+
+The reference framework (2015-era) has NO attention anywhere (SURVEY.md
+§5.7); this module is a capability the TPU build adds because long-context
+support is first-class here. Two sequence-parallel schemes are provided,
+matching the two standard TPU recipes:
+
+- **Ring attention** (`ring_attention`): Q stays sharded over the "seq"
+  mesh axis; K/V shards rotate around the ring via `lax.ppermute` while a
+  flash-style online softmax accumulates (m, l, o) — numerically identical
+  to full attention, memory O(S_local), and the permute rides ICI
+  neighbor links. Use when S is huge and heads are few.
+- **Ulysses / all-to-all** (`ulysses_attention`): `all_to_all` swaps the
+  sequence sharding for a head sharding, full-sequence attention runs per
+  head group, then swaps back. Use when n_heads >= mesh axis.
+
+Both run inside `shard_map` over a `Mesh` "seq" axis (parallel/mesh.py)
+and degrade to plain attention on a 1-device axis. Tested against
+`mha_forward` on the 8-device CPU mesh (tests/test_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def mha_forward(q, k, v, scale: Optional[float] = None,
+                causal: bool = False):
+    """Plain multi-head attention. q/k/v: (B, S, H, D) -> (B, S, H, D).
+    The single-device golden model for the parallel forms."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_idx = jnp.arange(q.shape[1])[:, None]
+        k_idx = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((k_idx <= q_idx)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_accum(q, k, v, scale, mask, m, l, o):
+    """One online-softmax accumulation step (flash-attention recurrence).
+    q: (B,Sq,H,D), k/v: (B,Sk,H,D); m/l: (B,H,Sq), o: (B,Sq,H,D)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_blk = s.max(axis=-1)                      # (B,H,Sq)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])           # (B,H,Sq,Sk)
+    alpha = jnp.exp(m - m_new)                  # (B,H,Sq)
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] \
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str,
+                   scale: Optional[float] = None, causal: bool = False):
+    """Sequence-parallel attention over a ring. Call INSIDE shard_map with
+    q/k/v sharded on the sequence dim: (B, S/n, H, D) per device.
+
+    Per step, each device computes attention of its Q shard against the
+    currently-held K/V shard, then passes the K/V shard to its ring
+    neighbor (`ppermute`) — n steps see every KV shard exactly once. The
+    online-softmax (m, l, o) carry makes the result bit-comparable to
+    full attention regardless of arrival order.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, _ = q.shape
+
+    q_idx = my * s_loc + jnp.arange(s_loc)      # global Q positions
+
+    # the carry must be device-varying from step 0 (shard_map vma typing:
+    # it mixes with the varying K/V inside the loop). Deriving it from q
+    # arithmetic inherits q's full varying-axis set, whatever outer mesh
+    # axes the caller sharded over.
+    zero_bhs = q[..., 0].transpose(0, 2, 1) * 0.0
+    m0 = zero_bhs + jnp.asarray(NEG_INF, q.dtype)
+    l0 = zero_bhs
+    o0 = q * 0.0
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        m, l, o, k_t, v_t = carry
+        # after t rotations we hold the shard originally on (my - t) mod n
+        src = (my - t) % n
+        if causal:
+            k_idx = src * s_loc + jnp.arange(s_loc)
+            mask = (k_idx[None, :] <= q_idx[:, None])[None, None]
+        else:
+            mask = None
+        m, l, o = _block_accum(q, k_t, v_t, scale, mask, m, l, o)
+        k_t = lax.ppermute(k_t, axis_name, perm)
+        v_t = lax.ppermute(v_t, axis_name, perm)
+        return m, l, o, k_t, v_t
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ulysses_attention(q, k, v, axis_name: str,
+                      scale: Optional[float] = None, causal: bool = False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme). Call
+    INSIDE shard_map with q/k/v sequence-sharded (B, S/n, H, D); requires
+    H divisible by the axis size. The all_to_all trades the sequence
+    sharding for a head sharding, full-sequence attention runs on H/n
+    local heads, and a second all_to_all restores the sequence sharding.
+    """
+    n = lax.axis_size(axis_name)
+
+    def seq_to_heads(x):  # (B, S/n, H, D) -> (B, S, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # (B, S, H/n, D) -> (B, S/n, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = mha_forward(qh, kh, vh, scale, causal)
+    return heads_to_seq(oh)

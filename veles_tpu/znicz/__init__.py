@@ -11,5 +11,6 @@ registry — SURVEY.md §2.8).
 # (standard_workflow first: the others append to its LAYER_TYPES).
 from veles_tpu.znicz import standard_workflow  # noqa: F401, E402
 from veles_tpu.znicz import (  # noqa: F401, E402
-    activation, all2all, conv, dropout, gd, gd_conv, gd_pooling,
-    normalization, pooling)
+    activation, all2all, attention, conv, cutter, deconv, depooling,
+    dropout, gd, gd_conv, gd_deconv, gd_pooling, kohonen, lstm,
+    normalization, pooling, rbm_units)

@@ -1,0 +1,169 @@
+"""Multi-head self-attention units.
+
+Not present in the reference (SURVEY.md §5.7: no attention anywhere in the
+2015 codebase) — added because long-context support is first-class in the
+TPU build. Follows the house unit pattern: a Forward twin with a
+vjp-driven GD twin, fused_apply for the one-step compiled path, and
+`seq_shards` plumbing so the fused/sharded step can run the ring or
+Ulysses sequence-parallel kernels over the mesh "seq" axis
+(ops/attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu.memory import Array
+from veles_tpu.ops import attention as oa
+from veles_tpu.ops.optim import SGDConfig, sgd_update
+from veles_tpu.znicz.nn_units import (Forward, GradientDescentBase,
+                                      register_gd)
+
+
+class MultiHeadAttention(Forward):
+    """Self-attention block: input (N, S, E) -> output (N, S, E).
+    Params: wq/wk/wv (E, H·D), wo (H·D, E). `parallel_mode` selects the
+    in-mesh kernel for the fused path: "local" | "ring" | "ulysses"."""
+
+    def __init__(self, workflow=None, n_heads: int = 4,
+                 head_dim: int = None, causal: bool = True,
+                 parallel_mode: str = "local", **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_heads = n_heads
+        self.head_dim = head_dim
+        self.causal = causal
+        self.parallel_mode = parallel_mode
+        self.wq = Array()
+        self.wk = Array()
+        self.wv = Array()
+        self.wo = Array()
+
+    def param_arrays(self) -> Dict[str, Array]:
+        return {"wq": self.wq, "wk": self.wk, "wv": self.wv, "wo": self.wo}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, s, e = self.input.shape
+        if self.head_dim is None:
+            assert e % self.n_heads == 0, (e, self.n_heads)
+            self.head_dim = e // self.n_heads
+        hd = self.n_heads * self.head_dim
+        if not self.wq:
+            std = self.weights_stddev or self.default_stddev(e)
+            for arr, shape in ((self.wq, (e, hd)), (self.wk, (e, hd)),
+                               (self.wv, (e, hd)), (self.wo, (hd, e))):
+                arr.reset(self._fill(shape, self.weights_filling, std))
+        if not self.output or self.output.shape != (n, s, e):
+            self.output.reset(np.zeros((n, s, e), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    # -- pure forward ---------------------------------------------------------
+
+    def _apply(self, params, x, axis_name=None):
+        n, s, e = x.shape
+        h, d = self.n_heads, self.head_dim
+        q = (x @ params["wq"]).reshape(n, s, h, d)
+        k = (x @ params["wk"]).reshape(n, s, h, d)
+        v = (x @ params["wv"]).reshape(n, s, h, d)
+        if axis_name is None or self.parallel_mode == "local":
+            o = oa.mha_forward(q, k, v, causal=self.causal)
+        elif self.parallel_mode == "ring":
+            o = oa.ring_attention(q, k, v, axis_name, causal=self.causal)
+        elif self.parallel_mode == "ulysses":
+            o = oa.ulysses_attention(q, k, v, axis_name,
+                                     causal=self.causal)
+        else:
+            raise ValueError(f"unknown parallel_mode "
+                             f"{self.parallel_mode!r}")
+        return o.reshape(n, s, h * d) @ params["wo"]
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return self._apply(params, x)
+
+    def xla_init(self):
+        self._fn = self.jit(lambda x, p: self._apply(p, x))
+        return None
+
+    def numpy_run(self) -> None:
+        # golden path: same math through jax on host (attention has no
+        # 2015-reference numpy twin to mirror; mha_forward IS the model)
+        params = {k: jnp.asarray(a.mem)
+                  for k, a in self.param_arrays().items()}
+        self.output.mem = np.asarray(self._apply(params, self.input.mem))
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {k: a.devmem(dv) for k, a in self.param_arrays().items()}
+        self.output.set_devmem(self._fn(self.input.devmem(dv), params))
+
+
+@register_gd(MultiHeadAttention)
+class GDMultiHeadAttention(GradientDescentBase):
+    """Backward via jax.vjp of the forward + fused SGD update."""
+
+    def link_forward(self, fwd: MultiHeadAttention
+                     ) -> "GDMultiHeadAttention":
+        self.link_attrs(fwd, "wq", "wk", "wv", "wo", "input", "output")
+        self._fwd = fwd
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.wq:
+            return False
+        for name in ("wq", "wk", "wv", "wo"):
+            vname = f"vel_{name}"
+            if getattr(self, vname, None) is None or not getattr(self,
+                                                                 vname):
+                arr = Array()
+                arr.reset(np.zeros(getattr(self, name).shape, np.float32))
+                setattr(self, vname, arr)
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        fwd = self._fwd
+        cfg = SGDConfig(lr=self.learning_rate,
+                        momentum=self.gradient_moment,
+                        weight_decay=self.weights_decay,
+                        l1_decay=self.l1_decay)
+
+        def step(x, params, err_y, vel, lr_scale):
+            _, vjp = jax.vjp(lambda p, xx: fwd._apply(p, xx), params, x)
+            grads, err_x = vjp(err_y)
+            new_p, new_v = sgd_update(params, grads, vel, cfg, lr_scale)
+            return err_x, new_p, new_v
+
+        self._fn = self.jit(step, donate_argnums=(3,))
+        return None
+
+    def numpy_run(self) -> None:
+        self.xla_run()  # vjp is the only backward model (no 2015 twin)
+
+    def xla_run(self) -> None:
+        dv = self.device
+        names = ("wq", "wk", "wv", "wo")
+        params = {n: getattr(self, n).devmem(dv) for n in names}
+        vel = {n: getattr(self, f"vel_{n}").devmem(dv) for n in names}
+        err_x, new_p, new_v = self._fn(
+            self.input.devmem(dv), params, self.err_output.devmem(dv),
+            vel, jnp.float32(self.lr_scale))
+        self.err_input.set_devmem(err_x)
+        for n in names:
+            getattr(self, n).set_devmem(new_p[n])
+            getattr(self, f"vel_{n}").set_devmem(new_v[n])
+
+    def __getstate__(self):
+        st = super().__getstate__()
+        st.pop("_fwd", None)
+        return st
+
+
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({"attention": MultiHeadAttention})
