@@ -1,0 +1,469 @@
+// znicz_engine: native C++ forward-only inference engine.
+//
+// Parity: the reference's libVeles/libZnicz (SURVEY.md §2.6) — a C++
+// library that loads a workflow package exported by the Python framework
+// (topology.json + weights.bin, see veles_tpu/export.py) and runs the
+// forward chain on CPU, for serving without a Python or JAX runtime.
+//
+// Scope matches the reference's: the classic znicz forward ops
+// (fully-connected, conv, max/avg pooling, LRN, activations, softmax) in
+// NHWC float32. Recurrent/attention layers are served through the
+// StableHLO/PJRT export instead (veles_tpu/export.py:export_stablehlo).
+//
+// C API (ctypes-consumed by veles_tpu/native_engine.py):
+//   void* znicz_load(const char* package_dir);
+//   int   znicz_input_size(void* h);          // flattened sample size
+//   int   znicz_output_size(void* h, int n_in);
+//   int   znicz_infer(void* h, const float* x, int n, int sample_len,
+//                     float* out, int out_cap);
+//   const char* znicz_error(void* h);
+//   void  znicz_free(void* h);
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (objects/arrays/strings/numbers/bools) — enough for
+// the manifests veles_tpu/export.py emits.
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum Kind { OBJ, ARR, STR, NUM, BOOL, NUL } kind = NUL;
+  std::map<std::string, Json> obj;
+  std::vector<Json> arr;
+  std::string str;
+  double num = 0.0;
+  bool b = false;
+
+  const Json& at(const std::string& k) const {
+    auto it = obj.find(k);
+    if (it == obj.end()) throw std::runtime_error("missing key: " + k);
+    return it->second;
+  }
+  bool has(const std::string& k) const { return obj.count(k) != 0; }
+  double numval(const std::string& k, double dflt) const {
+    return has(k) ? at(k).num : dflt;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  explicit JsonParser(const std::string& s)
+      : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip() {
+    while (p < end && (*p == ' ' || *p == '\n' || *p == '\t' || *p == '\r'))
+      ++p;
+  }
+  char peek() {
+    skip();
+    if (p >= end) throw std::runtime_error("unexpected end of json");
+    return *p;
+  }
+  void expect(char c) {
+    if (peek() != c)
+      throw std::runtime_error(std::string("expected '") + c + "'");
+    ++p;
+  }
+
+  Json parse() {
+    char c = peek();
+    if (c == '{') return parse_obj();
+    if (c == '[') return parse_arr();
+    if (c == '"') return parse_str();
+    if (c == 't' || c == 'f') return parse_bool();
+    if (c == 'n') { p += 4; return Json{}; }
+    return parse_num();
+  }
+  Json parse_obj() {
+    Json j; j.kind = Json::OBJ;
+    expect('{');
+    if (peek() == '}') { ++p; return j; }
+    while (true) {
+      Json key = parse_str();
+      expect(':');
+      j.obj[key.str] = parse();
+      if (peek() == ',') { ++p; continue; }
+      expect('}');
+      return j;
+    }
+  }
+  Json parse_arr() {
+    Json j; j.kind = Json::ARR;
+    expect('[');
+    if (peek() == ']') { ++p; return j; }
+    while (true) {
+      j.arr.push_back(parse());
+      if (peek() == ',') { ++p; continue; }
+      expect(']');
+      return j;
+    }
+  }
+  Json parse_str() {
+    Json j; j.kind = Json::STR;
+    expect('"');
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) ++p;
+      j.str += *p++;
+    }
+    expect('"');
+    return j;
+  }
+  Json parse_bool() {
+    Json j; j.kind = Json::BOOL;
+    if (*p == 't') { j.b = true; p += 4; } else { j.b = false; p += 5; }
+    return j;
+  }
+  Json parse_num() {
+    Json j; j.kind = Json::NUM;
+    char* q = nullptr;
+    j.num = std::strtod(p, &q);
+    if (q == p) throw std::runtime_error("bad number in json");
+    p = q;
+    return j;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Tensor + ops (NHWC float32)
+// ---------------------------------------------------------------------------
+
+struct Tensor {
+  std::vector<int> shape;  // leading dim = batch
+  std::vector<float> data;
+  int size() const {
+    int s = 1;
+    for (int d : shape) s *= d;
+    return s;
+  }
+};
+
+const float TANH_A = 1.7159f;
+const float TANH_B = 0.6666f;
+
+float activate(const std::string& act, float x) {
+  if (act == "linear") return x;
+  if (act == "tanh") return TANH_A * std::tanh(TANH_B * x);
+  if (act == "relu") {  // reference smooth RELU = softplus
+    if (x > 30.f) return x;
+    return std::log1p(std::exp(x));
+  }
+  if (act == "strictrelu") return x > 0.f ? x : 0.f;
+  if (act == "sigmoid") return 1.f / (1.f + std::exp(-x));
+  if (act == "log") return std::asinh(x);
+  throw std::runtime_error("unknown activation: " + act);
+}
+
+// y[n, o] = act(sum_i x[n, i] w[i, o] + b[o]); x flattened per sample.
+void all2all(const Tensor& x, const std::vector<float>& w,
+             const std::vector<float>& b, int in_dim, int out_dim,
+             const std::string& act, bool softmax, Tensor* y) {
+  int n = x.shape[0];
+  y->shape = {n, out_dim};
+  y->data.assign((size_t)n * out_dim, 0.f);
+  for (int s = 0; s < n; ++s) {
+    const float* xs = x.data.data() + (size_t)s * in_dim;
+    float* ys = y->data.data() + (size_t)s * out_dim;
+    // blocked over input for cache friendliness
+    for (int i = 0; i < in_dim; ++i) {
+      float xv = xs[i];
+      if (xv == 0.f) continue;
+      const float* wr = w.data() + (size_t)i * out_dim;
+      for (int o = 0; o < out_dim; ++o) ys[o] += xv * wr[o];
+    }
+    for (int o = 0; o < out_dim; ++o) ys[o] = activate(act, ys[o] + b[o]);
+    if (softmax) {
+      float m = ys[0];
+      for (int o = 1; o < out_dim; ++o) m = std::max(m, ys[o]);
+      float tot = 0.f;
+      for (int o = 0; o < out_dim; ++o) { ys[o] = std::exp(ys[o] - m); tot += ys[o]; }
+      for (int o = 0; o < out_dim; ++o) ys[o] /= tot;
+    }
+  }
+}
+
+// NHWC conv; w: (ky, kx, c, k) like the XLA path.
+void conv2d(const Tensor& x, const std::vector<float>& w,
+            const std::vector<float>& b, int ky, int kx, int sy, int sx,
+            int py, int px, int n_kernels, const std::string& act,
+            Tensor* y) {
+  int n = x.shape[0], h = x.shape[1], wd = x.shape[2], c = x.shape[3];
+  int oh = (h + 2 * py - ky) / sy + 1;
+  int ow = (wd + 2 * px - kx) / sx + 1;
+  y->shape = {n, oh, ow, n_kernels};
+  y->data.assign((size_t)n * oh * ow * n_kernels, 0.f);
+  for (int s = 0; s < n; ++s)
+    for (int i = 0; i < oh; ++i)
+      for (int j = 0; j < ow; ++j) {
+        float* out = y->data.data()
+            + (((size_t)s * oh + i) * ow + j) * n_kernels;
+        for (int di = 0; di < ky; ++di) {
+          int yy = i * sy + di - py;
+          if (yy < 0 || yy >= h) continue;
+          for (int dj = 0; dj < kx; ++dj) {
+            int xx = j * sx + dj - px;
+            if (xx < 0 || xx >= wd) continue;
+            const float* xin = x.data.data()
+                + (((size_t)s * h + yy) * wd + xx) * c;
+            const float* wr = w.data()
+                + (((size_t)di * kx + dj) * c) * n_kernels;
+            for (int ci = 0; ci < c; ++ci) {
+              float xv = xin[ci];
+              const float* wc = wr + (size_t)ci * n_kernels;
+              for (int k = 0; k < n_kernels; ++k) out[k] += xv * wc[k];
+            }
+          }
+        }
+        for (int k = 0; k < n_kernels; ++k)
+          out[k] = activate(act, out[k] + b[k]);
+      }
+}
+
+// ceil-mode pooling with truncated edge windows (ops.reference semantics).
+void pool2d(const Tensor& x, int ky, int kx, int sy, int sx, bool is_max,
+            bool use_abs, Tensor* y) {
+  int n = x.shape[0], h = x.shape[1], w = x.shape[2], c = x.shape[3];
+  int oh = h > ky ? (h - ky + sy - 1) / sy + 1 : 1;
+  int ow = w > kx ? (w - kx + sx - 1) / sx + 1 : 1;
+  y->shape = {n, oh, ow, c};
+  y->data.assign((size_t)n * oh * ow * c, 0.f);
+  for (int s = 0; s < n; ++s)
+    for (int i = 0; i < oh; ++i)
+      for (int j = 0; j < ow; ++j)
+        for (int ci = 0; ci < c; ++ci) {
+          int y0 = i * sy, x0 = j * sx;
+          int y1 = std::min(y0 + ky, h), x1 = std::min(x0 + kx, w);
+          float best = 0.f, sum = 0.f;
+          bool first = true;
+          int cnt = 0;
+          for (int yy = y0; yy < y1; ++yy)
+            for (int xx = x0; xx < x1; ++xx) {
+              float v = x.data[(((size_t)s * h + yy) * w + xx) * c + ci];
+              sum += v;
+              ++cnt;
+              float key = use_abs ? std::fabs(v) : v;
+              float bkey = use_abs ? std::fabs(best) : best;
+              if (first || key > bkey) { best = v; first = false; }
+            }
+          y->data[(((size_t)s * oh + i) * ow + j) * c + ci] =
+              is_max ? best : sum / cnt;
+        }
+}
+
+// AlexNet-style across-channel LRN.
+void lrn(const Tensor& x, float k, float alpha, float beta, int nwin,
+         Tensor* y) {
+  int total = x.size();
+  int c = x.shape.back();
+  int half = nwin / 2;
+  y->shape = x.shape;
+  y->data.assign(total, 0.f);
+  int rows = total / c;
+  for (int r = 0; r < rows; ++r) {
+    const float* xr = x.data.data() + (size_t)r * c;
+    float* yr = y->data.data() + (size_t)r * c;
+    for (int ci = 0; ci < c; ++ci) {
+      float ssum = 0.f;
+      for (int d = -half; d <= half; ++d) {
+        int cc = ci + d;
+        if (cc >= 0 && cc < c) ssum += xr[cc] * xr[cc];
+      }
+      yr[ci] = xr[ci] * std::pow(k + alpha * ssum, -beta);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
+
+struct Layer {
+  std::string type;
+  std::string activation = "linear";
+  bool softmax = false;
+  bool use_abs = false;
+  int ky = 0, kx = 0, sy = 1, sx = 1, py = 0, px = 0;
+  float k = 2.f, alpha = 1e-4f, beta = 0.75f;
+  int nwin = 5;
+  std::vector<int> w_shape;
+  std::vector<float> weights, bias;
+};
+
+struct Engine {
+  std::vector<Layer> layers;
+  std::vector<int> input_shape;  // per-sample
+  std::string error;
+};
+
+std::vector<float> read_blob(const std::vector<float>& pool, const Json& spec) {
+  int offset = (int)spec.at("offset").num;
+  int sz = 1;
+  for (const auto& d : spec.at("shape").arr) sz *= (int)d.num;
+  if (offset + sz > (int)pool.size())
+    throw std::runtime_error("weights.bin too small for manifest");
+  return std::vector<float>(pool.begin() + offset,
+                            pool.begin() + offset + sz);
+}
+
+Engine* load_package(const std::string& dir) {
+  auto eng = std::make_unique<Engine>();
+  std::ifstream mf(dir + "/topology.json");
+  if (!mf) throw std::runtime_error("cannot open topology.json in " + dir);
+  std::stringstream ss;
+  ss << mf.rdbuf();
+  std::string text = ss.str();
+  Json root = JsonParser(text).parse();
+  if (root.at("format").str != "veles_tpu-package-v1")
+    throw std::runtime_error("unknown package format");
+  for (const auto& d : root.at("input_shape").arr)
+    eng->input_shape.push_back((int)d.num);
+
+  std::ifstream wb(dir + "/weights.bin", std::ios::binary);
+  if (!wb) throw std::runtime_error("cannot open weights.bin in " + dir);
+  wb.seekg(0, std::ios::end);
+  size_t bytes = (size_t)wb.tellg();
+  wb.seekg(0);
+  std::vector<float> pool(bytes / sizeof(float));
+  wb.read(reinterpret_cast<char*>(pool.data()), bytes);
+
+  for (const auto& lj : root.at("layers").arr) {
+    Layer l;
+    l.type = lj.at("type").str;
+    if (lj.has("activation")) l.activation = lj.at("activation").str;
+    if (lj.has("softmax")) l.softmax = lj.at("softmax").b;
+    if (lj.has("use_abs")) l.use_abs = lj.at("use_abs").b;
+    if (lj.has("stride")) {
+      l.sy = (int)lj.at("stride").arr[0].num;
+      l.sx = (int)lj.at("stride").arr[1].num;
+    }
+    if (lj.has("padding")) {
+      l.py = (int)lj.at("padding").arr[0].num;
+      l.px = (int)lj.at("padding").arr[1].num;
+    }
+    if (lj.has("ksize")) {
+      l.ky = (int)lj.at("ksize").arr[0].num;
+      l.kx = (int)lj.at("ksize").arr[1].num;
+    }
+    l.k = (float)lj.numval("k", 2.0);
+    l.alpha = (float)lj.numval("alpha", 1e-4);
+    l.beta = (float)lj.numval("beta", 0.75);
+    l.nwin = (int)lj.numval("n", 5);
+    const auto& arrays = lj.at("arrays").arr;
+    if (!arrays.empty()) {
+      l.weights = read_blob(pool, arrays[0]);
+      for (const auto& d : arrays[0].at("shape").arr)
+        l.w_shape.push_back((int)d.num);
+      if (arrays.size() > 1) l.bias = read_blob(pool, arrays[1]);
+    }
+    eng->layers.push_back(std::move(l));
+  }
+  return eng.release();
+}
+
+void run_forward(Engine* eng, Tensor* t) {
+  for (const auto& l : eng->layers) {
+    Tensor out;
+    if (l.type == "all2all") {
+      int in_dim = l.w_shape[0], out_dim = l.w_shape[1];
+      // flatten per sample
+      Tensor flat;
+      flat.shape = {t->shape[0], t->size() / t->shape[0]};
+      flat.data = std::move(t->data);
+      if (flat.shape[1] != in_dim)
+        throw std::runtime_error("all2all input size mismatch");
+      all2all(flat, l.weights, l.bias, in_dim, out_dim, l.activation,
+              l.softmax, &out);
+    } else if (l.type == "conv") {
+      int ky = l.w_shape[0], kx = l.w_shape[1], nk = l.w_shape[3];
+      conv2d(*t, l.weights, l.bias, ky, kx, l.sy, l.sx, l.py, l.px, nk,
+             l.activation, &out);
+    } else if (l.type == "max_pooling") {
+      pool2d(*t, l.ky, l.kx, l.sy, l.sx, true, l.use_abs, &out);
+    } else if (l.type == "avg_pooling") {
+      pool2d(*t, l.ky, l.kx, l.sy, l.sx, false, false, &out);
+    } else if (l.type == "lrn") {
+      lrn(*t, l.k, l.alpha, l.beta, l.nwin, &out);
+    } else if (l.type == "activation") {
+      out.shape = t->shape;
+      out.data.resize(t->data.size());
+      for (size_t i = 0; i < t->data.size(); ++i)
+        out.data[i] = activate(l.activation, t->data[i]);
+    } else if (l.type == "identity") {
+      continue;
+    } else {
+      throw std::runtime_error("unknown layer type: " + l.type);
+    }
+    *t = std::move(out);
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* znicz_load(const char* package_dir) {
+  try {
+    return load_package(package_dir);
+  } catch (const std::exception& e) {
+    auto* eng = new Engine();
+    eng->error = e.what();
+    eng->layers.clear();
+    eng->input_shape.clear();
+    return eng;
+  }
+}
+
+const char* znicz_error(void* h) {
+  auto* eng = static_cast<Engine*>(h);
+  return eng->error.empty() ? nullptr : eng->error.c_str();
+}
+
+int znicz_input_size(void* h) {
+  auto* eng = static_cast<Engine*>(h);
+  int s = 1;
+  for (int d : eng->input_shape) s *= d;
+  return s;
+}
+
+// Run n samples of sample_len floats; writes n * out_dim floats into out.
+// Returns the per-sample output size, or -1 on error.
+int znicz_infer(void* h, const float* x, int n, int sample_len, float* out,
+                int out_cap) {
+  auto* eng = static_cast<Engine*>(h);
+  try {
+    Tensor t;
+    t.shape.push_back(n);
+    for (int d : eng->input_shape) t.shape.push_back(d);
+    if (t.size() != n * sample_len)
+      throw std::runtime_error("sample_len does not match input_shape");
+    t.data.assign(x, x + (size_t)n * sample_len);
+    run_forward(eng, &t);
+    int out_dim = t.size() / n;
+    if (n * out_dim > out_cap)
+      throw std::runtime_error("output buffer too small");
+    std::memcpy(out, t.data.data(), sizeof(float) * (size_t)n * out_dim);
+    return out_dim;
+  } catch (const std::exception& e) {
+    eng->error = e.what();
+    return -1;
+  }
+}
+
+void znicz_free(void* h) { delete static_cast<Engine*>(h); }
+
+}  // extern "C"

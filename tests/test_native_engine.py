@@ -1,0 +1,117 @@
+"""Native C++ inference engine (libVeles/libZnicz slot, SURVEY.md §2.6):
+exported packages load in C++ and reproduce the Python golden forward
+bit-closely for FC and conv/pool/LRN stacks; StableHLO export emits a
+servable module."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.export import export_stablehlo, export_workflow
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def build_wf(layers, sample_shape, n_classes=5):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=n_classes, sample_shape=sample_shape, n_validation=50,
+        n_train=100, minibatch_size=25, noise=0.5)
+    wf = StandardWorkflow(
+        layers=layers, loader=loader, loss="softmax", n_classes=n_classes,
+        decision_config={"max_epochs": 1, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1},
+        name="NativeTest")
+    wf.initialize(device=NumpyDevice())
+    return wf
+
+
+def python_forward(wf, x):
+    """Golden: run the granular numpy forward chain on a batch."""
+    wf.loader.minibatch_data.reset(x.astype(np.float32))
+    for fwd in wf.forwards:
+        fwd.run()
+    return np.asarray(wf.forwards[-1].output.mem)
+
+
+def test_fc_package_matches_golden(tmp_path):
+    wf = build_wf(
+        [{"type": "all2all_tanh", "output_sample_shape": 16,
+          "weights_stddev": 0.05},
+         {"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(6, 6))
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    assert os.path.exists(os.path.join(pkg, "topology.json"))
+    assert os.path.exists(os.path.join(pkg, "weights.bin"))
+
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(0).randn(7, 6, 6).astype(np.float32)
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        assert eng.input_size == 36
+        got = eng.infer(x)
+    assert got.shape == gold.shape
+    np.testing.assert_allclose(got, gold, rtol=1e-4, atol=1e-5)
+    # softmax rows sum to 1
+    np.testing.assert_allclose(got.sum(1), 1.0, rtol=1e-5)
+
+
+def test_conv_package_matches_golden(tmp_path):
+    wf = build_wf(
+        [{"type": "conv_strictrelu", "n_kernels": 6, "kx": 3, "ky": 3,
+          "padding": (1, 1), "weights_stddev": 0.05},
+         {"type": "max_pooling", "ksize": (2, 2)},
+         {"type": "lrn"},
+         {"type": "conv_tanh", "n_kernels": 4, "kx": 3, "ky": 3,
+          "stride": (2, 2), "weights_stddev": 0.05},
+         {"type": "avg_pooling", "ksize": (2, 2)},
+         {"type": "all2all_relu", "output_sample_shape": 12,
+          "weights_stddev": 0.05},
+         {"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(12, 12, 3))
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(1).randn(4, 12, 12, 3).astype(np.float32)
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    np.testing.assert_allclose(got, gold, rtol=2e-4, atol=2e-5)
+
+
+def test_dropout_exports_as_identity(tmp_path):
+    wf = build_wf(
+        [{"type": "all2all_tanh", "output_sample_shape": 8,
+          "weights_stddev": 0.05},
+         {"type": "dropout", "dropout_ratio": 0.5},
+         {"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(4, 4))
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(2).randn(3, 4, 4).astype(np.float32)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    # identity dropout at inference: rows are valid distributions
+    np.testing.assert_allclose(got.sum(1), 1.0, rtol=1e-5)
+
+
+def test_stablehlo_export(tmp_path):
+    wf = build_wf(
+        [{"type": "all2all_tanh", "output_sample_shape": 8,
+          "weights_stddev": 0.05},
+         {"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(4, 4))
+    path = export_stablehlo(wf, str(tmp_path / "fwd.mlir"), batch=2)
+    text = open(path).read()
+    assert "stablehlo" in text and "dot" in text

@@ -1,0 +1,144 @@
+"""Forward-export: package a trained workflow for the native engine.
+
+Parity: the reference's export path (SURVEY.md §3.4) — a trained snapshot's
+forward chain becomes a portable package (topology manifest + weight
+arrays) consumed by the C++ libVeles/libZnicz inference engine. Same
+design here: `topology.json` describes the forward layers; `weights.bin`
+holds raw little-endian float32 blobs addressed by (offset, shape) in the
+manifest. The C++ twin lives in `native/znicz_engine.cpp`.
+
+Also exports StableHLO (the PJRT-era equivalent noted in SURVEY.md §2.6):
+`export_stablehlo` serializes the jitted fused forward so any PJRT C-API
+plugin can execute the exact compiled computation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+#: unit-class name -> exporter; each returns (layer_dict, [arrays to pack])
+_EXPORTERS = {}
+
+
+def _exporter(*class_names: str):
+    def deco(fn):
+        for n in class_names:
+            _EXPORTERS[n] = fn
+        return fn
+    return deco
+
+
+@_exporter("All2All", "All2AllTanh", "All2AllRELU", "All2AllStrictRELU",
+           "All2AllSigmoid")
+def _export_all2all(u) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+    return ({"type": "all2all", "activation": u.activation},
+            [u.weights.mem, u.bias.mem])
+
+
+@_exporter("All2AllSoftmax")
+def _export_softmax(u):
+    return ({"type": "all2all", "activation": "linear", "softmax": True},
+            [u.weights.mem, u.bias.mem])
+
+
+@_exporter("Conv", "ConvTanh", "ConvRELU", "ConvStrictRELU", "ConvSigmoid")
+def _export_conv(u):
+    return ({"type": "conv", "activation": u.activation,
+             "stride": list(u.stride), "padding": list(u.padding)},
+            [u.weights.mem, u.bias.mem])
+
+
+@_exporter("MaxPooling", "MaxAbsPooling")
+def _export_maxpool(u):
+    return ({"type": "max_pooling", "ksize": list(u.ksize),
+             "stride": list(u.stride),
+             "use_abs": bool(getattr(u, "use_abs", False))}, [])
+
+
+@_exporter("AvgPooling")
+def _export_avgpool(u):
+    return ({"type": "avg_pooling", "ksize": list(u.ksize),
+             "stride": list(u.stride)}, [])
+
+
+@_exporter("LRNormalizerForward")
+def _export_lrn(u):
+    return ({"type": "lrn", "k": u.k, "alpha": u.alpha, "beta": u.beta,
+             "n": u.n}, [])
+
+
+@_exporter("DropoutForward")
+def _export_dropout(u):
+    # inference: dropout is identity (the reference exported it the same way)
+    return ({"type": "identity"}, [])
+
+
+@_exporter("ActivationTanh", "ActivationRELU", "ActivationStrictRELU",
+           "ActivationSigmoid", "ActivationLog")
+def _export_activation(u):
+    return ({"type": "activation", "activation": u.activation}, [])
+
+
+def export_workflow(workflow, directory: str) -> str:
+    """Write topology.json + weights.bin for the workflow's forward chain.
+    Returns the package directory. Raises on layers with no native twin
+    (LSTM/attention are jit/StableHLO-served, not C++-served — documented
+    non-goal matching the reference's CPU-forward-only libZnicz)."""
+    os.makedirs(directory, exist_ok=True)
+    blobs: List[np.ndarray] = []
+    layers: List[Dict[str, Any]] = []
+    for u in workflow.forwards:
+        name = type(u).__name__
+        if name not in _EXPORTERS:
+            raise ValueError(
+                f"no native exporter for unit {name}; export the fused "
+                "forward via export_stablehlo instead")
+        spec, arrays = _EXPORTERS[name](u)
+        offset = sum(int(a.size) for a in blobs)
+        packed = []
+        for a in arrays:
+            a = np.ascontiguousarray(a, np.float32)
+            packed.append({"offset": offset, "shape": list(a.shape)})
+            offset += int(a.size)
+            blobs.append(a)
+        spec["arrays"] = packed
+        layers.append(spec)
+    manifest = {
+        "format": "veles_tpu-package-v1",
+        "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
+        "layers": layers,
+    }
+    with open(os.path.join(directory, "topology.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    with open(os.path.join(directory, "weights.bin"), "wb") as f:
+        for a in blobs:
+            f.write(a.astype("<f4").tobytes())
+    return directory
+
+
+def export_stablehlo(workflow, path: str, batch: int = 1) -> str:
+    """Serialize the jitted fused eval forward as portable StableHLO
+    bytes — the PJRT-C-API serving slot (SURVEY.md §2.6 libVeles row)."""
+    import jax
+    import jax.numpy as jnp
+
+    step = workflow.build_fused_step()
+    state = step.init_state()
+    shape = (batch,) + tuple(workflow.loader.minibatch_data.shape[1:])
+
+    def fwd(params, x):
+        return step._forward(params, x, jax.random.PRNGKey(0), False)
+
+    lowered = jax.jit(fwd).lower(
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            state["params"]),
+        jax.ShapeDtypeStruct(shape, jnp.float32))
+    text = lowered.as_text(dialect="stablehlo")
+    with open(path, "w") as f:
+        f.write(text)
+    return path

@@ -1,0 +1,98 @@
+"""ctypes wrapper over the native C++ forward engine.
+
+Parity: the consumer side of the reference's libVeles/libZnicz export path
+(SURVEY.md §2.6, §3.4): load a package written by `veles_tpu.export
+.export_workflow` and run CPU inference with no JAX in the loop. The
+shared library builds on demand from `native/znicz_engine.cpp` (g++, no
+third-party deps) and is cached under `native/build/`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libznicz.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the engine if the cached .so is missing or stale."""
+    src = os.path.join(_NATIVE_DIR, "znicz_engine.cpp")
+    if force or not os.path.exists(_LIB_PATH) or \
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(src):
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
+    return _LIB_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        lib.znicz_load.restype = ctypes.c_void_p
+        lib.znicz_load.argtypes = [ctypes.c_char_p]
+        lib.znicz_error.restype = ctypes.c_char_p
+        lib.znicz_error.argtypes = [ctypes.c_void_p]
+        lib.znicz_input_size.restype = ctypes.c_int
+        lib.znicz_input_size.argtypes = [ctypes.c_void_p]
+        lib.znicz_infer.restype = ctypes.c_int
+        lib.znicz_infer.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.znicz_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class NativeEngine:
+    """Forward-only inference over an exported package directory."""
+
+    def __init__(self, package_dir: str) -> None:
+        self._lib = _load_lib()
+        self._h = self._lib.znicz_load(package_dir.encode())
+        err = self._lib.znicz_error(self._h)
+        if err:
+            msg = err.decode()
+            self.close()
+            raise RuntimeError(f"znicz_load: {msg}")
+        self.input_size = self._lib.znicz_input_size(self._h)
+
+    def infer(self, x: np.ndarray, out_dim_hint: int = 65536) -> np.ndarray:
+        """x: (N, ...) float32 — returns (N, out_dim)."""
+        x = np.ascontiguousarray(x, np.float32)
+        n = x.shape[0]
+        sample_len = int(np.prod(x.shape[1:]))
+        out = np.empty(n * out_dim_hint, np.float32)
+        res = self._lib.znicz_infer(
+            self._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, sample_len,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size)
+        if res < 0:
+            raise RuntimeError(
+                f"znicz_infer: {self._lib.znicz_error(self._h).decode()}")
+        return out[:n * res].reshape(n, res).copy()
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.znicz_free(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
