@@ -13,9 +13,9 @@
 // C API (ctypes-consumed by veles_tpu/native_engine.py):
 //   void* znicz_load(const char* package_dir);
 //   int   znicz_input_size(void* h);          // flattened sample size
-//   int   znicz_output_size(void* h, int n_in);
+//   int   znicz_output_size(void* h);       // flattened per-sample output
 //   int   znicz_infer(void* h, const float* x, int n, int sample_len,
-//                     float* out, int out_cap);
+//                     float* out, long long out_cap);
 //   const char* znicz_error(void* h);
 //   void  znicz_free(void* h);
 
@@ -440,12 +440,30 @@ int znicz_input_size(void* h) {
   return s;
 }
 
+// Per-sample output size, computed by pushing one zero sample through the
+// chain (exact shape inference; cheap relative to any real batch).
+int znicz_output_size(void* h) {
+  auto* eng = static_cast<Engine*>(h);
+  try {
+    Tensor t;
+    t.shape.push_back(1);
+    for (int d : eng->input_shape) t.shape.push_back(d);
+    t.data.assign(t.size(), 0.f);
+    run_forward(eng, &t);
+    return t.size();
+  } catch (const std::exception& e) {
+    eng->error = e.what();
+    return -1;
+  }
+}
+
 // Run n samples of sample_len floats; writes n * out_dim floats into out.
 // Returns the per-sample output size, or -1 on error.
 int znicz_infer(void* h, const float* x, int n, int sample_len, float* out,
-                int out_cap) {
+                long long out_cap) {
   auto* eng = static_cast<Engine*>(h);
   try {
+    if (n <= 0) throw std::runtime_error("batch must be positive");
     Tensor t;
     t.shape.push_back(n);
     for (int d : eng->input_shape) t.shape.push_back(d);
@@ -454,7 +472,7 @@ int znicz_infer(void* h, const float* x, int n, int sample_len, float* out,
     t.data.assign(x, x + (size_t)n * sample_len);
     run_forward(eng, &t);
     int out_dim = t.size() / n;
-    if (n * out_dim > out_cap)
+    if ((long long)n * out_dim > out_cap)
       throw std::runtime_error("output buffer too small");
     std::memcpy(out, t.data.data(), sizeof(float) * (size_t)n * out_dim);
     return out_dim;

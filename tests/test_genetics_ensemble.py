@@ -1,0 +1,84 @@
+"""Genetics GA + Ensemble meta-layer (SURVEY.md §2.5): the GA minimizes a
+workflow-backed fitness over config space; the ensemble's averaged
+prediction is no worse than its mean member."""
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.ensemble import Ensemble
+from veles_tpu.genetics import Chromosome, Population, Tune
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def _make_wf(lr, hidden, seed=1234, max_epochs=2):
+    prng.seed_all(seed)
+    loader = SyntheticClassifierLoader(
+        n_classes=5, sample_shape=(6, 6), n_validation=50, n_train=200,
+        minibatch_size=50, noise=0.5)
+    wf = StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": int(hidden),
+                 "weights_stddev": 0.05},
+                {"type": "softmax", "output_sample_shape": 5,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=5,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": float(lr), "gradient_moment": 0.9},
+        name="GATest")
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    return wf
+
+
+def test_ga_on_analytic_fitness():
+    """Pure-GA sanity: minimize (log-lr − log-0.1)² + (h − 24)² — the GA
+    must land near the optimum within a few generations."""
+    tun = [Tune("gd.learning_rate", 1e-3, 1.0, log=True),
+           Tune("layers.hidden", 8, 64, integer=True)]
+
+    def fitness(ov):
+        return (np.log(ov["gd.learning_rate"] / 0.1) ** 2
+                + ((ov["layers.hidden"] - 24) / 40) ** 2)
+
+    prng.seed_all(99)
+    pop = Population(tun, fitness, size=16, elite=2, max_workers=1)
+    best = pop.evolve(generations=8)
+    assert best.fitness < 0.3, (best.fitness, best.values)
+    assert 0.02 < best.overrides(tun)["gd.learning_rate"] < 0.5
+    # history is monotone non-increasing (elites preserved)
+    fits = [f for _, f in pop.history]
+    assert all(b <= a + 1e-12 for a, b in zip(fits, fits[1:]))
+
+
+def test_ga_over_real_workflow_runs():
+    """One tiny generation over a REAL workflow fitness (validation
+    errors): exercises the full loop end-to-end."""
+    tun = [Tune("lr", 0.01, 0.5, log=True)]
+
+    calls = []
+
+    def fitness(ov):
+        wf = _make_wf(ov["lr"], 16, max_epochs=1)
+        calls.append(ov["lr"])
+        return wf.decision.best_validation_err
+
+    prng.seed_all(7)
+    pop = Population(tun, fitness, size=3, elite=1, max_workers=1)
+    best = pop.evolve(generations=1)
+    assert best.fitness is not None
+    assert len(calls) >= 3
+
+
+def test_ensemble_beats_or_matches_mean_member():
+    ens = Ensemble(lambda seed: _make_wf(0.1, 16, seed=seed,
+                                         max_epochs=2),
+                   seeds=(11, 22, 33)).train()
+    # fresh eval batch from the SAME distribution (loader data, valid part)
+    wf0 = ens.members[0]
+    data = wf0.loader.data.mem[:50]
+    labels = wf0.loader.labels.mem[:50]
+    res = ens.evaluate(data, labels)
+    assert res["n_samples"] == 50
+    mean_member = np.mean(res["member_errs"])
+    assert res["n_err"] <= mean_member + 2, res

@@ -1,0 +1,61 @@
+"""Pallas kernels vs golden models (interpreter mode on CPU — SURVEY.md §4
+cross-backend strategy applied to hand-written kernels): fused SGD update,
+LRN fwd/bwd, blocked flash attention."""
+
+import numpy as np
+import pytest
+
+import veles_tpu.ops.pallas_kernels as pk
+from veles_tpu.ops import attention as oa
+from veles_tpu.ops import reference as ref
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    pk._FORCE_INTERPRET = True
+    yield
+    pk._FORCE_INTERPRET = False
+
+
+def test_sgd_update_matches_host_math():
+    rng = np.random.RandomState(0)
+    p = rng.randn(33, 17).astype(np.float32)   # deliberately unaligned
+    g = rng.randn(33, 17).astype(np.float32)
+    v = rng.randn(33, 17).astype(np.float32)
+    lr, mom, wd = 0.05, 0.9, 1e-3
+    g_eff = g + wd * p
+    v_gold = mom * v - lr * g_eff
+    p_gold = p + v_gold
+    p_new, v_new = pk.sgd_update_pallas(p, g, v, lr, mom, wd)
+    np.testing.assert_allclose(np.asarray(p_new), p_gold, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_new), v_gold, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_lrn_forward_matches_golden():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 5, 16).astype(np.float32)
+    gold = ref.lrn_forward(x, 2.0, 1e-4, 0.75, 5)
+    got = np.asarray(pk.lrn_forward_pallas(x, 2.0, 1e-4, 0.75, 5))
+    np.testing.assert_allclose(got, gold, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_backward_matches_golden():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 4, 16).astype(np.float32)
+    err = rng.randn(2, 4, 4, 16).astype(np.float32)
+    gold = ref.lrn_backward(x, err, 2.0, 1e-4, 0.75, 5)
+    got = np.asarray(pk.lrn_backward_pallas(x, err, 2.0, 1e-4, 0.75, 5))
+    np.testing.assert_allclose(got, gold, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_golden(causal):
+    rng = np.random.RandomState(3)
+    b, s, h, d = 2, 32, 2, 8
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+    gold = np.asarray(oa.mha_forward(q, k, v, causal=causal))
+    got = np.asarray(pk.flash_attention_pallas(q, k, v, causal=causal,
+                                               blk_q=16, blk_k=16))
+    np.testing.assert_allclose(got, gold, rtol=2e-4, atol=2e-5)

@@ -1,0 +1,59 @@
+"""Ensemble training & evaluation.
+
+Parity: reference `veles/ensemble/` (SURVEY.md §2.5) — train N instances
+of a workflow (different seeds / config jitter), then serve the averaged
+prediction. Population-parallel like genetics: each member is an
+independent full run (trivially maps onto independent TPU slices —
+SURVEY.md §2.4 checklist).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from veles_tpu.logger import Logger
+
+
+class Ensemble(Logger):
+    """`factory(seed) -> trained workflow` is called per member; members
+    expose their forward chain for averaged inference."""
+
+    def __init__(self, factory: Callable[[int], Any],
+                 seeds: Sequence[int] = (1, 2, 3)) -> None:
+        super().__init__()
+        self.factory = factory
+        self.seeds = list(seeds)
+        self.members: List[Any] = []
+
+    def train(self) -> "Ensemble":
+        for seed in self.seeds:
+            self.info("training member seed=%d", seed)
+            self.members.append(self.factory(seed))
+        return self
+
+    def _member_outputs(self, x: np.ndarray) -> List[np.ndarray]:
+        assert self.members, "train() first"
+        outs = []
+        for wf in self.members:
+            wf.loader.minibatch_data.reset(np.asarray(x, np.float32))
+            for fwd in wf.forwards:
+                fwd.run()
+            outs.append(np.asarray(wf.forwards[-1].output.mem).copy())
+        return outs
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Averaged forward output (probabilities for softmax heads)."""
+        outs = self._member_outputs(x)
+        return sum(outs) / len(outs)
+
+    def evaluate(self, x: np.ndarray, labels: np.ndarray) -> Dict[str, Any]:
+        """One forward pass per member; ensemble and per-member errors
+        both derive from the same outputs."""
+        outs = self._member_outputs(x)
+        probs = sum(outs) / len(outs)
+        n_err = int((probs.argmax(axis=1) != labels).sum())
+        member_errs = [int((p.argmax(1) != labels).sum()) for p in outs]
+        return {"n_err": n_err, "member_errs": member_errs,
+                "n_samples": len(labels)}

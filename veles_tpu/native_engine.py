@@ -43,10 +43,13 @@ def _load_lib() -> ctypes.CDLL:
         lib.znicz_error.argtypes = [ctypes.c_void_p]
         lib.znicz_input_size.restype = ctypes.c_int
         lib.znicz_input_size.argtypes = [ctypes.c_void_p]
+        lib.znicz_output_size.restype = ctypes.c_int
+        lib.znicz_output_size.argtypes = [ctypes.c_void_p]
         lib.znicz_infer.restype = ctypes.c_int
         lib.znicz_infer.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
-            ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_longlong]
         lib.znicz_free.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
@@ -64,13 +67,20 @@ class NativeEngine:
             self.close()
             raise RuntimeError(f"znicz_load: {msg}")
         self.input_size = self._lib.znicz_input_size(self._h)
+        self.output_size = self._lib.znicz_output_size(self._h)
+        if self.output_size < 0:
+            msg = self._lib.znicz_error(self._h).decode()
+            self.close()
+            raise RuntimeError(f"znicz_output_size: {msg}")
 
-    def infer(self, x: np.ndarray, out_dim_hint: int = 65536) -> np.ndarray:
-        """x: (N, ...) float32 — returns (N, out_dim)."""
+    def infer(self, x: np.ndarray) -> np.ndarray:
+        """x: (N, ...) float32 — returns (N, output_size)."""
         x = np.ascontiguousarray(x, np.float32)
         n = x.shape[0]
+        if n == 0:
+            return np.empty((0, self.output_size), np.float32)
         sample_len = int(np.prod(x.shape[1:]))
-        out = np.empty(n * out_dim_hint, np.float32)
+        out = np.empty(n * self.output_size, np.float32)
         res = self._lib.znicz_infer(
             self._h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             n, sample_len,

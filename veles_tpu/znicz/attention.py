@@ -31,12 +31,17 @@ class MultiHeadAttention(Forward):
 
     def __init__(self, workflow=None, n_heads: int = 4,
                  head_dim: int = None, causal: bool = True,
-                 parallel_mode: str = "local", **kwargs: Any) -> None:
+                 parallel_mode: str = "local",
+                 use_flash: str = "auto", **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.n_heads = n_heads
         self.head_dim = head_dim
         self.causal = causal
         self.parallel_mode = parallel_mode
+        #: "auto": the Pallas flash kernel on TPU when S is long enough to
+        #: beat the XLA einsum (and divisible into blocks); "on"/"off"
+        #: force it. See ops/pallas_kernels.flash_attention_pallas.
+        self.use_flash = use_flash
         self.wq = Array()
         self.wk = Array()
         self.wv = Array()
@@ -62,16 +67,33 @@ class MultiHeadAttention(Forward):
             self.output.reset(np.zeros((n, s, e), np.float32))
         return super().initialize(device=device, **kwargs)
 
+    def _flash_ok(self, s: int) -> bool:
+        if self.use_flash == "off":
+            return False
+        from veles_tpu.ops import pallas_kernels as pk
+        if self.use_flash == "on":
+            return True
+        # auto: long sequences on a real TPU; the kernel fits its blocks
+        # to any S divisible by 128
+        return pk.available() and s >= 4096 and s % 128 == 0
+
     # -- pure forward ---------------------------------------------------------
 
-    def _apply(self, params, x, axis_name=None):
+    def _apply(self, params, x, axis_name=None, allow_flash=False):
         n, s, e = x.shape
         h, d = self.n_heads, self.head_dim
         q = (x @ params["wq"]).reshape(n, s, h, d)
         k = (x @ params["wk"]).reshape(n, s, h, d)
         v = (x @ params["wv"]).reshape(n, s, h, d)
         if axis_name is None or self.parallel_mode == "local":
-            o = oa.mha_forward(q, k, v, causal=self.causal)
+            # the Pallas kernel has no VJP: inference-only paths opt in
+            # (granular xla_run); the differentiated fused/GD paths use
+            # the einsum form, which jax.grad handles
+            if allow_flash and self._flash_ok(s):
+                from veles_tpu.ops import pallas_kernels as pk
+                o = pk.flash_attention_pallas(q, k, v, causal=self.causal)
+            else:
+                o = oa.mha_forward(q, k, v, causal=self.causal)
         elif self.parallel_mode == "ring":
             o = oa.ring_attention(q, k, v, axis_name, causal=self.causal)
         elif self.parallel_mode == "ulysses":
@@ -86,7 +108,8 @@ class MultiHeadAttention(Forward):
         return self._apply(params, x)
 
     def xla_init(self):
-        self._fn = self.jit(lambda x, p: self._apply(p, x))
+        self._fn = self.jit(lambda x, p: self._apply(p, x,
+                                                     allow_flash=True))
         return None
 
     def numpy_run(self) -> None:
