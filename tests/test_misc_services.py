@@ -1,0 +1,136 @@
+"""lr_adjust policies, misc units (accumulator/histogram/zero-filler/
+image-saver), forge packaging, and the scaling-efficiency harness
+(SURVEY.md §2.5, §2.8)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.forge import Forge
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.lr_adjust import LearningRateAdjust
+from veles_tpu.znicz.misc_units import (Accumulator, ImageSaver,
+                                        MultiHistogram, ZeroFiller)
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build(max_epochs=2, **gd):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=5, sample_shape=(6, 6), n_validation=50, n_train=200,
+        minibatch_size=50, noise=0.5)
+    return StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.05},
+                {"type": "softmax", "output_sample_shape": 5,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=5,
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9, **gd},
+        name="MiscTest")
+
+
+def test_lr_policies_math():
+    from veles_tpu.znicz.lr_adjust import (exp_policy, inv_policy,
+                                           step_policy)
+    assert step_policy(1.0, 0.5, 10)(25) == 0.25
+    assert abs(exp_policy(1.0, 0.9)(2) - 0.81) < 1e-12
+    assert abs(inv_policy(1.0, 1.0, 1.0)(3) - 0.25) < 1e-12
+
+
+def test_lr_adjust_drives_gd_scale_in_workflow():
+    wf = build(max_epochs=2)
+    lr = LearningRateAdjust(wf, policy="exp", gamma=0.9)
+    lr.link_gds(wf.gds)
+    # splice INTO the loop (repeater is an OR-gate: adding a second
+    # loop-back edge would double-fire it): ... gds[-1] -> lr -> repeater
+    wf.repeater.unlink_from(wf.gds[-1])
+    lr.link_from(wf.gds[-1])
+    wf.repeater.link_from(lr)
+    lr.gate_skip = wf.loader.not_train  # iterations = train minibatches
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    # 2 epochs x 4 train minibatches, minus the final cycle (end_point
+    # stops the pump before the last chain tail drains — same convention
+    # as the gd run_count assertions in test_mnist_functional)
+    assert lr.iteration == 7
+    assert wf.gds[0].lr_scale == pytest.approx(0.9 ** 6)
+
+
+def test_accumulator_histogram_zerofiller():
+    wf = build(max_epochs=1)
+    acc = Accumulator(wf)
+    acc.link_attrs(wf.evaluator, ("input", "loss"))
+    acc.link_from(wf.evaluator)
+    hist = MultiHistogram(wf, n_bins=8)
+    hist.link_attrs(wf.forwards[0], ("input", "weights"))
+    hist.link_from(wf.decision)
+    wf.end_point.link_from(acc, hist)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert len(acc.values) == wf.evaluator.run_count
+    assert hist.hist is not None and hist.hist.sum() == 16 * 36
+
+    zf = ZeroFiller()
+    zf.weights = wf.forwards[0].weights
+    zf.mask = np.zeros((36, 16), bool)
+    zf.mask[0, :] = True
+    zf.run()
+    assert np.all(wf.forwards[0].weights.mem[0] == 0.0)
+
+
+def test_image_saver_dumps_misclassified(tmp_path):
+    wf = build(max_epochs=1)
+    saver = ImageSaver(wf, directory=str(tmp_path / "bad"), limit=10)
+    saver.link_attrs(wf.loader, ("input", "minibatch_data"),
+                     ("labels", "minibatch_labels"))
+    saver.link_attrs(wf.forwards[-1], "max_idx")
+    saver.link_from(wf.evaluator)
+    wf.end_point.link_from(saver)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    files = os.listdir(tmp_path / "bad")
+    assert 0 < len(files) <= 10
+    assert all("_as_" in f for f in files)
+
+
+def test_forge_publish_list_fetch(tmp_path):
+    wf = build(max_epochs=1)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    zoo = Forge(str(tmp_path / "zoo"))
+    zoo.publish(wf, "misc-test", author="ci",
+                description="tiny fc softmax")
+    entries = zoo.list()
+    assert len(entries) == 1
+    assert entries[0]["name"] == "misc-test"
+    assert entries[0]["metrics"]["epochs"] == 1
+    manifest, restored = zoo.fetch("misc-test")
+    assert manifest["workflow_class"] == "StandardWorkflow"
+    assert restored.decision.epoch_number == 1
+
+
+def test_scaling_harness_single_device_honest():
+    from veles_tpu.parallel.distributed import scaling_efficiency
+    import jax
+    wf = build(max_epochs=1)
+    wf.initialize(device=None)
+    res = scaling_efficiency(wf, mesh_devices=jax.devices()[:1],
+                             batch_per_chip=50, warmup=1, steps=3)
+    assert res["trivial"] is True
+    assert res["scaling_efficiency"] == pytest.approx(1.0)
+    assert res["samples_per_sec_per_chip_1"] > 0
+
+
+def test_scaling_harness_multi_device(eight_devices):
+    from veles_tpu.parallel.distributed import scaling_efficiency
+    wf = build(max_epochs=1)
+    wf.initialize(device=None)
+    res = scaling_efficiency(wf, mesh_devices=eight_devices[:4],
+                             batch_per_chip=52, warmup=1, steps=3)
+    assert res["chips"] == 4
+    assert res["trivial"] is False
+    assert res["samples_per_sec_per_chip_n"] > 0

@@ -1,0 +1,96 @@
+"""Forge: model-zoo packaging (pack / publish / fetch workflows).
+
+Parity: reference `veles/forge_client.py` + VelesForge service (SURVEY.md
+§2.5 [M]) — package a trained workflow (snapshot + metadata + manifest)
+and exchange it through a zoo. The reference's zoo was a remote HTTP
+service; this environment is zero-egress, so the transport is a
+filesystem directory (local path or network mount) with the same
+package format and the same publish/fetch verbs — pointing `zoo` at an
+HTTP mirror is a transport swap, not a format change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tarfile
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from veles_tpu.snapshotter import Snapshotter
+
+MANIFEST = "forge.json"
+
+
+def pack(workflow, path: str, name: str, author: str = "",
+         description: str = "") -> str:
+    """Write `<path>` (a .tar.gz forge package): snapshot + manifest."""
+    dec = getattr(workflow, "decision", None)
+    manifest: Dict[str, Any] = {
+        "format": "veles_tpu-forge-v1",
+        "name": name,
+        "author": author,
+        "description": description,
+        "workflow_class": type(workflow).__name__,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "metrics": {
+            "best_validation_err": getattr(dec, "best_validation_err",
+                                           None),
+            "epochs": getattr(dec, "epoch_number", None),
+        },
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        snap = Snapshotter(prefix=name, directory=tmp, compression="gz")
+        snap.workflow = workflow
+        snap_path = snap.export()
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+        with tarfile.open(path, "w:gz") as tar:
+            tar.add(snap_path, arcname="workflow.pickle.gz")
+            tar.add(os.path.join(tmp, MANIFEST), arcname=MANIFEST)
+    return path
+
+
+def unpack(path: str, restore: bool = True):
+    """Returns (manifest, workflow-or-None)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        with tarfile.open(path, "r:gz") as tar:
+            tar.extractall(tmp, filter="data")
+        with open(os.path.join(tmp, MANIFEST)) as f:
+            manifest = json.load(f)
+        wf = None
+        if restore:
+            wf = Snapshotter.import_(
+                os.path.join(tmp, "workflow.pickle.gz"))
+    return manifest, wf
+
+
+class Forge:
+    """A zoo directory of forge packages."""
+
+    def __init__(self, zoo: str) -> None:
+        self.zoo = zoo
+        os.makedirs(zoo, exist_ok=True)
+
+    def publish(self, workflow, name: str, **meta: Any) -> str:
+        dest = os.path.join(self.zoo, f"{name}.forge.tar.gz")
+        pack(workflow, dest, name, **meta)
+        return dest
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = []
+        for f in sorted(os.listdir(self.zoo)):
+            if f.endswith(".forge.tar.gz"):
+                manifest, _ = unpack(os.path.join(self.zoo, f),
+                                     restore=False)
+                out.append(manifest)
+        return out
+
+    def fetch(self, name: str):
+        """Returns (manifest, restored workflow)."""
+        path = os.path.join(self.zoo, f"{name}.forge.tar.gz")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no package {name!r} in {self.zoo}")
+        return unpack(path)

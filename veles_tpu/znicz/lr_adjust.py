@@ -1,0 +1,90 @@
+"""Learning-rate scheduling unit.
+
+Parity: reference `veles/znicz/lr_adjust.py` (SURVEY.md §2.8 [M]) —
+step/exp/inv policies applied to the GD units' learning rate over
+training iterations.
+
+TPU-first: the GD units (and FusedTrainStep) read a runtime `lr_scale`
+multiplier that is a TRACED scalar in the compiled step, so schedule
+changes never retrace/recompile — the reference re-set a kernel argument,
+we re-set one device scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from veles_tpu.units import Unit
+
+
+def step_policy(base: float, gamma: float, step: int):
+    """lr(it) = base · gamma^floor(it/step)."""
+    return lambda it: base * (gamma ** (it // step))
+
+
+def exp_policy(base: float, gamma: float):
+    """lr(it) = base · gamma^it."""
+    return lambda it: base * (gamma ** it)
+
+
+def inv_policy(base: float, gamma: float, power: float):
+    """lr(it) = base / (1 + gamma·it)^power (the Caffe-era 'inv')."""
+    return lambda it: base / ((1.0 + gamma * it) ** power)
+
+
+_POLICIES = {"step": step_policy, "exp": exp_policy, "inv": inv_policy}
+
+
+class LearningRateAdjust(Unit):
+    """Applies a policy to every linked GD unit's `lr_scale` each firing
+    (wire it after the gradient chain; one firing per training
+    minibatch = one 'iteration' like the reference)."""
+
+    def __init__(self, workflow=None, policy: str = "exp",
+                 base: float = 1.0, gamma: float = 0.999,
+                 step: int = 100, power: float = 0.75,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"unknown lr policy {policy!r}; one of {sorted(_POLICIES)}")
+        self.policy_name = policy
+        if policy == "step":
+            self._policy = step_policy(base, gamma, step)
+        elif policy == "exp":
+            self._policy = exp_policy(base, gamma)
+        else:
+            self._policy = inv_policy(base, gamma, power)
+        self._cfg = (policy, base, gamma, step, power)
+        self.iteration = 0
+        self.gd_units: list = []
+
+    def link_gds(self, gds: Iterable[Unit]) -> "LearningRateAdjust":
+        self.gd_units = list(gds)
+        return self
+
+    @property
+    def current_scale(self) -> float:
+        return float(self._policy(self.iteration))
+
+    def run(self) -> None:
+        scale = self.current_scale
+        for g in self.gd_units:
+            g.lr_scale = scale
+        self.iteration += 1
+
+    # policy closures don't pickle; rebuild from the stored config
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("_policy", None)
+        return d
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        policy, base, gamma, step, power = self._cfg
+        if policy == "step":
+            self._policy = step_policy(base, gamma, step)
+        elif policy == "exp":
+            self._policy = exp_policy(base, gamma)
+        else:
+            self._policy = inv_policy(base, gamma, power)

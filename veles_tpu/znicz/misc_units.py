@@ -1,0 +1,117 @@
+"""Miscellaneous service units.
+
+Parity: reference misc znicz units (SURVEY.md §2.8 [L]):
+`image_saver.py` (dump misclassified samples), `accumulator.py` (collect a
+linked value over time), `weights_zerofilling.py` (mask/zero chosen weight
+entries each step), `multi_hist.py` (histogram of a linked tensor).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+
+from veles_tpu.units import Unit
+
+
+class Accumulator(Unit):
+    """Appends the linked `input` value each firing (the reference used it
+    to gather per-minibatch metrics for plotters)."""
+
+    def __init__(self, workflow=None, limit: int = 0, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.limit = limit
+        self.values: List[Any] = []
+        self.input = None  # usually a data link
+
+    def run(self) -> None:
+        v = self.input
+        if v is None:
+            return
+        self.values.append(np.copy(v) if isinstance(v, np.ndarray)
+                           else v)
+        if self.limit and len(self.values) > self.limit:
+            self.values.pop(0)
+
+    def reset(self) -> None:
+        self.values.clear()
+
+
+class MultiHistogram(Unit):
+    """Histogram of a linked Array (weights/activations) each firing."""
+
+    def __init__(self, workflow=None, n_bins: int = 20, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_bins = n_bins
+        self.input = None          # Array data link
+        self.hist = None
+        self.bin_edges = None
+
+    def run(self) -> None:
+        if self.input is None or not self.input:
+            return
+        self.hist, self.bin_edges = np.histogram(
+            np.asarray(self.input.mem).ravel(), bins=self.n_bins)
+
+
+class ZeroFiller(Unit):
+    """Zeroes weight entries selected by a boolean mask after each update
+    (parity: weights_zerofilling — used to enforce sparsity patterns /
+    frozen connections)."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.weights = None  # Array data link (to a forward unit's weights)
+        self.mask: Optional[np.ndarray] = None
+
+    def run(self) -> None:
+        if self.weights is None or not self.weights or self.mask is None:
+            return
+        w = np.asarray(self.weights.mem)
+        w[self.mask] = 0.0
+        self.weights.reset(w)
+
+
+class ImageSaver(Unit):
+    """Dumps misclassified samples as PNGs named
+    `<label>_as_<pred>_<i>.png` (parity: image_saver.py). Links: `input`
+    (minibatch data Array), `labels` (Array), `max_idx` (Array from
+    All2AllSoftmax)."""
+
+    def __init__(self, workflow=None, directory: str = "misclassified",
+                 limit: int = 64, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.directory = directory
+        self.limit = limit
+        self.saved = 0
+        self.input = None
+        self.labels = None
+        self.max_idx = None
+
+    def run(self) -> None:
+        if any(a is None or not a
+               for a in (self.input, self.labels, self.max_idx)):
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        x = np.asarray(self.input.mem)
+        y = np.asarray(self.labels.mem)
+        pred = np.asarray(self.max_idx.mem)
+        for i in np.nonzero(pred != y)[0]:
+            if self.saved >= self.limit:
+                return
+            img = x[i].squeeze()
+            lo, hi = float(img.min()), float(img.max())
+            arr = ((img - lo) / max(hi - lo, 1e-9) * 255).astype(np.uint8)
+            path = os.path.join(
+                self.directory,
+                f"{int(y[i])}_as_{int(pred[i])}_{self.saved}.png")
+            try:
+                from PIL import Image
+                if arr.ndim == 1:  # flat features: save as a row strip
+                    arr = arr[None, :]
+                Image.fromarray(arr).save(path)
+            except ImportError:
+                np.save(path + ".npy", arr)
+            self.saved += 1
