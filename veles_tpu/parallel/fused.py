@@ -94,6 +94,12 @@ class FusedTrainStep:
                 mode = "dp"
         if mode in ("dp", "gspmd") and mesh is None:
             raise ValueError(f"mode={mode!r} requires a mesh")
+        if mode == "gspmd":
+            # GSPMD auto-partitioning cannot shard a pallas_call; units
+            # with a pallas fast path must fall back to their XLA form
+            for u in self.forwards:
+                if hasattr(u, "prefer_pallas"):
+                    u.prefer_pallas = False
         self.mode = mode
         self.donate = donate
         self._train_fn = None

@@ -49,7 +49,18 @@ class LRNormalizerForward(Forward):
                                     n=self.n))
         return None
 
+    #: opt-in: the Pallas LRN (custom_vjp, ops.pallas_kernels.lrn_pallas)
+    #: measured SLOWER inside the fused AlexNet step on v5e (6.5k vs 9.5k
+    #: samples/s, 2026-07-29) — a pallas_call is a fusion barrier + an
+    #: extra f32 HBM round-trip, while XLA keeps the LRN chain fused in
+    #: bf16 with its neighbors. Kept for workloads where LRN stands alone.
+    #: (FusedTrainStep also clears it under GSPMD auto-partitioning.)
+    prefer_pallas = False
+
     def fused_apply(self, params, x, *, key=None, train=True):
+        from veles_tpu.ops import pallas_kernels as pk
+        if self.prefer_pallas and pk.available():
+            return pk.lrn_pallas(x, self.k, self.alpha, self.beta, self.n)
         return ox.lrn_forward(x, self.k, self.alpha, self.beta, self.n)
 
     def numpy_run(self) -> None:
