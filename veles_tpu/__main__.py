@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-w", "--web-status", action="store_true",
                    help="serve the status dashboard while running")
     p.add_argument("--web-port", type=int, default=8090)
+    p.add_argument("-p", "--profile", default="", metavar="DIR",
+                   help="write a jax.profiler trace (TensorBoard/Perfetto)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax NaN checking (debug runs)")
     return p
 
 
@@ -97,7 +101,8 @@ def main(argv=None) -> int:
         snapshot=args.snapshot, listen=args.listen, master=args.master,
         process_id=args.process_id, n_processes=args.n_processes,
         device=device, stats=not args.no_stats,
-        web_status=args.web_status, web_port=args.web_port)
+        web_status=args.web_status, web_port=args.web_port,
+        profile_dir=args.profile, debug_nans=args.debug_nans)
     return launcher.run_module(module)
 
 

@@ -32,9 +32,15 @@ class Launcher(Logger):
                  process_id: int = 0, n_processes: int = 1,
                  device: Any = None, stats: bool = True,
                  web_status: bool = False, web_port: int = 8090,
+                 profile_dir: str = "", debug_nans: bool = False,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
+        #: when set, the run is wrapped in jax.profiler.trace (TensorBoard/
+        #: Perfetto), on top of the per-unit wall-time table — SURVEY.md
+        #: §5.1's "strictly better than the reference" tracing story
+        self.profile_dir = profile_dir
+        self.debug_nans = debug_nans
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -87,10 +93,18 @@ class Launcher(Logger):
         if self.workflow is None:
             raise RuntimeError("Launcher.main() before load()")
         self.boot_distributed()
+        if self.debug_nans:
+            import jax
+            jax.config.update("jax_debug_nans", True)
         if self.web_status_enabled:
             from veles_tpu.web_status import WebStatusServer
             self._web = WebStatusServer(self.workflow, port=self.web_port)
             self._web.start()
+        profiling = False
+        if self.profile_dir:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            profiling = True
         try:
             self.workflow.initialize(device=self.device, **kwargs)
             self.workflow.run()
@@ -99,6 +113,10 @@ class Launcher(Logger):
             self.workflow.stop()
             return 130
         finally:
+            if profiling:
+                import jax
+                jax.profiler.stop_trace()
+                self.info("profiler trace -> %s", self.profile_dir)
             if self._web is not None:
                 self._web.stop()
             if self.show_stats and hasattr(self.workflow, "print_stats"):
