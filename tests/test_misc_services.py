@@ -134,3 +134,15 @@ def test_scaling_harness_multi_device(eight_devices):
     assert res["chips"] == 4
     assert res["trivial"] is False
     assert res["samples_per_sec_per_chip_n"] > 0
+
+def test_wine_sample_trains():
+    from veles_tpu.config import root
+    from veles_tpu.samples.wine import create_workflow
+    prng.seed_all(1234)
+    root.wine.decision.max_epochs = 10
+    wf = create_workflow()
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    # 40 validation samples / 3 classes: chance ~27 errors
+    assert wf.decision.best_validation_err < 15, \
+        wf.decision.best_validation_err

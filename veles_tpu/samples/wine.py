@@ -1,0 +1,68 @@
+"""Wine tabular-classification workflow.
+
+Parity: reference `veles/znicz/samples/Wine` (SURVEY.md §2.8) — the
+smallest sample: a single softmax layer over the 13-feature UCI wine
+dataset, the reference's "hello world" after MNIST. Reads the classic
+`wine.data` CSV when `root.wine.loader.data_path` points at it; otherwise
+a synthetic 13-feature stand-in (zero-egress default). Exposes
+`run(load, main)`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.wine.loader.minibatch_size = 30
+root.wine.loader.n_validation = 40
+root.wine.loader.n_train = 138
+root.wine.loader.data_path = ""
+root.wine.layers = [
+    {"type": "softmax", "output_sample_shape": 3, "weights_stddev": 0.05},
+]
+root.wine.decision.max_epochs = 50
+root.wine.decision.fail_iterations = 50
+root.wine.gd.learning_rate = 0.3
+root.wine.gd.gradient_moment = 0.9
+
+
+class WineWorkflow(StandardWorkflow):
+    """13 features → softmax(3)."""
+
+
+def make_loader() -> FullBatchLoader:
+    cfg = root.wine.loader
+    if cfg.data_path:
+        raw = np.loadtxt(cfg.data_path, delimiter=",")
+        labels = raw[:, 0].astype(np.int64) - 1   # classes are 1..3
+        x = raw[:, 1:].astype(np.float32)
+        x = (x - x.mean(0)) / x.std(0)            # standardize features
+        n_valid = int(cfg.n_validation)
+        from veles_tpu import prng
+        perm = prng.get("wine_split").permutation(len(x))
+        x, labels = x[perm], labels[perm]
+        loader = FullBatchLoader(minibatch_size=cfg.minibatch_size)
+        loader.load_data = lambda: loader.bind_arrays(  # type: ignore
+            x, labels, 0, n_valid, len(x) - n_valid)
+        return loader
+    return SyntheticClassifierLoader(
+        n_classes=3, sample_shape=(13,),
+        n_validation=cfg.n_validation, n_train=cfg.n_train,
+        minibatch_size=cfg.minibatch_size, noise=0.8)
+
+
+def create_workflow() -> WineWorkflow:
+    return WineWorkflow(
+        layers=root.wine.layers, loader=make_loader(),
+        loss="softmax", n_classes=3,
+        decision_config=root.wine.decision.to_dict(),
+        gd_config=root.wine.gd.to_dict(), name="WineWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()
