@@ -168,3 +168,31 @@ def test_mse_loss_fused():
     # reconstruction MSE (summed per validation pass) falls well below the
     # ~35/minibatch starting point
     assert wf.decision.best_validation_err < 5.0, wf.decision.epoch_metrics
+
+
+def test_train_many_matches_sequential():
+    """K scanned steps in one dispatch == K sequential train() calls."""
+    import jax.numpy as jnp
+    wf = build(minibatch_size=50)
+    wf.initialize(device=None)
+    step_a = wf.build_fused_step()
+    step_b = wf.build_fused_step()
+    sa = step_a.init_state()
+    sb = step_b.init_state()
+    rng = np.random.RandomState(0)
+    K, B = 4, 50
+    xs = rng.randn(K, B, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 10, (K, B))
+    losses_seq = []
+    for t in range(K):
+        sa, (loss, _) = step_a.train(sa, xs[t], ys[t])
+        losses_seq.append(float(loss))
+    sb, (losses, n_errs) = step_b.train_many(sb, xs, ys)
+    assert losses.shape == (K,)
+    np.testing.assert_allclose(np.asarray(losses), losses_seq,
+                               rtol=1e-5, atol=1e-6)
+    for pa, pb in zip(sa["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]),
+                                       np.asarray(pb[k]),
+                                       rtol=1e-5, atol=1e-6)

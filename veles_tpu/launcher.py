@@ -88,10 +88,26 @@ class Launcher(Logger):
             self.snapshot_loaded = False
         return self.workflow, self.snapshot_loaded
 
+    @staticmethod
+    def enable_compilation_cache(directory: str = "") -> None:
+        """Persistent XLA compilation cache (parity slot: the reference's
+        on-disk kernel-binary cache keyed by source hash, SURVEY.md §2.2).
+        First AlexNet compile is tens of seconds; subsequent launches hit
+        the cache."""
+        import os
+
+        import jax
+        directory = directory or os.path.join(
+            os.path.expanduser("~"), ".cache", "veles_tpu", "xla")
+        os.makedirs(directory, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", directory)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     def main(self, **kwargs: Any) -> int:
         """Initialize + run the loaded workflow; returns an exit code."""
         if self.workflow is None:
             raise RuntimeError("Launcher.main() before load()")
+        self.enable_compilation_cache()
         self.boot_distributed()
         if self.debug_nans:
             import jax
