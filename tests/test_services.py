@@ -175,3 +175,29 @@ def test_web_status_serves_workflow_json(tmp_path):
             assert b"veles_tpu" in r.read()
     finally:
         srv.stop()
+
+
+def test_cli_optimize_mode(tmp_path):
+    """Reference --optimize parity: GA over a module's TUNABLES, each
+    individual a full run; prints the best overrides as JSON."""
+    import json as _json
+    from veles_tpu.__main__ import main
+    wf_file = tmp_path / "wf.py"
+    wf_file.write_text(
+        "from veles_tpu.samples.mnist import run  # noqa\n"
+        "from veles_tpu.genetics import Tune\n"
+        "TUNABLES = [Tune('mnist.gd.learning_rate', 0.01, 0.5, log=True)]\n")
+    import io, contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        code = main([str(wf_file),
+                     "root.mnist.decision.max_epochs=1",
+                     "root.mnist.loader.n_train=100",
+                     "root.mnist.loader.n_validation=50",
+                     "root.mnist.loader.minibatch_size=50",
+                     "-b", "numpy", "-r", "5", "--no-stats",
+                     "--optimize", "1"])
+    assert code == 0
+    out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert "best_fitness" in out
+    assert 0.01 <= out["best_overrides"]["mnist.gd.learning_rate"] <= 0.5

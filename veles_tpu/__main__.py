@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import importlib.util
+import json
 import os
 import sys
 
@@ -68,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
+    p.add_argument("--optimize", type=int, default=0, metavar="GENERATIONS",
+                   help="genetic hyperparameter search instead of a single "
+                        "run: the workflow/config module must define "
+                        "TUNABLES = [genetics.Tune(...)]; fitness is the "
+                        "best validation error of each spawned run")
     return p
 
 
@@ -103,7 +109,37 @@ def main(argv=None) -> int:
         device=device, stats=not args.no_stats,
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans)
+    if args.optimize:
+        return run_optimize(module, args, device)
     return launcher.run_module(module)
+
+
+def run_optimize(module, args, device) -> int:
+    """Reference `--optimize` mode: GA over the module's TUNABLES, each
+    individual a full workflow run with the overrides applied to root."""
+    from veles_tpu.config import root
+    from veles_tpu.genetics import Population
+    from veles_tpu.launcher import Launcher
+
+    tunables = getattr(module, "TUNABLES", None)
+    if not tunables:
+        raise SystemExit(
+            f"--optimize: {args.workflow} defines no TUNABLES list")
+
+    def fitness(overrides):
+        for path, value in overrides.items():
+            root.override(path, value)
+        launcher = Launcher(device=device, stats=False)
+        launcher.run_module(module)
+        dec = getattr(launcher.workflow, "decision", None)
+        err = getattr(dec, "best_validation_err", None)
+        return float("inf") if err is None else float(err)
+
+    pop = Population(tunables, fitness)
+    best = pop.evolve(generations=args.optimize)
+    print(json.dumps({"best_fitness": best.fitness,
+                      "best_overrides": best.overrides(tunables)}))
+    return 0
 
 
 if __name__ == "__main__":
