@@ -201,3 +201,25 @@ def test_cli_optimize_mode(tmp_path):
     out = _json.loads(buf.getvalue().strip().splitlines()[-1])
     assert "best_fitness" in out
     assert 0.01 <= out["best_overrides"]["mnist.gd.learning_rate"] <= 0.5
+
+
+def test_cli_fused_mode(tmp_path):
+    from veles_tpu.__main__ import main
+    wf_file = tmp_path / "wf.py"
+    wf_file.write_text("from veles_tpu.samples.mnist import run  # noqa\n")
+    code = main([str(wf_file),
+                 "root.mnist.decision.max_epochs=1",
+                 "root.mnist.loader.n_train=100",
+                 "root.mnist.loader.n_validation=50",
+                 "root.mnist.loader.minibatch_size=50",
+                 "-r", "6", "--no-stats", "--fused"])
+    assert code == 0
+
+
+def test_snapshotter_latest(tmp_path):
+    wf = build(tmp_path, max_epochs=3, snapshot=True)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    latest = Snapshotter.latest(str(tmp_path))
+    assert latest == wf.snapshotter.destination
+    assert Snapshotter.latest(str(tmp_path / "nope")) is None

@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
+    p.add_argument("--fused", action="store_true",
+                   help="train via the fused one-dispatch-per-minibatch "
+                        "XLA step instead of the granular unit graph")
     p.add_argument("--optimize", type=int, default=0, metavar="GENERATIONS",
                    help="genetic hyperparameter search instead of a single "
                         "run: the workflow/config module must define "
@@ -108,7 +111,8 @@ def main(argv=None) -> int:
         process_id=args.process_id, n_processes=args.n_processes,
         device=device, stats=not args.no_stats,
         web_status=args.web_status, web_port=args.web_port,
-        profile_dir=args.profile, debug_nans=args.debug_nans)
+        profile_dir=args.profile, debug_nans=args.debug_nans,
+        fused=args.fused)
     if args.optimize:
         return run_optimize(module, args, device)
     return launcher.run_module(module)

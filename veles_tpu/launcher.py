@@ -33,6 +33,7 @@ class Launcher(Logger):
                  device: Any = None, stats: bool = True,
                  web_status: bool = False, web_port: int = 8090,
                  profile_dir: str = "", debug_nans: bool = False,
+                 fused: bool = False,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -41,6 +42,9 @@ class Launcher(Logger):
         #: §5.1's "strictly better than the reference" tracing story
         self.profile_dir = profile_dir
         self.debug_nans = debug_nans
+        #: run via the one-dispatch-per-minibatch fused XLA step instead
+        #: of the granular unit graph (same Decision/Snapshotter behavior)
+        self.fused = fused
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -122,8 +126,15 @@ class Launcher(Logger):
             jax.profiler.start_trace(self.profile_dir)
             profiling = True
         try:
-            self.workflow.initialize(device=self.device, **kwargs)
-            self.workflow.run()
+            if self.fused:
+                if not hasattr(self.workflow, "run_fused"):
+                    raise SystemExit(
+                        f"--fused: {type(self.workflow).__name__} has no "
+                        "fused step (StandardWorkflow-family only)")
+                self.workflow.run_fused(device=self.device, **kwargs)
+            else:
+                self.workflow.initialize(device=self.device, **kwargs)
+                self.workflow.run()
         except KeyboardInterrupt:
             self.warning("interrupted; stopping workflow")
             self.workflow.stop()

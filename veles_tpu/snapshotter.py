@@ -141,6 +141,21 @@ class Snapshotter(SnapshotterBase):
         return path
 
     @staticmethod
+    def latest(directory: str, prefix: str = "") -> Optional[str]:
+        """Newest snapshot file in `directory` (restart-from-snapshot
+        recovery, SURVEY.md §5.3: the SPMD fault model is resume, not
+        mid-step elasticity)."""
+        try:
+            names = [n for n in os.listdir(directory)
+                     if ".pickle" in n and n.startswith(prefix)]
+        except FileNotFoundError:
+            return None
+        if not names:
+            return None
+        paths = [os.path.join(directory, n) for n in names]
+        return max(paths, key=os.path.getmtime)
+
+    @staticmethod
     def import_(path: str):
         """Restore a workflow from a snapshot file (any supported codec,
         sniffed by magic bytes, so renamed files still load)."""
