@@ -1,0 +1,86 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh
+"stage" axis.
+
+Absent in the reference (SURVEY.md §2.4: PP = NO) — added so the parallel
+layer covers the full dp/tp/sp/ep/pp axis set. The TPU-native shape of
+the idea (scaling-book recipe): each device owns ONE stage's params;
+a `lax.scan` runs M + S − 1 ticks; per tick every device applies its
+stage to its current activation and `ppermute`s the result to the next
+stage — at steady state all S stages compute concurrently on different
+microbatches. The bubble is the standard (S−1)/(M+S−1).
+
+Constraints of this v1 (documented): every stage maps activations of one
+width to the same width (equal-width stages), and the microbatch count M
+must be ≥ 1. Autodiff flows through scan+ppermute, so `jax.grad` of a
+loss over `pipeline_apply` yields per-stage parameter gradients — no
+hand-written backward schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+STAGE_AXIS = "stage"
+
+
+def pipeline_apply(stage_fn: Callable, params, xs, axis_name: str = STAGE_AXIS):
+    """Run microbatches through the pipeline. Call INSIDE shard_map with:
+    - `params`: this device's stage params (leading stage dim already
+      split away by the shard_map in_spec);
+    - `xs`: (M, mb, D) microbatches, replicated (only stage 0 reads them);
+    - `stage_fn(params, x) -> y` with y.shape == x.shape.
+    Returns (M, mb, D) outputs (valid on every device after the final
+    psum-broadcast from the last stage)."""
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m, mb, d = xs.shape
+    ticks = m + s - 1
+
+    def tick(carry, t):
+        act, outputs = carry
+        mb_idx = t - idx                       # which microbatch this
+        # stage would be processing at tick t
+        inject = xs[jnp.clip(t, 0, m - 1)]
+        is_first = (idx == 0)
+        x_in = jnp.where(is_first, inject, act)
+        y = stage_fn(params, x_in)
+        valid = (mb_idx >= 0) & (mb_idx < m)
+        is_last = (idx == s - 1)
+        write = (valid & is_last).astype(y.dtype)
+        outputs = outputs.at[jnp.clip(mb_idx, 0, m - 1)].add(write * y)
+        act_next = lax.ppermute(y, axis_name,
+                                [(i, (i + 1) % s) for i in range(s)])
+        return (act_next, outputs), None
+
+    # the scan carry mixes with device-varying values (idx, params), so
+    # it must start varying over the stage axis (shard_map vma typing)
+    act0 = lax.pvary(jnp.zeros((mb, d), xs.dtype), (axis_name,))
+    out0 = lax.pvary(jnp.zeros_like(xs), (axis_name,))
+    (act, outputs), _ = lax.scan(tick, (act0, out0),
+                                 jnp.arange(ticks))
+    # broadcast the last stage's outputs to every device (simple v1
+    # epilogue; a real deployment would keep them stage-resident)
+    last = (idx == s - 1).astype(outputs.dtype)
+    return lax.psum(outputs * last, axis_name)
+
+
+def make_pipeline(mesh: Mesh, stage_fn: Callable,
+                  axis_name: str = STAGE_AXIS):
+    """jit-compiled pipeline runner over `mesh`:
+    `run(params_stacked, xs)` with params_stacked leading dim = S (sharded
+    over the stage axis) and xs (M, mb, D) microbatches. Differentiable."""
+
+    def inner(params, xs):
+        # shard_map splits the leading stage dim; squeeze it away
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return pipeline_apply(stage_fn, local, xs, axis_name)
+
+    pspec = P(axis_name)   # prefix spec: applies to every params leaf
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(pspec, P()), out_specs=P()))

@@ -12,5 +12,5 @@ registry — SURVEY.md §2.8).
 from veles_tpu.znicz import standard_workflow  # noqa: F401, E402
 from veles_tpu.znicz import (  # noqa: F401, E402
     activation, all2all, attention, conv, cutter, deconv, depooling,
-    dropout, gd, gd_conv, gd_deconv, gd_pooling, kohonen, lstm,
+    dropout, gd, gd_conv, gd_deconv, gd_pooling, kohonen, lstm, moe,
     normalization, pooling, rbm_units)

@@ -1,0 +1,161 @@
+"""Mixture-of-experts units (expert parallelism).
+
+Not in the reference (SURVEY.md §2.4: EP absent) — added so the parallel
+layer covers the full dp/tp/sp/ep axis set. Follows the house pattern:
+Forward twin + vjp-driven GD twin; the dense routing form is the golden
+model, the shard_map expert-parallel form (ops.moe.moe_forward_ep) is its
+mesh twin, equivalence-tested on the virtual 8-device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles_tpu.memory import Array
+from veles_tpu.ops import moe as om
+from veles_tpu.ops.optim import SGDConfig, sgd_update
+from veles_tpu.znicz.nn_units import (Forward, GradientDescentBase,
+                                      register_gd)
+
+
+class MoELayer(Forward):
+    """Top-1 (switch) MoE FFN: x (N, D) -> (N, D). Params: router wr
+    (D, E), expert FFNs w1 (E, D, H), b1, w2 (E, H, D), b2."""
+
+    def __init__(self, workflow=None, n_experts: int = 4,
+                 hidden: int = 64, capacity_factor: float = 2.0,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_experts = n_experts
+        self.hidden = hidden
+        self.capacity_factor = capacity_factor
+        self.wr = Array()
+        self.w1 = Array()
+        self.b1 = Array()
+        self.w2 = Array()
+        self.b2 = Array()
+
+    def param_arrays(self) -> Dict[str, Array]:
+        return {"wr": self.wr, "w1": self.w1, "b1": self.b1,
+                "w2": self.w2, "b2": self.b2}
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(self.capacity_factor * n_tokens
+                          / self.n_experts))
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n = self.input.shape[0]
+        d = int(np.prod(self.input.shape[1:]))
+        e, h = self.n_experts, self.hidden
+        if not self.wr:
+            std = self.weights_stddev or self.default_stddev(d)
+            self.wr.reset(self._fill((d, e), self.weights_filling, std))
+            self.w1.reset(self._fill((e, d, h), self.weights_filling, std))
+            self.b1.reset(np.zeros((e, h), np.float32))
+            self.w2.reset(self._fill((e, h, d), self.weights_filling,
+                                     self.weights_stddev
+                                     or self.default_stddev(h)))
+            self.b2.reset(np.zeros((e, d), np.float32))
+        if not self.output or self.output.shape != (n, d):
+            self.output.reset(np.zeros((n, d), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def _apply(self, params, x):
+        x2 = x.reshape(x.shape[0], -1)
+        return om.moe_forward(x2, params["wr"], params["w1"], params["b1"],
+                              params["w2"], params["b2"],
+                              capacity=self.capacity(x2.shape[0]))
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return self._apply(params, x)
+
+    def xla_init(self):
+        self._fn = self.jit(lambda x, p: self._apply(p, x))
+        return None
+
+    def numpy_run(self) -> None:
+        params = {k: jnp.asarray(a.mem)
+                  for k, a in self.param_arrays().items()}
+        self.output.mem = np.asarray(self._apply(params, self.input.mem))
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {k: a.devmem(dv) for k, a in self.param_arrays().items()}
+        self.output.set_devmem(self._fn(self.input.devmem(dv), params))
+
+
+@register_gd(MoELayer)
+class GDMoELayer(GradientDescentBase):
+    """Backward via jax.vjp of the dense routing forward + SGD update.
+    (The top-1 argmax is non-differentiable by construction — gradients
+    flow through the gate value and the expert FFNs, switch-style.)"""
+
+    def link_forward(self, fwd: MoELayer) -> "GDMoELayer":
+        self.link_attrs(fwd, "wr", "w1", "b1", "w2", "b2", "input",
+                        "output")
+        self._fwd = fwd
+        return self
+
+    _PNAMES = ("wr", "w1", "b1", "w2", "b2")
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not self.wr:
+            return False
+        for name in self._PNAMES:
+            vname = f"vel_{name}"
+            if getattr(self, vname, None) is None or not getattr(self,
+                                                                 vname):
+                arr = Array()
+                arr.reset(np.zeros(getattr(self, name).shape, np.float32))
+                setattr(self, vname, arr)
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def xla_init(self):
+        fwd = self._fwd
+        cfg = SGDConfig(lr=self.learning_rate,
+                        momentum=self.gradient_moment,
+                        weight_decay=self.weights_decay,
+                        l1_decay=self.l1_decay)
+
+        def step(x, params, err_y, vel, lr_scale):
+            _, vjp = jax.vjp(lambda p, xx: fwd._apply(p, xx), params, x)
+            grads, err_x = vjp(err_y)
+            new_p, new_v = sgd_update(params, grads, vel, cfg, lr_scale)
+            return err_x, new_p, new_v
+
+        self._fn = self.jit(step, donate_argnums=(3,))
+        return None
+
+    def numpy_run(self) -> None:
+        self.xla_run()  # vjp is the only backward model
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {n: getattr(self, n).devmem(dv) for n in self._PNAMES}
+        vel = {n: getattr(self, f"vel_{n}").devmem(dv)
+               for n in self._PNAMES}
+        err_x, new_p, new_v = self._fn(
+            self.input.devmem(dv), params, self.err_output.devmem(dv),
+            vel, jnp.float32(self.lr_scale))
+        self.err_input.set_devmem(err_x.reshape(self.input.shape))
+        for n in self._PNAMES:
+            getattr(self, n).set_devmem(new_p[n])
+            getattr(self, f"vel_{n}").set_devmem(new_v[n])
+
+    def __getstate__(self):
+        st = super().__getstate__()
+        st.pop("_fwd", None)
+        return st
+
+
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({"moe": MoELayer})
