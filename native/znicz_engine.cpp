@@ -307,13 +307,29 @@ struct Engine {
 };
 
 std::vector<float> read_blob(const std::vector<float>& pool, const Json& spec) {
-  int offset = (int)spec.at("offset").num;
-  int sz = 1;
-  for (const auto& d : spec.at("shape").arr) sz *= (int)d.num;
-  if (offset + sz > (int)pool.size())
+  // Packages travel through the forge/zoo exchange, so treat the manifest
+  // as untrusted: validate each JSON double BEFORE casting (double->int
+  // conversion of an out-of-range value is UB), then 64-bit arithmetic
+  // with a subtraction-form bounds check that cannot itself overflow.
+  auto to_index = [](double v) -> long long {
+    if (!(v >= 0 && v <= 9007199254740992.0 /* 2^53 */) ||
+        v != std::floor(v))
+      throw std::runtime_error("bad offset/shape value in manifest");
+    return (long long)v;
+  };
+  long long offset = to_index(spec.at("offset").num);
+  long long sz = 1;
+  for (const auto& d : spec.at("shape").arr) {
+    long long dim = to_index(d.num);
+    if (dim > 0 && sz > (long long)pool.size() / dim)
+      throw std::runtime_error("bad shape in manifest");
+    sz *= dim;
+  }
+  if (offset < 0 || (size_t)offset > pool.size() ||
+      (size_t)sz > pool.size() - (size_t)offset)
     throw std::runtime_error("weights.bin too small for manifest");
-  return std::vector<float>(pool.begin() + offset,
-                            pool.begin() + offset + sz);
+  return std::vector<float>(pool.begin() + (size_t)offset,
+                            pool.begin() + (size_t)offset + (size_t)sz);
 }
 
 Engine* load_package(const std::string& dir) {

@@ -68,3 +68,30 @@ def test_prng_pickle_roundtrip():
     g.permutation(10)
     g2 = pickle.loads(pickle.dumps(g))
     np.testing.assert_array_equal(g.permutation(10), g2.permutation(10))
+
+
+def test_seed_all_governs_future_generators():
+    """seed_all BEFORE any get() must determine the seeds of generators
+    created later — two same-seeded fresh registries produce identical
+    draws regardless of when the generator object is created (round-2
+    regression: the first run in a process silently used the default
+    seed because seed_all over an empty registry was a no-op)."""
+    from veles_tpu import prng
+    saved_gens = dict(prng._generators)
+    saved_base = prng._base_seed
+    try:
+        prng._generators.clear()
+        prng.seed_all(777)
+        a = prng.get().fill_uniform((16,), -1, 1)
+        prng._generators.clear()
+        prng.seed_all(777)
+        b = prng.get().fill_uniform((16,), -1, 1)
+        np.testing.assert_array_equal(a, b)
+        prng._generators.clear()
+        prng.seed_all(778)
+        c = prng.get().fill_uniform((16,), -1, 1)
+        assert np.abs(a - c).max() > 0
+    finally:
+        prng._generators.clear()
+        prng._generators.update(saved_gens)
+        prng._base_seed = saved_base

@@ -115,3 +115,34 @@ def test_stablehlo_export(tmp_path):
     path = export_stablehlo(wf, str(tmp_path / "fwd.mlir"), batch=2)
     text = open(path).read()
     assert "stablehlo" in text and "dot" in text
+
+
+def test_corrupt_manifest_rejected(tmp_path):
+    """A tampered package (negative offset / oversized shape in
+    topology.json) fails with a clean error, not an out-of-bounds read
+    (the forge exchange format is untrusted input)."""
+    import json
+    wf = build_wf(
+        [{"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(6, 6))
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    topo_path = os.path.join(pkg, "topology.json")
+    with open(topo_path) as f:
+        topo_orig = json.load(f)
+
+    def corrupt(mutate):
+        topo = json.loads(json.dumps(topo_orig))
+        mutate(topo)
+        with open(topo_path, "w") as f:
+            json.dump(topo, f)
+        with pytest.raises(RuntimeError):
+            NativeEngine(pkg)
+
+    corrupt(lambda t: t["layers"][0]["arrays"][0].__setitem__(
+        "offset", -8))
+    corrupt(lambda t: t["layers"][0]["arrays"][0].__setitem__(
+        "offset", 10 ** 12))
+    corrupt(lambda t: t["layers"][0]["arrays"][0].__setitem__(
+        "shape", [2 ** 31, 2 ** 31]))

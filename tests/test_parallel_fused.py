@@ -196,3 +196,82 @@ def test_train_many_matches_sequential():
             np.testing.assert_allclose(np.asarray(pa[k]),
                                        np.asarray(pb[k]),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_fused_velocity_roundtrip_nonbase_layers():
+    """Momentum velocities for layer families whose GD twins use
+    vel_<name> attributes (attention: vel_wq..., not the base vel_w/vel_b)
+    survive write_back -> new fused step; a fresh step resumes with the
+    exact velocity pytree instead of silently zeroing it."""
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    prng.seed_all(77)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(8, 16), n_validation=40, n_train=160,
+        minibatch_size=40, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[
+            {"type": "attention", "n_heads": 2, "causal": False,
+             "weights_stddev": 0.1},
+            {"type": "softmax", "output_sample_shape": 4,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 1, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.05, "gradient_moment": 0.9},
+        name="VelRoundTrip")
+    wf.initialize(device=None)
+    step = wf.build_fused_step()
+    state = step.init_state()
+    rng = np.random.RandomState(0)
+    x = rng.randn(40, 8, 16).astype(np.float32)
+    y = rng.randint(0, 4, 40)
+    state, _ = step.train(state, x, y)
+    state, _ = step.train(state, x, y)
+    # attention velocities are non-trivial after 2 momentum steps
+    att_vel = state["vel"][0]
+    assert set(att_vel) == {"wq", "wk", "wv", "wo"}
+    for k, v in att_vel.items():
+        assert np.abs(np.asarray(v)).max() > 0, k
+    step.write_back(state)
+    # the GD twin now holds them under vel_wq/... and a NEW fused step
+    # (fresh object, as after snapshot resume) seeds from those buffers
+    step2 = wf.build_fused_step()
+    s2 = step2.init_state()
+    for k in att_vel:
+        np.testing.assert_array_equal(np.asarray(s2["vel"][0][k]),
+                                      np.asarray(att_vel[k]))
+
+
+@pytest.mark.parametrize("mesh_kw,mode", [
+    ({}, "dp"),
+    ({"model": 2}, "gspmd"),
+])
+def test_train_many_sharded_matches_sequential(mesh_kw, mode,
+                                               eight_devices):
+    """scan-of-steps == K sequential steps on the 8-device mesh, for both
+    the shard_map dp mode and the GSPMD dp x tp mode (VERDICT r1 #4: the
+    dispatch-amortized hot loop must exist exactly where multi-chip DP
+    pays per-step dispatch)."""
+    mesh = make_mesh(eight_devices, **mesh_kw)
+    wf = build(minibatch_size=48)
+    wf.initialize(device=None)
+    step_a = wf.build_fused_step(mesh=mesh, mode=mode)
+    step_b = wf.build_fused_step(mesh=mesh, mode=mode)
+    sa = step_a.init_state()
+    sb = step_b.init_state()
+    rng = np.random.RandomState(0)
+    K, B = 3, 48
+    xs = rng.randn(K, B, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 10, (K, B))
+    losses_seq = []
+    for t in range(K):
+        sa, (loss, _) = step_a.train(sa, xs[t], ys[t])
+        losses_seq.append(float(loss))
+    sb, (losses, _) = step_b.train_many(sb, xs, ys)
+    np.testing.assert_allclose(np.asarray(losses), losses_seq,
+                               rtol=1e-5, atol=1e-6)
+    for pa, pb in zip(sa["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]),
+                                       np.asarray(pb[k]),
+                                       rtol=1e-5, atol=1e-6)

@@ -1,0 +1,149 @@
+"""Sequence parallelism trained END-TO-END (VERDICT r1 #3): the
+char-transformer workflow trains with its sequence dim sharded over the
+mesh "seq" axis — ring and Ulysses attention inside the fused step — and
+the loss trajectory matches local-mode training step for step.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.parallel import make_mesh
+from veles_tpu.samples.char_transformer import create_workflow
+
+
+def fresh_wf(parallel_mode="local"):
+    from veles_tpu.config import root
+    prng.seed_all(4321)
+    root.char_transformer.parallel_mode = parallel_mode
+    wf = create_workflow()
+    wf.initialize(device=None)
+    return wf
+
+
+def batches(wf, k=3):
+    """Deterministic (x, y_flat) train minibatches from the loader data."""
+    rng = np.random.RandomState(0)
+    data = wf.loader.data.mem
+    labels = wf.loader.labels.mem
+    n = wf.loader.minibatch_size
+    out = []
+    for _ in range(k):
+        idx = rng.randint(0, data.shape[0], n)
+        out.append((data[idx], labels[idx].reshape(-1)))
+    return out
+
+
+def test_granular_transformer_trains():
+    """The unit graph itself (SeqLinear/attention/SeqSoftmax + vjp GD
+    twins) trains: validation error drops well below chance."""
+    from veles_tpu.backends import XLADevice
+    wf = fresh_wf()
+    wf.initialize(device=XLADevice())
+    wf.run()
+    # the loader wraps the last minibatch, so a validation pass evaluates
+    # ceil(40/32) full minibatches of seq_len tokens each
+    mb = wf.loader.minibatch_size
+    n_tokens = -(-40 // mb) * mb * wf.loader.seq_len
+    vocab = wf.loader.n_vocab
+    chance = n_tokens * (1 - 1.0 / vocab)
+    assert wf.decision.best_validation_err < 0.7 * chance, \
+        (wf.decision.best_validation_err, chance)
+
+
+@pytest.mark.parametrize("parallel_mode", ["ring", "ulysses"])
+def test_seq_parallel_training_matches_local(parallel_mode,
+                                             eight_devices):
+    """Fused "seq" training over a data(2) x seq(4) mesh reproduces the
+    local-mode loss trajectory AND final params (ring/Ulysses attention
+    are exact, the distributed CE mean is the global mean, and the
+    grad psum is the transpose of the replicated-param broadcast)."""
+    wf_l = fresh_wf("local")
+    steps_l = wf_l.build_fused_step()
+    wf_s = fresh_wf(parallel_mode)
+    mesh = make_mesh(eight_devices, seq=4)
+    steps_s = wf_s.build_fused_step(mesh=mesh, mode="seq")
+    # identical initial params (same seed), identical batches
+    bs = batches(wf_l)
+    sl = steps_l.init_state()
+    ss = steps_s.init_state()
+    for (x, y) in bs:
+        sl, (loss_l, err_l) = steps_l.train(sl, x, y)
+        ss, (loss_s, err_s) = steps_s.train(ss, x, y)
+        np.testing.assert_allclose(float(loss_l), float(loss_s),
+                                   rtol=2e-5, atol=1e-6)
+        assert int(err_l) == int(err_s)
+    for pl, ps in zip(sl["params"], ss["params"]):
+        for k in pl:
+            np.testing.assert_allclose(np.asarray(pl[k]),
+                                       np.asarray(ps[k]),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_seq_parallel_evaluate_matches_local(eight_devices):
+    """Forward-only metrics agree between local and seq-sharded modes."""
+    wf_l = fresh_wf("local")
+    step_l = wf_l.build_fused_step()
+    wf_s = fresh_wf("ring")
+    mesh = make_mesh(eight_devices, seq=4)
+    step_s = wf_s.build_fused_step(mesh=mesh, mode="seq")
+    x, y = batches(wf_l, k=1)[0]
+    sl = step_l.init_state()
+    ss = step_s.init_state()
+    loss_l, err_l = step_l.evaluate(sl, x, y)
+    loss_s, err_s = step_s.evaluate(ss, x, y)
+    np.testing.assert_allclose(float(loss_l), float(loss_s),
+                               rtol=2e-5, atol=1e-6)
+    assert int(err_l) == int(err_s)
+
+
+def test_seq_train_many_matches_sequential(eight_devices):
+    """The dispatch-amortized scan composes with the seq mode too."""
+    wf = fresh_wf("ring")
+    mesh = make_mesh(eight_devices, seq=4)
+    step_a = wf.build_fused_step(mesh=mesh, mode="seq")
+    step_b = wf.build_fused_step(mesh=mesh, mode="seq")
+    bs = batches(wf, k=3)
+    xs = np.stack([b[0] for b in bs])
+    ys = np.stack([b[1] for b in bs])
+    sa = step_a.init_state()
+    sb = step_b.init_state()
+    losses_seq = []
+    for (x, y) in bs:
+        sa, (loss, _) = step_a.train(sa, x, y)
+        losses_seq.append(float(loss))
+    sb, (losses, _) = step_b.train_many(sb, xs, ys)
+    np.testing.assert_allclose(np.asarray(losses), losses_seq,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_seq_mode_rejects_local_attention(eight_devices):
+    """Silent shard-local attention is a correctness trap: building a
+    seq-sharded step over an attention unit left at parallel_mode='local'
+    must raise, not train a mathematically different model."""
+    wf = fresh_wf("local")
+    mesh = make_mesh(eight_devices, seq=4)
+    with pytest.raises(ValueError, match="ring"):
+        wf.build_fused_step(mesh=mesh, mode="seq")
+
+
+def test_granular_paths_work_after_seq_trace(eight_devices):
+    """Tracing a seq-mode step must not poison the units' granular paths
+    (stale seq_axis_name would make lax.axis_index run outside any
+    shard_map)."""
+    import jax.numpy as jnp
+    wf = fresh_wf("ring")
+    mesh = make_mesh(eight_devices, seq=4)
+    step = wf.build_fused_step(mesh=mesh, mode="seq")
+    x, y = batches(wf, k=1)[0]
+    st = step.init_state()
+    st, _ = step.train(st, x, y)
+    step.write_back(st)
+    # granular numpy path of the pos-embedding unit runs standalone
+    embed = wf.forwards[0]
+    params = {k: jnp.asarray(a.mem)
+              for k, a in embed.param_arrays().items()}
+    out = embed._apply(params, x)
+    assert np.isfinite(np.asarray(out)).all()
+    embed.numpy_run()

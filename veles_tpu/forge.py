@@ -7,6 +7,13 @@ service; this environment is zero-egress, so the transport is a
 filesystem directory (local path or network mount) with the same
 package format and the same publish/fetch verbs — pointing `zoo` at an
 HTTP mirror is a transport swap, not a format change.
+
+TRUST MODEL: packages embed a workflow *pickle*, and unpacking one runs
+`pickle.load` — arbitrary code execution by design (reference parity:
+VelesForge had the same property). Only unpack packages from a zoo
+directory you control/trust. For untrusted exchange, ship the data-only
+package (`veles_tpu.export`: topology.json + weights.bin), which the C++
+engine loads with full bounds checking and zero code execution.
 """
 
 from __future__ import annotations

@@ -331,7 +331,11 @@ def lrn_forward(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
     shifts are pad+slice, so XLA fuses the whole LRN (and its autodiff
     backward) into one elementwise chain — measured 4× faster fwd+bwd
     than the reduce_window lowering on v5e (20.4 → 5.1 ms on the AlexNet
-    L1 activation, 2026-07-29)."""
+    L1 activation, 2026-07-29). The ±half window requires odd n — for
+    even n it would silently widen to n+1 taps (the Pallas and C++ twins
+    share the ±half semantics, so all three agree only for odd n)."""
+    if n % 2 == 0:
+        raise ValueError(f"LRN window n must be odd, got {n}")
     sq = x * x
     half = n // 2
     zeros = [(0, 0)] * (x.ndim - 1)
@@ -380,7 +384,11 @@ def softmax_ce(probs, labels, n_classes: int):
 
 def ce_loss_from_logits(logits, labels, n_classes: int):
     """Scalar CE loss from logits — the form jax.grad differentiates in the
-    fused train step (log-softmax for stability)."""
+    fused train step (log-softmax for stability). Accepts any leading
+    dims: (N, C) classifier logits, or (N, S, C) per-token LM logits with
+    (N, S) labels (mean over all tokens)."""
+    logits = logits.reshape(-1, logits.shape[-1])
+    labels = labels.reshape(-1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
     return -picked.mean()

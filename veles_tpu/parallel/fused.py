@@ -16,6 +16,11 @@ Two execution modes:
 - "gspmd" — `jax.jit` with NamedSharding annotations on params (model
             axis) and batch (data axis); XLA's SPMD partitioner inserts
             the collectives. Composes DP×TP.
+- "seq"   — `shard_map` over ("data", "seq"): the batch dim rides the
+            data axis and the SEQUENCE dim rides the seq axis; attention
+            units run their ring/Ulysses kernels (via `seq_axis_name`),
+            per-token CE averages globally through the same
+            grad-transpose psum. The long-context training path.
 A mesh of one device degrades to plain jit (same code path, collectives
 are no-ops) — SURVEY.md §7: build size-agnostically.
 
@@ -39,13 +44,28 @@ from jax.sharding import PartitionSpec as P
 from veles_tpu import prng
 from veles_tpu.ops import optim
 from veles_tpu.ops import xla as ox
-from veles_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from veles_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 def _tree_cast(tree, dtype):
     return jax.tree_util.tree_map(
         lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating)
         else a, tree)
+
+
+#: the base GD units keep velocities as vel_w/vel_b for the params named
+#: weights/bias; every other GD twin names them vel_<param_name>
+#: (vel_wq, vel_wx, vel_wr, ...). _vel_attr resolves the attribute for a
+#: param name so ALL layer families round-trip momentum through fused
+#: snapshots, not just {weights, bias}.
+_VEL_ALIASES = {"weights": "vel_w", "bias": "vel_b"}
+
+
+def _vel_attr(gd_unit, param_name: str) -> Optional[str]:
+    for cand in (f"vel_{param_name}", _VEL_ALIASES.get(param_name)):
+        if cand is not None and getattr(gd_unit, cand, None) is not None:
+            return cand
+    return None
 
 
 class FusedTrainStep:
@@ -87,13 +107,24 @@ class FusedTrainStep:
         if mode == "auto":
             if mesh is None:
                 mode = "local"
+            elif SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1:
+                mode = "seq"
             elif MODEL_AXIS in mesh.axis_names \
                     and mesh.shape[MODEL_AXIS] > 1:
                 mode = "gspmd"
             else:
                 mode = "dp"
-        if mode in ("dp", "gspmd") and mesh is None:
+        if mode in ("dp", "gspmd", "seq") and mesh is None:
             raise ValueError(f"mode={mode!r} requires a mesh")
+        if mode == "seq":
+            for u in self.forwards:
+                if getattr(u, "parallel_mode", None) == "local":
+                    raise ValueError(
+                        f"{type(u).__name__} has parallel_mode='local' "
+                        "under the seq-sharded step: attention would "
+                        "silently stay shard-local (causality restarts "
+                        "at every shard). Set parallel_mode='ring' or "
+                        "'ulysses'.")
         if mode == "gspmd":
             # GSPMD auto-partitioning cannot shard a pallas_call; units
             # with a pallas fast path must fall back to their XLA form
@@ -112,14 +143,14 @@ class FusedTrainStep:
         params = tuple(
             {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
             for u in self.forwards)
-        vel_keys = {"weights": "vel_w", "bias": "vel_b"}
 
         def seed_vel(u, g, p):
             # resume from the GD twin's velocity buffers when present
             # (written by write_back / restored from a snapshot)
             out = {}
             for k, a in p.items():
-                varr = getattr(g, vel_keys.get(k, ""), None)
+                vname = _vel_attr(g, k)
+                varr = getattr(g, vname) if vname else None
                 if varr is not None and varr:
                     out[k] = jnp.asarray(varr.mem)
                 else:
@@ -142,31 +173,55 @@ class FusedTrainStep:
         Tolerates donated-away buffers: if a step failed mid-dispatch the
         state it consumed is already deleted — skip those arrays (the unit
         Arrays keep their last written-back values) instead of raising a
-        secondary error that would mask the original one."""
-        vel_keys = {"weights": "vel_w", "bias": "vel_b"}
+        secondary error that would mask the original one. Only the
+        deleted-buffer RuntimeError is swallowed, per-array, so a real
+        error in one layer cannot silently abort the rest."""
+        def deleted(a) -> bool:
+            return getattr(a, "is_deleted", lambda: False)()
+
         for u, g, p, v in zip(self.forwards, self.gd_units,
                               state["params"], state["vel"]):
             for k, arr in u.param_arrays().items():
-                try:
-                    arr.reset(np.asarray(p[k]))
-                    # momentum velocities land in the GD twin so a snapshot
-                    # resumes with optimizer state intact (reference parity:
-                    # whole-workflow pickle includes optimizer state)
-                    if k in vel_keys and hasattr(g, vel_keys[k]):
-                        getattr(g, vel_keys[k]).reset(np.asarray(v[k]))
-                except RuntimeError:
-                    return  # donated/deleted state: nothing recoverable
+                if deleted(p[k]) or deleted(v[k]):
+                    continue  # donated-away buffer: keep last value
+                arr.reset(np.asarray(p[k]))
+                # momentum velocities land in the GD twin so a snapshot
+                # resumes with optimizer state intact (reference parity:
+                # whole-workflow pickle includes optimizer state)
+                vname = _vel_attr(g, k)
+                if vname is not None:
+                    getattr(g, vname).reset(np.asarray(v[k]))
 
     def _check_batch(self, n: int) -> None:
         """The actual fed batch must divide the data axis (checked per call
         so callers that feed their own batches — e.g. the scaling harness —
         are validated on what they actually feed, not the loader's size)."""
-        if self.mode in ("dp", "gspmd"):
+        if self.mode in ("dp", "gspmd", "seq"):
             n_data = self.mesh.shape.get(DATA_AXIS, 1)
             if n % n_data:
                 raise ValueError(
                     f"batch of {n} not divisible by the mesh data axis "
                     f"({n_data} shards)")
+
+    def _seq_xy(self, x, y, batched: bool = False):
+        """In "seq" mode the sequence dim is sharded, so labels must keep
+        their (N, S) structure. The text loaders emit flat (N·S,) labels
+        (the char-LSTM/evaluator convention) — reshape them here, and
+        check S divides the seq axis. `batched` handles train_many's
+        extra leading K dim."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        if self.mode != "seq":
+            return x, y
+        lead = (x.shape[0],) if batched else ()
+        n, s = x.shape[len(lead)], x.shape[len(lead) + 1]
+        n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
+        if s % n_seq:
+            raise ValueError(f"sequence length {s} not divisible by the "
+                             f"mesh seq axis ({n_seq} shards)")
+        if y.ndim == 1 + len(lead) and y.size == np.prod(lead + (n, s)):
+            y = y.reshape(lead + (n, s))
+        return x, y
 
     # -- forward chain -------------------------------------------------------
 
@@ -174,7 +229,12 @@ class FusedTrainStep:
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
             params = _tree_cast(params, self.compute_dtype)
+        seq_axis = SEQ_AXIS if self.mode == "seq" else None
         for i, u in enumerate(self.forwards):
+            if hasattr(u, "seq_axis_name"):
+                # set at trace time so several step objects (different
+                # modes) over one workflow each trace the right kernel
+                u.seq_axis_name = seq_axis
             k = jax.random.fold_in(key, i) if u.fused_needs_key else None
             x = u.fused_apply(params[i], x, key=k, train=train)
         if self.compute_dtype is not None:
@@ -185,7 +245,10 @@ class FusedTrainStep:
         out = self._forward(params, x, key, train)
         if self.loss_kind == "softmax":
             loss = ox.ce_loss_from_logits(out, y, self.n_classes)
-            n_err = (out.argmax(axis=-1) != y).sum()
+            # flatten leading dims: (N, C) classifiers and (N, S, C)
+            # per-token LM heads (labels may arrive flat (N·S,) or (N, S))
+            n_err = (out.reshape(-1, out.shape[-1]).argmax(axis=-1)
+                     != y.reshape(-1)).sum()
         else:
             loss, _ = ox.mse(out, y)
             n_err = loss
@@ -193,11 +256,21 @@ class FusedTrainStep:
 
     # -- step bodies ---------------------------------------------------------
 
-    def _train_body(self, state, x, y, *, axis: Optional[str]):
+    def _train_body(self, state, x, y, *, axis):
+        """axis: None (local/gspmd), a mesh axis name, or a tuple of axis
+        names (the "seq" mode reduces over ("data", "seq"))."""
+        axes = (axis,) if isinstance(axis, str) else axis
         step_key = state["key"]
-        n_shards = 1 if axis is None else self.mesh.shape[axis]
-        if axis is not None:  # decorrelate dropout/stochastic-pool per shard
-            step_key = jax.random.fold_in(step_key, lax.axis_index(axis))
+        n_shards = 1
+        if axes:
+            for a in axes:
+                n_shards *= self.mesh.shape[a]
+            # decorrelate dropout/stochastic-pool per shard via the global
+            # linear shard index
+            idx = lax.axis_index(axes[0])
+            for a in axes[1:]:
+                idx = idx * self.mesh.shape[a] + lax.axis_index(a)
+            step_key = jax.random.fold_in(step_key, idx)
 
         def lf(p):
             loss, n_err = self._loss_metrics(p, x, y, step_key, True)
@@ -212,11 +285,11 @@ class FusedTrainStep:
 
         (_, (loss, n_err)), grads = jax.value_and_grad(
             lf, has_aux=True)(state["params"])
-        if axis is not None:
-            loss = lax.pmean(loss, axis)
-            n_err = (lax.psum(n_err, axis)
+        if axes:
+            loss = lax.pmean(loss, axes)
+            n_err = (lax.psum(n_err, axes)
                      if self.loss_kind == "softmax"
-                     else lax.pmean(n_err, axis))
+                     else lax.pmean(n_err, axes))
         new_params, new_vel = [], []
         for p, g, v, cfg in zip(state["params"], grads, state["vel"],
                                 self.cfgs):
@@ -234,14 +307,15 @@ class FusedTrainStep:
                      "key": new_key, "lr_scale": state["lr_scale"]}
         return new_state, loss, n_err
 
-    def _eval_body(self, params, x, y, *, axis: Optional[str]):
+    def _eval_body(self, params, x, y, *, axis):
+        axes = (axis,) if isinstance(axis, str) else axis
         key = jax.random.PRNGKey(0)  # unused: eval paths need no RNG
         loss, n_err = self._loss_metrics(params, x, y, key, False)
-        if axis is not None:
-            loss = lax.pmean(loss, axis)
-            n_err = (lax.psum(n_err, axis)
+        if axes:
+            loss = lax.pmean(loss, axes)
+            n_err = (lax.psum(n_err, axes)
                      if self.loss_kind == "softmax"
-                     else lax.pmean(n_err, axis))
+                     else lax.pmean(n_err, axes))
         return loss, n_err
 
     # -- compilation ---------------------------------------------------------
@@ -265,6 +339,22 @@ class FusedTrainStep:
                 lambda p, x, y: self._eval_body(p, x, y, axis=DATA_AXIS),
                 mesh=mesh,
                 in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P()))
+            self._train_fn = jax.jit(train, donate_argnums=donate)
+            self._eval_fn = jax.jit(evalf)
+        elif self.mode == "seq":
+            mesh = self.mesh
+            axes = (DATA_AXIS, SEQ_AXIS)
+            xspec = P(DATA_AXIS, SEQ_AXIS)  # (N, S, ...) batch x sequence
+            train = jax.shard_map(
+                lambda s, x, y: self._train_body(s, x, y, axis=axes),
+                mesh=mesh,
+                in_specs=(P(), xspec, xspec),
+                out_specs=(P(), P(), P()))
+            evalf = jax.shard_map(
+                lambda p, x, y: self._eval_body(p, x, y, axis=axes),
+                mesh=mesh,
+                in_specs=(P(), xspec, xspec),
                 out_specs=(P(), P()))
             self._train_fn = jax.jit(train, donate_argnums=donate)
             self._eval_fn = jax.jit(evalf)
@@ -313,8 +403,8 @@ class FusedTrainStep:
         if self._train_fn is None:
             self._build()
         self._check_batch(np.shape(x)[0])
-        new_state, loss, n_err = self._train_fn(state, jnp.asarray(x),
-                                                jnp.asarray(y))
+        x, y = self._seq_xy(x, y)
+        new_state, loss, n_err = self._train_fn(state, x, y)
         return new_state, (loss, n_err)
 
     def evaluate(self, state, x, y):
@@ -322,7 +412,8 @@ class FusedTrainStep:
         if self._eval_fn is None:
             self._build()
         self._check_batch(np.shape(x)[0])
-        return self._eval_fn(state["params"], jnp.asarray(x), jnp.asarray(y))
+        x, y = self._seq_xy(x, y)
+        return self._eval_fn(state["params"], x, y)
 
     def train_many(self, state, xs, ys):
         """K training steps in ONE dispatch: xs (K, batch, ...), ys
@@ -330,21 +421,40 @@ class FusedTrainStep:
         sequential updates, one host->device round trip. This is the
         dispatch-amortized hot loop (the reference's analog was K×dozens
         of kernel enqueues; through a remote PJRT tunnel per-step dispatch
-        latency is real money). Returns (state, (losses, n_errs)) with
+        latency is real money). Works in every mode: local plain scan,
+        "dp" as scan INSIDE the shard_map (collectives fire per scan
+        iteration), "gspmd" as a scan whose per-step batch carries the
+        data-axis sharding. Returns (state, (losses, n_errs)) with
         leading dim K."""
-        if self.mode not in ("local",):
-            # sharded modes: per-step train() already amortizes inside
-            # the mesh; scan composition with shard_map is future work
-            raise NotImplementedError("train_many supports local mode")
         self._check_batch(np.shape(xs)[1])
+        xs, ys = self._seq_xy(xs, ys, batched=True)
         if self._train_many_fn is None:
+            axis = {"dp": DATA_AXIS, "seq": (DATA_AXIS, SEQ_AXIS)}.get(
+                self.mode)
+
             def many(state, xs, ys):
                 def step(st, xy):
                     st2, loss, n_err = self._train_body(
-                        st, xy[0], xy[1], axis=None)
+                        st, xy[0], xy[1], axis=axis)
                     return st2, (loss, n_err)
                 return lax.scan(step, state, (xs, ys))
 
-            self._train_many_fn = jax.jit(
-                many, donate_argnums=(0,) if self.donate else ())
-        return self._train_many_fn(state, jnp.asarray(xs), jnp.asarray(ys))
+            donate = (0,) if self.donate else ()
+            if self.mode == "local":
+                self._train_many_fn = jax.jit(many, donate_argnums=donate)
+            elif self.mode in ("dp", "seq"):
+                spec = (P(None, DATA_AXIS, SEQ_AXIS)
+                        if self.mode == "seq" else P(None, DATA_AXIS))
+                sm = jax.shard_map(
+                    many, mesh=self.mesh,
+                    in_specs=(P(), spec, spec),
+                    out_specs=(P(), (P(), P())))
+                self._train_many_fn = jax.jit(sm, donate_argnums=donate)
+            elif self.mode == "gspmd":
+                xsh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+                self._train_many_fn = jax.jit(
+                    many, in_shardings=(self._state_shardings(), xsh, xsh),
+                    donate_argnums=donate)
+            else:
+                raise ValueError(f"unknown mode {self.mode!r}")
+        return self._train_many_fn(state, xs, ys)

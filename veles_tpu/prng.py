@@ -67,17 +67,30 @@ class RandomGenerator:
 
 
 _generators: Dict[str, RandomGenerator] = {}
+_base_seed: Optional[int] = None
 
 
-def get(name: str = "default", seed: int = 1234) -> RandomGenerator:
-    """Fetch (creating on first use) the named global generator."""
+def get(name: str = "default",
+        seed: Optional[int] = None) -> RandomGenerator:
+    """Fetch (creating on first use) the named global generator. An
+    explicit `seed` wins; otherwise a prior `seed_all(s)` governs
+    generators created later too: they get s + registration_index,
+    exactly as if they had existed at seed_all time (otherwise the FIRST
+    run in a process silently used the default seed — seed_all over an
+    empty registry was a no-op)."""
     gen = _generators.get(name)
     if gen is None:
+        if seed is None:
+            seed = (_base_seed + len(_generators)
+                    if _base_seed is not None else 1234)
         gen = _generators[name] = RandomGenerator(name, seed)
     return gen
 
 
 def seed_all(seed: int) -> None:
-    """Reseed every registered generator (functional-test determinism)."""
+    """Reseed every registered generator — and every FUTURE one —
+    deterministically (functional-test determinism)."""
+    global _base_seed
+    _base_seed = int(seed)
     for i, gen in enumerate(_generators.values()):
         gen.seed(seed + i)

@@ -1,0 +1,68 @@
+"""Character-transformer language model — the long-context training
+config (sequence parallelism exercised end-to-end).
+
+No reference analog (SURVEY.md §5.7: the 2015 codebase has no attention);
+this sample exists because long-context/distributed support is
+first-class in the TPU build: the same workflow trains locally, or with
+the sequence dim sharded over a mesh "seq" axis (ring/Ulysses attention,
+FusedTrainStep "seq" mode) — `root.char_transformer.parallel_mode`
+selects the kernel. Exposes `run(load, main)`.
+
+Geometry: one-hot chars -> SeqLinear embed (+learned positions) ->
+causal MultiHeadAttention (residual) -> SeqFFN (residual) -> per-token
+SeqSoftmax(V).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root
+from veles_tpu.loader.text import CharSequenceLoader
+from veles_tpu.znicz import attention  # noqa: F401 (registers layer type)
+from veles_tpu.znicz import transformer  # noqa: F401 (registers types)
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.char_transformer.loader.minibatch_size = 32
+root.char_transformer.loader.seq_len = 32
+root.char_transformer.loader.n_validation = 40
+root.char_transformer.embed = 64
+root.char_transformer.n_heads = 4
+root.char_transformer.ffn = 128
+root.char_transformer.parallel_mode = "local"  # | "ring" | "ulysses"
+root.char_transformer.decision.max_epochs = 5
+root.char_transformer.decision.fail_iterations = 20
+root.char_transformer.gd.learning_rate = 0.2
+root.char_transformer.gd.gradient_moment = 0.9
+
+
+class CharTransformerWorkflow(StandardWorkflow):
+    """embed → causal attention → FFN → per-token softmax(V)."""
+
+
+def create_workflow(text: str = None) -> CharTransformerWorkflow:
+    cfg = root.char_transformer
+    loader = CharSequenceLoader(
+        text=text, seq_len=cfg.loader.seq_len,
+        n_validation=cfg.loader.n_validation,
+        minibatch_size=cfg.loader.minibatch_size)
+    e = cfg.embed
+    return CharTransformerWorkflow(
+        layers=[
+            {"type": "seq_linear", "output_features": e,
+             "pos_embed": True, "weights_stddev": 0.05},
+            {"type": "attention", "n_heads": cfg.n_heads, "causal": True,
+             "residual": True, "parallel_mode": cfg.parallel_mode,
+             "weights_stddev": 0.05},
+            {"type": "seq_ffn", "hidden": cfg.ffn, "activation": "tanh",
+             "weights_stddev": 0.05},
+            {"type": "seq_softmax", "output_features": loader.n_vocab,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=loader.n_vocab,
+        decision_config=cfg.decision.to_dict(),
+        gd_config=cfg.gd.to_dict(),
+        name="CharTransformerWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

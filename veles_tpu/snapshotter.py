@@ -14,6 +14,13 @@ TPU-first notes:
 - A fused-step state pytree (`workflow.fused_state`) is written back into
   the unit Arrays by `StandardWorkflow.run_fused` before snapshot time, so
   both execution modes produce interchangeable snapshots.
+
+TRUST MODEL: snapshots are pickles, and `pickle.load` executes arbitrary
+code — so `import_()`/`latest()` must only ever be pointed at snapshots
+YOU wrote (local resume, the reference's exact trust boundary). For
+*exchanging* models (forge/zoo), use the data-only package format
+(`veles_tpu.export`: topology.json + weights.bin) which the C++ engine
+and `Forge` consume without unpickling anything.
 """
 
 from __future__ import annotations

@@ -3,10 +3,11 @@
 Not present in the reference (SURVEY.md §5.7: no attention anywhere in the
 2015 codebase) — added because long-context support is first-class in the
 TPU build. Follows the house unit pattern: a Forward twin with a
-vjp-driven GD twin, fused_apply for the one-step compiled path, and
-`seq_shards` plumbing so the fused/sharded step can run the ring or
-Ulysses sequence-parallel kernels over the mesh "seq" axis
-(ops/attention.py).
+vjp-driven GD twin, fused_apply for the one-step compiled path, and a
+`seq_axis_name` attribute (set by FusedTrainStep's "seq" mode) that
+routes fused_apply to the ring or Ulysses sequence-parallel kernels over
+the mesh "seq" axis (ops/attention.py) — sequence parallelism is
+trainable end-to-end, not ops-level only.
 """
 
 from __future__ import annotations
@@ -19,8 +20,7 @@ import numpy as np
 
 from veles_tpu.memory import Array
 from veles_tpu.ops import attention as oa
-from veles_tpu.ops.optim import SGDConfig, sgd_update
-from veles_tpu.znicz.nn_units import (Forward, GradientDescentBase,
+from veles_tpu.znicz.nn_units import (Forward, GradientDescentVJP,
                                       register_gd)
 
 
@@ -31,13 +31,20 @@ class MultiHeadAttention(Forward):
 
     def __init__(self, workflow=None, n_heads: int = 4,
                  head_dim: int = None, causal: bool = True,
-                 parallel_mode: str = "local",
+                 parallel_mode: str = "local", residual: bool = False,
                  use_flash: str = "auto", **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.n_heads = n_heads
         self.head_dim = head_dim
         self.causal = causal
         self.parallel_mode = parallel_mode
+        #: y = x + attn(x) — the transformer-block form. Purely local
+        #: (element-wise add), so it composes with every parallel_mode.
+        self.residual = residual
+        #: mesh axis name the sequence dim is sharded over; set by
+        #: FusedTrainStep's "seq" mode so fused_apply runs the ring /
+        #: Ulysses kernel instead of the local one. None = local.
+        self.seq_axis_name = None
         #: "auto": the Pallas flash kernel on TPU when S is long enough to
         #: beat the XLA einsum (and divisible into blocks); "on"/"off"
         #: force it. See ops/pallas_kernels.flash_attention_pallas.
@@ -102,10 +109,11 @@ class MultiHeadAttention(Forward):
         else:
             raise ValueError(f"unknown parallel_mode "
                              f"{self.parallel_mode!r}")
-        return o.reshape(n, s, h * d) @ params["wo"]
+        y = o.reshape(n, s, h * d) @ params["wo"]
+        return x + y if self.residual else y
 
     def fused_apply(self, params, x, *, key=None, train=True):
-        return self._apply(params, x)
+        return self._apply(params, x, axis_name=self.seq_axis_name)
 
     def xla_init(self):
         self._fn = self.jit(lambda x, p: self._apply(p, x,
@@ -126,65 +134,9 @@ class MultiHeadAttention(Forward):
 
 
 @register_gd(MultiHeadAttention)
-class GDMultiHeadAttention(GradientDescentBase):
-    """Backward via jax.vjp of the forward + fused SGD update."""
-
-    def link_forward(self, fwd: MultiHeadAttention
-                     ) -> "GDMultiHeadAttention":
-        self.link_attrs(fwd, "wq", "wk", "wv", "wo", "input", "output")
-        self._fwd = fwd
-        return self
-
-    def initialize(self, device=None, **kwargs: Any):
-        if not self.err_output or not self.wq:
-            return False
-        for name in ("wq", "wk", "wv", "wo"):
-            vname = f"vel_{name}"
-            if getattr(self, vname, None) is None or not getattr(self,
-                                                                 vname):
-                arr = Array()
-                arr.reset(np.zeros(getattr(self, name).shape, np.float32))
-                setattr(self, vname, arr)
-        if not self.err_input or self.err_input.shape != self.input.shape:
-            self.err_input.reset(np.zeros(self.input.shape, np.float32))
-        return super().initialize(device=device, **kwargs)
-
-    def xla_init(self):
-        fwd = self._fwd
-        cfg = SGDConfig(lr=self.learning_rate,
-                        momentum=self.gradient_moment,
-                        weight_decay=self.weights_decay,
-                        l1_decay=self.l1_decay)
-
-        def step(x, params, err_y, vel, lr_scale):
-            _, vjp = jax.vjp(lambda p, xx: fwd._apply(p, xx), params, x)
-            grads, err_x = vjp(err_y)
-            new_p, new_v = sgd_update(params, grads, vel, cfg, lr_scale)
-            return err_x, new_p, new_v
-
-        self._fn = self.jit(step, donate_argnums=(3,))
-        return None
-
-    def numpy_run(self) -> None:
-        self.xla_run()  # vjp is the only backward model (no 2015 twin)
-
-    def xla_run(self) -> None:
-        dv = self.device
-        names = ("wq", "wk", "wv", "wo")
-        params = {n: getattr(self, n).devmem(dv) for n in names}
-        vel = {n: getattr(self, f"vel_{n}").devmem(dv) for n in names}
-        err_x, new_p, new_v = self._fn(
-            self.input.devmem(dv), params, self.err_output.devmem(dv),
-            vel, jnp.float32(self.lr_scale))
-        self.err_input.set_devmem(err_x)
-        for n in names:
-            getattr(self, n).set_devmem(new_p[n])
-            getattr(self, f"vel_{n}").set_devmem(new_v[n])
-
-    def __getstate__(self):
-        st = super().__getstate__()
-        st.pop("_fwd", None)
-        return st
+class GDMultiHeadAttention(GradientDescentVJP):
+    """Backward via jax.vjp of the forward + fused SGD update
+    (GradientDescentVJP drives everything off param_arrays())."""
 
 
 from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402

@@ -17,8 +17,7 @@ import numpy as np
 
 from veles_tpu.memory import Array
 from veles_tpu.ops import moe as om
-from veles_tpu.ops.optim import SGDConfig, sgd_update
-from veles_tpu.znicz.nn_units import (Forward, GradientDescentBase,
+from veles_tpu.znicz.nn_units import (Forward, GradientDescentVJP,
                                       register_gd)
 
 
@@ -91,69 +90,10 @@ class MoELayer(Forward):
 
 
 @register_gd(MoELayer)
-class GDMoELayer(GradientDescentBase):
+class GDMoELayer(GradientDescentVJP):
     """Backward via jax.vjp of the dense routing forward + SGD update.
     (The top-1 argmax is non-differentiable by construction — gradients
     flow through the gate value and the expert FFNs, switch-style.)"""
-
-    def link_forward(self, fwd: MoELayer) -> "GDMoELayer":
-        self.link_attrs(fwd, "wr", "w1", "b1", "w2", "b2", "input",
-                        "output")
-        self._fwd = fwd
-        return self
-
-    _PNAMES = ("wr", "w1", "b1", "w2", "b2")
-
-    def initialize(self, device=None, **kwargs: Any):
-        if not self.err_output or not self.wr:
-            return False
-        for name in self._PNAMES:
-            vname = f"vel_{name}"
-            if getattr(self, vname, None) is None or not getattr(self,
-                                                                 vname):
-                arr = Array()
-                arr.reset(np.zeros(getattr(self, name).shape, np.float32))
-                setattr(self, vname, arr)
-        if not self.err_input or self.err_input.shape != self.input.shape:
-            self.err_input.reset(np.zeros(self.input.shape, np.float32))
-        return super().initialize(device=device, **kwargs)
-
-    def xla_init(self):
-        fwd = self._fwd
-        cfg = SGDConfig(lr=self.learning_rate,
-                        momentum=self.gradient_moment,
-                        weight_decay=self.weights_decay,
-                        l1_decay=self.l1_decay)
-
-        def step(x, params, err_y, vel, lr_scale):
-            _, vjp = jax.vjp(lambda p, xx: fwd._apply(p, xx), params, x)
-            grads, err_x = vjp(err_y)
-            new_p, new_v = sgd_update(params, grads, vel, cfg, lr_scale)
-            return err_x, new_p, new_v
-
-        self._fn = self.jit(step, donate_argnums=(3,))
-        return None
-
-    def numpy_run(self) -> None:
-        self.xla_run()  # vjp is the only backward model
-
-    def xla_run(self) -> None:
-        dv = self.device
-        params = {n: getattr(self, n).devmem(dv) for n in self._PNAMES}
-        vel = {n: getattr(self, f"vel_{n}").devmem(dv)
-               for n in self._PNAMES}
-        err_x, new_p, new_v = self._fn(
-            self.input.devmem(dv), params, self.err_output.devmem(dv),
-            vel, jnp.float32(self.lr_scale))
-        self.err_input.set_devmem(err_x.reshape(self.input.shape))
-        for n in self._PNAMES:
-            getattr(self, n).set_devmem(new_p[n])
-            getattr(self, f"vel_{n}").set_devmem(new_v[n])
-
-    def __getstate__(self):
-        st = super().__getstate__()
-        st.pop("_fwd", None)
-        return st
 
 
 from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple, Type
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from veles_tpu import prng
@@ -187,6 +189,82 @@ class GradientDescentBase(XLAUnit):
                                       self.weights.dtype))
         if not self.vel_b and self.bias:
             self.vel_b.reset(np.zeros(self.bias.shape, self.bias.dtype))
+
+
+class GradientDescentVJP(GradientDescentBase):
+    """Generic vjp-driven GD twin: the forward unit's `_apply(params, x)`
+    IS the backward model (jax.vjp differentiates it), parameters are
+    whatever `param_arrays()` names, and velocities live as vel_<name>.
+    Used by the attention/MoE/transformer families, whose backward has no
+    2015-reference twin to mirror (the conv/FC units keep hand-derived
+    backward paths for reference parity)."""
+
+    def link_forward(self, fwd: Forward):
+        names = tuple(fwd.param_arrays())
+        self._pnames = names
+        self.link_attrs(fwd, "input", "output", *names)
+        self._fwd = fwd
+        return self
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.err_output or not getattr(self, self._pnames[0]):
+            return False
+        for name in self._pnames:
+            vname = f"vel_{name}"
+            if getattr(self, vname, None) is None \
+                    or not getattr(self, vname):
+                arr = Array()
+                arr.reset(np.zeros(getattr(self, name).shape, np.float32))
+                setattr(self, vname, arr)
+        if not self.err_input or self.err_input.shape != self.input.shape:
+            self.err_input.reset(np.zeros(self.input.shape, np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def _backward_model(self, params, x):
+        return self._fwd._apply(params, x)
+
+    def xla_init(self):
+        from veles_tpu.ops.optim import SGDConfig, sgd_update
+        cfg = SGDConfig(lr=self.learning_rate,
+                        momentum=self.gradient_moment,
+                        weight_decay=self.weights_decay,
+                        l1_decay=self.l1_decay)
+
+        def step(x, params, err_y, vel, lr_scale):
+            _, vjp = jax.vjp(
+                lambda p, xx: self._backward_model(p, xx), params, x)
+            grads, err_x = vjp(err_y)
+            new_p, new_v = sgd_update(params, grads, vel, cfg, lr_scale)
+            return err_x, new_p, new_v
+
+        self._fn = self.jit(step, donate_argnums=(3,))
+        return None
+
+    def numpy_run(self) -> None:
+        self.xla_run()  # vjp is the only backward model
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {n: getattr(self, n).devmem(dv) for n in self._pnames}
+        vel = {n: getattr(self, f"vel_{n}").devmem(dv)
+               for n in self._pnames}
+        err_y = self.err_output.devmem(dv)
+        if hasattr(self, "_err_reshape"):
+            # heads whose evaluator-facing output is flattened (N·S, V)
+            # while the differentiated model emits (N, S, V)
+            err_y = err_y.reshape(self._err_reshape())
+        err_x, new_p, new_v = self._fn(
+            self.input.devmem(dv), params, err_y, vel,
+            jnp.float32(self.lr_scale))
+        self.err_input.set_devmem(err_x.reshape(self.input.shape))
+        for n in self._pnames:
+            getattr(self, n).set_devmem(new_p[n])
+            getattr(self, f"vel_{n}").set_devmem(new_v[n])
+
+    def __getstate__(self):
+        st = super().__getstate__()
+        st.pop("_fwd", None)
+        return st
 
 
 class NNWorkflow:

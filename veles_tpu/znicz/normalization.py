@@ -28,6 +28,10 @@ class LRNormalizerForward(Forward):
     def __init__(self, workflow=None, k: float = 2.0, alpha: float = 1e-4,
                  beta: float = 0.75, n: int = 5, **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
+        if n % 2 == 0:
+            # all four twins (XLA shifted-adds, Pallas, numpy reference,
+            # C++ engine) use a ±n//2 window; even n would mean n+1 taps
+            raise ValueError(f"LRN window n must be odd, got {n}")
         self.k = k
         self.alpha = alpha
         self.beta = beta

@@ -1,0 +1,234 @@
+"""Sequence-preserving (time-distributed) layers for transformer LMs.
+
+Not in the reference (SURVEY.md §5.7: the 2015 codebase has no attention
+and its only sequence model host-unrolled an LSTM) — these units exist so
+the long-context path (MultiHeadAttention + ring/Ulysses sequence
+parallelism, znicz/attention.py) is reachable from a real TRAINING
+workflow, not just ops-level tests.
+
+House pattern: Forward twin + vjp-driven GD twin; `fused_apply` keeps the
+(N, S, D) sequence structure so FusedTrainStep's "seq" mode can shard S
+over the mesh "seq" axis. Granular mode flattens at the softmax head to
+(N·S, V) so the standard EvaluatorSoftmax/Decision stack consumes
+per-token predictions exactly like the char-LSTM convention
+(loader/text.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from veles_tpu.memory import Array
+from veles_tpu.ops import xla as ox
+from veles_tpu.znicz.nn_units import (Forward, GradientDescentVJP,
+                                      register_gd)
+
+
+class SeqLinear(Forward):
+    """Position-wise linear: x (N, S, Din) -> act(x @ W + b) (N, S, Dout),
+    optionally adding a learned positional embedding (pos_embed=True —
+    the embedding layer of a transformer LM when fed one-hot tokens).
+
+    Under the fused "seq" mode the sequence dim is sharded; the pos table
+    is replicated and each shard slices its own rows at
+    axis_index * S_local (`seq_axis_name` is set by FusedTrainStep)."""
+
+    def __init__(self, workflow=None, output_features: int = 64,
+                 activation: str = "linear", pos_embed: bool = False,
+                 max_seq: int = 0, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.output_features = output_features
+        self.activation = activation
+        self.pos_embed = pos_embed
+        self.max_seq = max_seq
+        self.pos = Array()
+        #: set by FusedTrainStep in "seq" mode; None = sequence is local
+        self.seq_axis_name = None
+
+    def param_arrays(self) -> Dict[str, Array]:
+        out = {"weights": self.weights, "bias": self.bias}
+        if self.pos_embed:
+            out["pos"] = self.pos
+        return out
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, s, din = self.input.shape
+        dout = self.output_features
+        self.init_params((din, dout), fan_in=din)
+        if self.pos_embed:
+            smax = self.max_seq or s
+            if smax < s:
+                # dynamic_slice CLAMPS out-of-range starts — an undersized
+                # table would silently feed wrong/duplicated position rows
+                raise ValueError(
+                    f"pos_embed table max_seq={smax} shorter than the "
+                    f"input sequence length {s}")
+            if not self.pos:
+                std = self.weights_stddev or self.default_stddev(din)
+                self.pos.reset(self._fill((smax, dout),
+                                          self.weights_filling, std))
+        if not self.output or self.output.shape != (n, s, dout):
+            self.output.reset(np.zeros((n, s, dout), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def _apply(self, params, x, seq_axis_name=None):
+        """seq_axis_name is passed EXPLICITLY by fused_apply (from the
+        unit attr FusedTrainStep sets at trace time); the granular
+        numpy_run/xla_run paths and the VJP GD twin call with the default
+        None, so they never execute lax.axis_index outside a shard_map."""
+        y = x @ params["weights"] + params["bias"]
+        if self.pos_embed:
+            s_loc = x.shape[1]
+            if seq_axis_name is not None:
+                off = lax.axis_index(seq_axis_name) * s_loc
+            else:
+                off = 0
+            rows = lax.dynamic_slice_in_dim(params["pos"], off, s_loc, 0)
+            y = y + rows[None]
+        return ox.act_forward(self.activation, y)
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return self._apply(params, x, seq_axis_name=self.seq_axis_name)
+
+    def xla_init(self):
+        self._fn = self.jit(lambda x, p: self._apply(p, x))
+        return None
+
+    def numpy_run(self) -> None:
+        params = {k: jnp.asarray(a.mem)
+                  for k, a in self.param_arrays().items()}
+        self.output.mem = np.asarray(self._apply(params, self.input.mem))
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {k: a.devmem(dv) for k, a in self.param_arrays().items()}
+        self.output.set_devmem(self._fn(self.input.devmem(dv), params))
+
+
+class SeqFFN(Forward):
+    """Transformer FFN block with residual: y = x + W2·act(W1·x + b1) + b2.
+    x (N, S, E) -> (N, S, E); hidden width `hidden`. The residual add is
+    element-wise, so it composes with sequence sharding untouched."""
+
+    def __init__(self, workflow=None, hidden: int = 128,
+                 activation: str = "tanh", **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.hidden = hidden
+        self.activation = activation
+        self.w2 = Array()
+        self.b2 = Array()
+
+    def param_arrays(self) -> Dict[str, Array]:
+        return {"weights": self.weights, "bias": self.bias,
+                "w2": self.w2, "b2": self.b2}
+
+    def initialize(self, device=None, **kwargs: Any):
+        if not self.input:
+            return False
+        n, s, e = self.input.shape
+        h = self.hidden
+        self.init_params((e, h), fan_in=e)
+        if not self.w2:
+            std = self.weights_stddev or self.default_stddev(h)
+            self.w2.reset(self._fill((h, e), self.weights_filling, std))
+            self.b2.reset(np.zeros((e,), np.float32))
+        if not self.output or self.output.shape != (n, s, e):
+            self.output.reset(np.zeros((n, s, e), np.float32))
+        return super().initialize(device=device, **kwargs)
+
+    def _apply(self, params, x):
+        hmid = ox.act_forward(self.activation,
+                              x @ params["weights"] + params["bias"])
+        return x + hmid @ params["w2"] + params["b2"]
+
+    def fused_apply(self, params, x, *, key=None, train=True):
+        return self._apply(params, x)
+
+    def xla_init(self):
+        self._fn = self.jit(lambda x, p: self._apply(p, x))
+        return None
+
+    def numpy_run(self) -> None:
+        params = {k: jnp.asarray(a.mem)
+                  for k, a in self.param_arrays().items()}
+        self.output.mem = np.asarray(self._apply(params, self.input.mem))
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {k: a.devmem(dv) for k, a in self.param_arrays().items()}
+        self.output.set_devmem(self._fn(self.input.devmem(dv), params))
+
+
+class SeqSoftmax(SeqLinear):
+    """Per-position softmax head: x (N, S, E) -> logits (N, S, V) in the
+    fused path (log-softmax CE consumes logits; sequence structure kept
+    for the "seq" sharding), probabilities flattened to (N·S, V) in the
+    granular path so EvaluatorSoftmax sees the char-LSTM convention."""
+
+    fused_emits_logits = True
+
+    def initialize(self, device=None, **kwargs: Any):
+        ok = super().initialize(device=device, **kwargs)
+        if ok is False:
+            return False
+        n, s, _ = self.input.shape
+        v = self.output_features
+        if self.output.shape != (n * s, v):
+            self.output.reset(np.zeros((n * s, v), np.float32))
+        return ok
+
+    def numpy_run(self) -> None:
+        params = {k: jnp.asarray(a.mem)
+                  for k, a in self.param_arrays().items()}
+        logits = self._apply(params, self.input.mem)
+        probs = jax.nn.softmax(logits, axis=-1)
+        self.output.mem = np.asarray(probs).reshape(-1, probs.shape[-1])
+
+    def xla_init(self):
+        def fn(x, p):
+            probs = jax.nn.softmax(self._apply(p, x), axis=-1)
+            return probs.reshape(-1, probs.shape[-1])
+
+        self._fn = self.jit(fn)
+        return None
+
+    def xla_run(self) -> None:
+        dv = self.device
+        params = {k: a.devmem(dv) for k, a in self.param_arrays().items()}
+        self.output.set_devmem(self._fn(self.input.devmem(dv), params))
+
+
+@register_gd(SeqLinear)
+class GDSeqLinear(GradientDescentVJP):
+    pass
+
+
+@register_gd(SeqFFN)
+class GDSeqFFN(GradientDescentVJP):
+    pass
+
+
+@register_gd(SeqSoftmax)
+class GDSeqSoftmax(GradientDescentVJP):
+    """err_output arrives flattened (N·S, V) from the evaluator (probs −
+    onehot over logits, the same gradient as log-softmax CE); the
+    backward model therefore composes softmax-CE's logit gradient: we
+    differentiate the LOGITS (N, S, V), so the incoming error is exactly
+    dL/dlogits reshaped to sequence form."""
+
+    def _err_reshape(self):
+        n, s, _ = self.input.shape
+        return (n, s, -1)
+
+
+from veles_tpu.znicz import standard_workflow as _sw  # noqa: E402
+
+_sw.LAYER_TYPES.update({"seq_linear": SeqLinear, "seq_ffn": SeqFFN,
+                        "seq_softmax": SeqSoftmax})
