@@ -275,3 +275,54 @@ def test_train_many_sharded_matches_sequential(mesh_kw, mode,
             np.testing.assert_allclose(np.asarray(pa[k]),
                                        np.asarray(pb[k]),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_precision_type_config_sets_fused_dtype():
+    """root.common.precision_type (the reference's global precision knob,
+    SURVEY.md §2.2) governs the fused step's default compute dtype; an
+    explicit compute_dtype argument still wins."""
+    from veles_tpu.config import root
+    prev = root.common.precision_type
+    try:
+        root.common.precision_type = "bfloat16"
+        wf = build()
+        wf.initialize(device=None)
+        step = wf.build_fused_step()
+        assert step.compute_dtype == "bfloat16"
+        state = step.init_state()
+        rng = np.random.RandomState(0)
+        x = rng.randn(48, 8, 8).astype(np.float32)
+        y = rng.randint(0, 10, 48)
+        state, (loss, _) = step.train(state, x, y)
+        assert np.isfinite(float(loss))
+        # master weights stay f32 regardless of compute precision
+        assert state["params"][0]["weights"].dtype == np.float32
+        # explicit argument overrides the knob
+        assert wf.build_fused_step(
+            compute_dtype="float32").compute_dtype == "float32"
+        root.common.precision_type = "float32"
+        assert wf.build_fused_step().compute_dtype is None
+    finally:
+        root.common.precision_type = prev
+
+
+def test_seq_mode_rejects_bad_labels(eight_devices):
+    """seq mode must fail with a clear shape message when labels cannot
+    be brought to per-token (N, S) form (ADVICE r2)."""
+    from veles_tpu.config import root
+    from veles_tpu.samples.char_transformer import create_workflow
+    prng.seed_all(11)
+    prev = root.char_transformer.parallel_mode
+    try:
+        root.char_transformer.parallel_mode = "ring"
+        wf = create_workflow()
+        wf.initialize(device=None)
+        mesh = make_mesh(model=1, seq=4)
+        step = wf.build_fused_step(mesh, mode="seq")
+        state = step.init_state()
+        x = wf.loader.data.mem[:8]
+        bad_y = np.zeros(8, np.int64)  # classifier-shaped: not per-token
+        with pytest.raises(ValueError, match="per-token"):
+            step.train(state, x, bad_y)
+    finally:
+        root.char_transformer.parallel_mode = prev

@@ -84,6 +84,15 @@ class FusedTrainStep:
         self.forwards = list(workflow.forwards)
         self.loss_kind = workflow.loss
         self.n_classes = getattr(workflow, "n_classes", None)
+        if compute_dtype is None:
+            # root.common.precision_type is the reference's global
+            # precision knob (SURVEY.md §2.2 dtype mapping row); it sets
+            # the default compute dtype for fused steps. "float32" means
+            # no cast (params are already f32 master weights).
+            from veles_tpu.config import root
+            pt = getattr(root.common, "precision_type", None)
+            if pt and pt != "float32":
+                compute_dtype = pt
         self.compute_dtype = compute_dtype
         if self.loss_kind == "softmax" and not getattr(
                 self.forwards[-1], "fused_emits_logits", False):
@@ -221,6 +230,14 @@ class FusedTrainStep:
                              f"mesh seq axis ({n_seq} shards)")
         if y.ndim == 1 + len(lead) and y.size == np.prod(lead + (n, s)):
             y = y.reshape(lead + (n, s))
+        elif (y.ndim != 2 + len(lead)
+              or y.shape[len(lead):] != (n, s)):
+            # fail HERE with shapes, not inside shard_map with an opaque
+            # rank/spec mismatch: seq mode shards labels over (data, seq)
+            # so they must be per-token
+            raise ValueError(
+                f"seq mode needs per-token labels shaped {lead + (n, s)} "
+                f"or flat ({np.prod(lead + (n, s))},); got {y.shape}")
         return x, y
 
     # -- forward chain -------------------------------------------------------
