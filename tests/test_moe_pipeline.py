@@ -93,6 +93,76 @@ def test_moe_unit_trains():
         wf.decision.best_validation_err
 
 
+def _build_moe_wf(seed=1234, minibatch=32):
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.seed_all(seed)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(12,), n_validation=32, n_train=128,
+        minibatch_size=minibatch, noise=0.3)
+    return StandardWorkflow(
+        layers=[
+            # capacity_factor = n_experts -> capacity = n_tokens: zero
+            # drops, so the dense and EP forms are exactly equivalent
+            {"type": "moe", "n_experts": 4, "hidden": 16,
+             "capacity_factor": 4.0, "weights_stddev": 0.2},
+            {"type": "softmax", "output_sample_shape": 4,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 3, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="MoEEP")
+
+
+def test_moe_ep_trains_matches_dense(eight_devices):
+    """An EP MoE model TRAINS in the fused dp step (experts sharded over
+    the data axis, all_to_all exchange) and its loss trajectory + final
+    params match the dense-local golden run."""
+    from veles_tpu.backends import XLADevice
+
+    wf_d = _build_moe_wf()
+    wf_d.initialize(device=XLADevice())
+    wf_e = _build_moe_wf()          # same seed -> identical init
+    wf_e.initialize(device=XLADevice())
+
+    rng = np.random.RandomState(7)
+    xs = rng.randn(6, 32, 12).astype(np.float32)
+    ys = rng.randint(0, 4, (6, 32))
+
+    dense = wf_d.build_fused_step()                      # local golden
+    sd = dense.init_state()
+    mesh = make_4x_mesh(eight_devices)
+    ep = wf_e.build_fused_step(mesh=mesh, mode="dp", ep=True)
+    se = ep.init_state()
+
+    for i in range(xs.shape[0]):
+        sd, (ld, _) = dense.train(sd, xs[i], ys[i])
+        se, (le, _) = ep.train(se, xs[i], ys[i])
+        np.testing.assert_allclose(float(ld), float(le),
+                                   rtol=2e-4, atol=1e-5)
+
+    # the expert tensors must actually be PARTITIONED over the data axis
+    # (a silent replication would also pass the numerics check)
+    moe_w1 = se["params"][0]["w1"]
+    shard_shapes = {s.data.shape for s in moe_w1.addressable_shards}
+    assert shard_shapes == {(1, 12, 16)}, shard_shapes  # 4 experts / 4 dev
+    # router stays replicated
+    wr = se["params"][0]["wr"]
+    assert {s.data.shape for s in wr.addressable_shards} == {(12, 4)}
+
+    for pd, pe in zip(sd["params"], se["params"]):
+        for k in pd:
+            np.testing.assert_allclose(
+                np.asarray(pd[k]), np.asarray(pe[k]),
+                rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def make_4x_mesh(eight_devices):
+    from veles_tpu.parallel.mesh import make_mesh
+    return make_mesh(eight_devices[:4], data=4)
+
+
 # ---------------------------------------------------------------------------
 # pipeline parallelism
 # ---------------------------------------------------------------------------

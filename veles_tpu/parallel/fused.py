@@ -21,6 +21,12 @@ Two execution modes:
             units run their ring/Ulysses kernels (via `seq_axis_name`),
             per-token CE averages globally through the same
             grad-transpose psum. The long-context training path.
+Expert parallelism (`ep=True`, "dp" mode only) shards MoE expert tensors
+over the data axis via per-param shard_map specs: each shard owns
+E/n_data experts, MoE units run the all_to_all token exchange
+(ops.moe.moe_forward_ep via `ep_axis_name`), and expert grads arrive
+through the all_to_all transpose while replicated params keep the
+broadcast-psum. The EP group IS the DP group (DeepSpeed-MoE layout).
 A mesh of one device degrades to plain jit (same code path, collectives
 are no-ops) — SURVEY.md §7: build size-agnostically.
 
@@ -79,7 +85,8 @@ class FusedTrainStep:
 
     def __init__(self, workflow, mesh=None, mode: str = "auto",
                  donate: bool = True,
-                 compute_dtype: Optional[Any] = None) -> None:
+                 compute_dtype: Optional[Any] = None,
+                 ep: bool = False) -> None:
         self.mesh = mesh
         self.forwards = list(workflow.forwards)
         self.loss_kind = workflow.loss
@@ -141,6 +148,31 @@ class FusedTrainStep:
                 if hasattr(u, "prefer_pallas"):
                     u.prefer_pallas = False
         self.mode = mode
+        # expert parallelism rides the data axis (DeepSpeed-MoE style: the
+        # EP group IS the DP group): expert tensors shard over "data" in
+        # the shard_map specs and MoE units run the all_to_all exchange
+        if ep:
+            if mode != "dp":
+                raise ValueError(
+                    f"ep=True needs the explicit shard_map 'dp' mode "
+                    f"(got mode={mode!r}): expert tensors are sharded "
+                    "via per-param shard_map specs")
+            n_data = mesh.shape[DATA_AXIS]
+            any_ep = False
+            for u in self.forwards:
+                for name in getattr(u, "ep_params", ()):
+                    any_ep = True
+                    e = u.param_arrays()[name].shape[0] \
+                        if u.param_arrays()[name] else u.n_experts
+                    if e % n_data:
+                        raise ValueError(
+                            f"{type(u).__name__}: {e} experts not "
+                            f"divisible by the data axis ({n_data})")
+            if not any_ep:
+                raise ValueError(
+                    "ep=True but no forward unit declares ep_params — "
+                    "the step would silently run plain DP")
+        self.ep = ep
         self.donate = donate
         self._train_fn = None
         self._eval_fn = None
@@ -247,11 +279,14 @@ class FusedTrainStep:
             x = x.astype(self.compute_dtype)
             params = _tree_cast(params, self.compute_dtype)
         seq_axis = SEQ_AXIS if self.mode == "seq" else None
+        ep_axis = DATA_AXIS if self.ep else None
         for i, u in enumerate(self.forwards):
             if hasattr(u, "seq_axis_name"):
                 # set at trace time so several step objects (different
                 # modes) over one workflow each trace the right kernel
                 u.seq_axis_name = seq_axis
+            if hasattr(u, "ep_axis_name"):
+                u.ep_axis_name = ep_axis
             k = jax.random.fold_in(key, i) if u.fused_needs_key else None
             x = u.fused_apply(params[i], x, key=k, train=train)
         if self.compute_dtype is not None:
@@ -335,6 +370,26 @@ class FusedTrainStep:
                      else lax.pmean(n_err, axes))
         return loss, n_err
 
+    # -- shard_map specs (dp mode) -------------------------------------------
+
+    def _smap_param_specs(self):
+        """Per-layer PartitionSpec dicts for shard_map state specs. All
+        params replicate (P()) except expert tensors under ep=True, which
+        shard their leading expert dim over the data axis — each shard
+        then owns E/n_data experts and updates them locally (their grads
+        arrive through the all_to_all transpose, not the broadcast-psum
+        that replicated params get)."""
+        specs = []
+        for u in self.forwards:
+            ep_names = getattr(u, "ep_params", ()) if self.ep else ()
+            specs.append({k: P(DATA_AXIS) if k in ep_names else P()
+                          for k in u.param_arrays()})
+        return tuple(specs)
+
+    def _smap_state_spec(self):
+        psp = self._smap_param_specs()
+        return {"params": psp, "vel": psp, "key": P(), "lr_scale": P()}
+
     # -- compilation ---------------------------------------------------------
 
     def _build(self) -> None:
@@ -347,15 +402,16 @@ class FusedTrainStep:
                 lambda p, x, y: self._eval_body(p, x, y, axis=None))
         elif self.mode == "dp":
             mesh = self.mesh
+            ssp = self._smap_state_spec()
             train = jax.shard_map(
                 lambda s, x, y: self._train_body(s, x, y, axis=DATA_AXIS),
                 mesh=mesh,
-                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=(P(), P(), P()))
+                in_specs=(ssp, P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(ssp, P(), P()))
             evalf = jax.shard_map(
                 lambda p, x, y: self._eval_body(p, x, y, axis=DATA_AXIS),
                 mesh=mesh,
-                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+                in_specs=(ssp["params"], P(DATA_AXIS), P(DATA_AXIS)),
                 out_specs=(P(), P()))
             self._train_fn = jax.jit(train, donate_argnums=donate)
             self._eval_fn = jax.jit(evalf)
@@ -462,10 +518,12 @@ class FusedTrainStep:
             elif self.mode in ("dp", "seq"):
                 spec = (P(None, DATA_AXIS, SEQ_AXIS)
                         if self.mode == "seq" else P(None, DATA_AXIS))
+                ssp = (self._smap_state_spec() if self.mode == "dp"
+                       else P())
                 sm = jax.shard_map(
                     many, mesh=self.mesh,
-                    in_specs=(P(), spec, spec),
-                    out_specs=(P(), (P(), P())))
+                    in_specs=(ssp, spec, spec),
+                    out_specs=(ssp, (P(), P())))
                 self._train_many_fn = jax.jit(sm, donate_argnums=donate)
             elif self.mode == "gspmd":
                 xsh = NamedSharding(self.mesh, P(None, DATA_AXIS))

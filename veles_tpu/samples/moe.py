@@ -1,0 +1,67 @@
+"""Mixture-of-experts classifier sample (expert parallelism).
+
+No reference analog (SURVEY.md §2.4: EP absent from the 2015 codebase) —
+this sample exists so the EP axis is exercised end-to-end through the
+same `run(load, main)` convention as every reference-parity sample: a
+switch-style top-1 MoE FFN between two dense layers, trainable either
+dense-local (granular, or fused via CLI `--fused`) or expert-parallel
+over the mesh data axis — programmatically via
+`run_fused(mesh=..., mode="dp", ep=True)` or
+`build_fused_step(mesh=..., mode="dp", ep=True)` on a multi-device host
+(the CLI `--fused` path is single-process dense-local).
+
+Data note: zero-egress environment — synthetic classifier dataset by
+default (veles_tpu/loader/synthetic.py).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz import moe  # noqa: F401 (registers the "moe" type)
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.moe.loader.minibatch_size = 64
+root.moe.loader.n_validation = 256
+root.moe.loader.n_train = 1024
+root.moe.loader.n_classes = 8
+root.moe.layers = [
+    {"type": "all2all_tanh", "output_sample_shape": 64,
+     "weights_stddev": 0.1},
+    {"type": "moe", "n_experts": 8, "hidden": 128,
+     "capacity_factor": 2.0, "weights_stddev": 0.1},
+    {"type": "softmax", "output_sample_shape": 8, "weights_stddev": 0.05},
+]
+root.moe.decision.max_epochs = 8
+root.moe.decision.fail_iterations = 50
+root.moe.gd.learning_rate = 0.05
+root.moe.gd.gradient_moment = 0.9
+
+#: GA-searchable hyperparameters (CLI --optimize)
+TUNABLES = {
+    "root.moe.gd.learning_rate": (0.005, 0.3),
+    "root.moe.gd.gradient_moment": (0.0, 0.95),
+}
+
+
+class MoEWorkflow(StandardWorkflow):
+    """All2AllTanh(64) -> MoE(8 experts, hidden 128) -> Softmax(8)."""
+
+
+def create_workflow() -> MoEWorkflow:
+    cfg = root.moe.loader
+    loader = SyntheticClassifierLoader(
+        n_classes=cfg.n_classes, sample_shape=(32,),
+        n_validation=cfg.n_validation, n_train=cfg.n_train,
+        minibatch_size=cfg.minibatch_size, noise=0.4)
+    return MoEWorkflow(
+        layers=root.moe.layers,
+        loader=loader, loss="softmax", n_classes=cfg.n_classes,
+        decision_config=root.moe.decision.to_dict(),
+        gd_config=root.moe.gd.to_dict(),
+        name="MoEWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

@@ -2,9 +2,13 @@
 
 Not in the reference (SURVEY.md §2.4: EP absent) — added so the parallel
 layer covers the full dp/tp/sp/ep axis set. Follows the house pattern:
-Forward twin + vjp-driven GD twin; the dense routing form is the golden
-model, the shard_map expert-parallel form (ops.moe.moe_forward_ep) is its
-mesh twin, equivalence-tested on the virtual 8-device mesh.
+Forward twin + vjp-driven GD twin. The dense routing form
+(ops.moe.moe_forward) is the golden model and the granular/local fused
+path; when FusedTrainStep is built with `ep=True` it sets `ep_axis_name`
+on the unit and `fused_apply` dispatches to the expert-parallel
+shard_map form (ops.moe.moe_forward_ep) with the expert tensors sharded
+over the mesh data axis — an EP MoE model trains end-to-end and matches
+the dense golden (tests/test_moe_pipeline.py).
 """
 
 from __future__ import annotations
@@ -25,6 +29,11 @@ class MoELayer(Forward):
     """Top-1 (switch) MoE FFN: x (N, D) -> (N, D). Params: router wr
     (D, E), expert FFNs w1 (E, D, H), b1, w2 (E, H, D), b2."""
 
+    #: params sharded on their leading (expert) dim when the fused step
+    #: runs expert-parallel; the router wr stays replicated (every shard
+    #: routes its own tokens over ALL experts before the all_to_all)
+    ep_params = ("w1", "b1", "w2", "b2")
+
     def __init__(self, workflow=None, n_experts: int = 4,
                  hidden: int = 64, capacity_factor: float = 2.0,
                  **kwargs: Any) -> None:
@@ -32,6 +41,11 @@ class MoELayer(Forward):
         self.n_experts = n_experts
         self.hidden = hidden
         self.capacity_factor = capacity_factor
+        #: mesh axis name the expert dim is sharded over; set by
+        #: FusedTrainStep(ep=True) at trace time so fused_apply runs the
+        #: all_to_all expert exchange instead of the dense local form.
+        #: None = dense local (the golden model).
+        self.ep_axis_name = None
         self.wr = Array()
         self.w1 = Array()
         self.b1 = Array()
@@ -65,14 +79,24 @@ class MoELayer(Forward):
             self.output.reset(np.zeros((n, d), np.float32))
         return super().initialize(device=device, **kwargs)
 
-    def _apply(self, params, x):
+    def _apply(self, params, x, axis_name=None):
         x2 = x.reshape(x.shape[0], -1)
+        if axis_name is not None:
+            # inside shard_map: x2.shape[0] is the per-shard token count.
+            # When capacity_factor·n_loc/n_experts divides exactly, the
+            # per-source-shard capacities total the dense form's global
+            # slots; with truncation/clamping the drop sets can differ —
+            # dense/EP equivalence is exact only in zero-drop configs.
+            return om.moe_forward_ep(
+                x2, params["wr"], params["w1"], params["b1"],
+                params["w2"], params["b2"], axis_name,
+                capacity=self.capacity(x2.shape[0]))
         return om.moe_forward(x2, params["wr"], params["w1"], params["b1"],
                               params["w2"], params["b2"],
                               capacity=self.capacity(x2.shape[0]))
 
     def fused_apply(self, params, x, *, key=None, train=True):
-        return self._apply(params, x)
+        return self._apply(params, x, axis_name=self.ep_axis_name)
 
     def xla_init(self):
         self._fn = self.jit(lambda x, p: self._apply(p, x))

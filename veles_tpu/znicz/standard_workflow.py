@@ -183,16 +183,18 @@ class StandardWorkflow(Workflow):
     # -- fused/sharded execution (veles_tpu.parallel) -------------------------
 
     def build_fused_step(self, mesh=None, mode: str = "auto",
-                         compute_dtype=None):
+                         compute_dtype=None, ep: bool = False):
         """Compile the whole forward+backward+update chain into one donated
-        XLA step, optionally sharded over `mesh` (data/model axes). See
+        XLA step, optionally sharded over `mesh` (data/model axes; ep=True
+        additionally shards MoE expert tensors over the data axis). See
         parallel.fused.FusedTrainStep."""
         from veles_tpu.parallel.fused import FusedTrainStep
         return FusedTrainStep(self, mesh=mesh, mode=mode,
-                              compute_dtype=compute_dtype)
+                              compute_dtype=compute_dtype, ep=ep)
 
     def run_fused(self, epochs: Optional[int] = None, device=None,
-                  mesh=None, mode: str = "auto", compute_dtype=None) -> None:
+                  mesh=None, mode: str = "auto", compute_dtype=None,
+                  ep: bool = False) -> None:
         """Train with the fused step while keeping the graph semantics:
         the real Loader drives minibatches and the real Decision unit does
         the epoch/stop bookkeeping (so snapshot gating, best-error tracking
@@ -203,7 +205,7 @@ class StandardWorkflow(Workflow):
         if not self.is_initialized:
             self.initialize(device=device)
         step = self.build_fused_step(mesh=mesh, mode=mode,
-                                     compute_dtype=compute_dtype)
+                                     compute_dtype=compute_dtype, ep=ep)
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
         # the fused step uploads (sharded) itself; the loader's granular-path
