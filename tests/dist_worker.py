@@ -1,0 +1,73 @@
+"""Subprocess body for the two-process loopback distributed test.
+
+Plays the reference's master/slave roles (SURVEY.md §3.2) the TPU-native
+way: both processes join one JAX job over DCN (loopback here), build the
+SAME workflow, and train it data-parallel over the GLOBAL device mesh
+through the Launcher's coordinator (-l) / worker (-m) path — gradient
+averaging is the in-graph psum, not pickled deltas. Prints one JSON line
+with a param digest so the parent test can assert both processes hold
+bit-identical trained weights.
+
+Not a pytest file (no test_ prefix): launched by
+tests/test_distributed_two_process.py.
+"""
+
+import json
+import sys
+
+import jax
+
+# beat the baked sitecustomize's "axon,cpu" platform pin before first use
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    role, addr, pid = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    import numpy as np
+
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    def factory():
+        prng.seed_all(4321)  # same seed everywhere -> same init + data
+        loader = SyntheticClassifierLoader(
+            n_classes=4, sample_shape=(8,), n_validation=32, n_train=128,
+            minibatch_size=32, noise=0.3)
+        return StandardWorkflow(
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.1},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "weights_stddev": 0.05},
+            ],
+            loader=loader, loss="softmax", n_classes=4,
+            decision_config={"max_epochs": 3, "fail_iterations": 50},
+            gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+            name="DistDP")
+
+    launcher = Launcher(
+        listen=addr if role == "coordinator" else "",
+        master=addr if role == "worker" else "",
+        process_id=pid, n_processes=2, stats=False)
+    launcher.load(factory)
+    rc = launcher.main()
+
+    wf = launcher.workflow
+    digest = {
+        "role": role, "rc": rc,
+        "n_global_devices": jax.device_count(),
+        "n_local_devices": jax.local_device_count(),
+        "best_validation_err": int(wf.decision.best_validation_err),
+        "param_sums": [float(np.abs(u.weights.mem).sum())
+                       for u in wf.forwards],
+        "param_digest": [np.asarray(u.weights.mem).tobytes().hex()[:32]
+                         for u in wf.forwards],
+    }
+    print("DIGEST " + json.dumps(digest), flush=True)
+
+
+if __name__ == "__main__":
+    main()

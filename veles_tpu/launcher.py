@@ -117,16 +117,46 @@ class Launcher(Logger):
             import jax
             jax.config.update("jax_debug_nans", True)
         if self.web_status_enabled:
-            from veles_tpu.web_status import WebStatusServer
-            self._web = WebStatusServer(self.workflow, port=self.web_port)
-            self._web.start()
+            from veles_tpu.parallel.distributed import is_coordinator
+            if self.mode == "standalone" or is_coordinator():
+                from veles_tpu.web_status import WebStatusServer
+                self._web = WebStatusServer(self.workflow,
+                                            port=self.web_port)
+                self._web.start()
         profiling = False
         if self.profile_dir:
             import jax
             jax.profiler.start_trace(self.profile_dir)
             profiling = True
         try:
-            if self.fused:
+            if self.mode != "standalone":
+                # distributed run: every process executes the same SPMD
+                # program over the GLOBAL device mesh; gradient averaging
+                # is the in-graph psum (reference §3.2's pickled-deltas
+                # loop has no analog). Granular per-unit execution is
+                # single-device by construction, so distributed implies
+                # the fused step.
+                if not hasattr(self.workflow, "run_fused"):
+                    raise SystemExit(
+                        f"distributed mode: {type(self.workflow).__name__} "
+                        "has no fused step (StandardWorkflow-family only)")
+                import jax
+
+                from veles_tpu.parallel.distributed import is_coordinator
+                from veles_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh(jax.devices())
+                self.info("distributed %s: %d processes, %d global devices",
+                          self.mode, self.n_processes, jax.device_count())
+                if not is_coordinator() and getattr(
+                        self.workflow, "snapshotter", None) is not None:
+                    # host-side side effects are coordinator-only: every
+                    # process holds identical replicated params, and two
+                    # processes racing os.replace on one snapshot path
+                    # can publish a truncated file
+                    self.workflow.snapshotter = None
+                self.workflow.run_fused(device=self.device, mesh=mesh,
+                                        mode="dp", **kwargs)
+            elif self.fused:
                 if not hasattr(self.workflow, "run_fused"):
                     raise SystemExit(
                         f"--fused: {type(self.workflow).__name__} has no "
