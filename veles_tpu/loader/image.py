@@ -130,6 +130,13 @@ class ImageDirectoryLoader(Loader):
 
     # -- decode + prefetch ----------------------------------------------------
 
+    def train_labels(self):
+        """Class labels of the train split (pristine order) — enables
+        `balanced_train` for imbalanced image directories."""
+        if not len(self.path_labels):
+            return None
+        return self.path_labels[self._train_base]
+
     def _decode_batch(self, indices: np.ndarray) -> Tuple[np.ndarray,
                                                           np.ndarray]:
         h, w = self.size_hw

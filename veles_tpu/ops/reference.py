@@ -411,11 +411,13 @@ def make_dropout_mask(rng: np.random.RandomState, shape, drop_prob: float,
 # evaluators (parity: veles/znicz/evaluator.py)
 # ---------------------------------------------------------------------------
 
-def softmax_ce(probs: np.ndarray, labels: np.ndarray, n_classes: int
+def softmax_ce(probs: np.ndarray, labels: np.ndarray, n_classes: int,
+               weights: np.ndarray = None
                ) -> Tuple[float, np.ndarray, int, np.ndarray]:
     """EvaluatorSoftmax: input is the softmax OUTPUT (All2AllSoftmax yields
     probabilities). Returns (mean CE loss, err wrt pre-softmax logits,
-    n_err, confusion matrix).
+    n_err, confusion matrix). `weights` (N,) sample weights (the Loader's
+    pad mask) — zero rows drop out of every metric; None == all-ones.
 
     Deviation from reference (documented): err is divided by batch size so
     learning rates are batch-size-invariant; the reference folded this into
@@ -425,21 +427,38 @@ def softmax_ce(probs: np.ndarray, labels: np.ndarray, n_classes: int
     onehot = np.zeros((n, n_classes), probs.dtype)
     onehot[np.arange(n), labels] = 1.0
     eps = np.finfo(probs.dtype).tiny
-    loss = float(-np.log(np.maximum(probs[np.arange(n), labels], eps)).mean())
-    err = (probs - onehot) / np.asarray(n, probs.dtype)
+    logs = -np.log(np.maximum(probs[np.arange(n), labels], eps))
     pred = probs.argmax(axis=1)
-    n_err = int((pred != labels).sum())
+    wrong = pred != labels
     confusion = np.zeros((n_classes, n_classes), np.int64)
-    np.add.at(confusion, (labels, pred), 1)
+    if weights is None:
+        loss = float(logs.mean())
+        err = (probs - onehot) / np.asarray(n, probs.dtype)
+        n_err = int(wrong.sum())
+        np.add.at(confusion, (labels, pred), 1)
+    else:
+        w = weights.astype(probs.dtype)
+        wsum = max(float(w.sum()), float(eps))
+        loss = float((logs * w).sum() / wsum)
+        err = (probs - onehot) * w[:, None] / wsum
+        n_err = int((wrong & (w > 0)).sum())
+        np.add.at(confusion, (labels, pred), (w > 0).astype(np.int64))
     return loss, err, n_err, confusion
 
 
-def mse(y: np.ndarray, target: np.ndarray) -> Tuple[float, np.ndarray]:
-    """EvaluatorMSE: returns (mean-over-batch MSE, err wrt y)."""
+def mse(y: np.ndarray, target: np.ndarray, weights: np.ndarray = None
+        ) -> Tuple[float, np.ndarray]:
+    """EvaluatorMSE: returns (mean-over-batch MSE, err wrt y); `weights`
+    (N,) sample weights as in softmax_ce."""
     n = y.shape[0]
     diff = y - target
-    loss = float((diff * diff).sum() / n)
-    return loss, 2.0 * diff / np.asarray(n, y.dtype)
+    if weights is None:
+        loss = float((diff * diff).sum() / n)
+        return loss, 2.0 * diff / np.asarray(n, y.dtype)
+    wb = weights.astype(y.dtype).reshape((n,) + (1,) * (y.ndim - 1))
+    wsum = max(float(weights.sum()), 1e-9)
+    loss = float((wb * diff * diff).sum() / wsum)
+    return loss, 2.0 * diff * wb / np.asarray(wsum, y.dtype)
 
 
 # ---------------------------------------------------------------------------

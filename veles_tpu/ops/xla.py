@@ -366,38 +366,68 @@ def dropout_forward(x, mask):
 # ---------------------------------------------------------------------------
 
 
-def softmax_ce(probs, labels, n_classes: int):
+def softmax_ce(probs, labels, n_classes: int, weights=None):
     """Mirror of reference.softmax_ce on device: returns (loss, err wrt
-    logits, n_err, confusion). All jit-safe."""
+    logits, n_err, confusion). All jit-safe. `weights` (N,) are sample
+    weights (the Loader's pad mask): zero-weight rows contribute nothing
+    to any metric — exact epoch metrics at any minibatch size with
+    static shapes. weights=None == all-ones (the legacy mean forms)."""
     n = probs.shape[0]
     onehot = jax.nn.one_hot(labels, n_classes, dtype=probs.dtype)
     eps = jnp.finfo(probs.dtype).tiny
     picked = jnp.take_along_axis(probs, labels[:, None], 1)[:, 0]
-    loss = -jnp.log(jnp.maximum(picked, eps)).mean()
-    err = (probs - onehot) / jnp.asarray(n, probs.dtype)
+    logs = -jnp.log(jnp.maximum(picked, eps))
     pred = probs.argmax(axis=1)
-    n_err = (pred != labels).sum()
+    wrong = pred != labels
+    if weights is None:
+        loss = logs.mean()
+        err = (probs - onehot) / jnp.asarray(n, probs.dtype)
+        n_err = wrong.sum()
+        conf_inc = jnp.ones_like(labels, jnp.int32)
+    else:
+        w = weights.astype(probs.dtype)
+        wsum = jnp.maximum(w.sum(), eps)
+        loss = (logs * w).sum() / wsum
+        err = (probs - onehot) * w[:, None] / wsum
+        n_err = (wrong & (w > 0)).sum()
+        conf_inc = (w > 0).astype(jnp.int32)
     confusion = jnp.zeros((n_classes, n_classes), jnp.int32
-                          ).at[labels, pred].add(1)
+                          ).at[labels, pred].add(conf_inc)
     return loss, err, n_err, confusion
 
 
-def ce_loss_from_logits(logits, labels, n_classes: int):
+def ce_loss_from_logits(logits, labels, n_classes: int, weights=None,
+                        denom=None):
     """Scalar CE loss from logits — the form jax.grad differentiates in the
     fused train step (log-softmax for stability). Accepts any leading
     dims: (N, C) classifier logits, or (N, S, C) per-token LM logits with
-    (N, S) labels (mean over all tokens)."""
+    (N, S) labels (mean over all tokens). `weights` must broadcast to the
+    label shape; `denom` overrides the normalizer (the fused sharded step
+    passes the GLOBAL psum'd weight sum so per-shard partial losses sum
+    to the exact global weighted mean)."""
     logits = logits.reshape(-1, logits.shape[-1])
-    labels = labels.reshape(-1)
+    flat = labels.reshape(-1)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
-    return -picked.mean()
+    picked = jnp.take_along_axis(logp, flat[:, None], 1)[:, 0]
+    if weights is None:
+        return -picked.mean()
+    w = jnp.broadcast_to(weights, labels.shape).reshape(-1)
+    w = w.astype(picked.dtype)
+    d = w.sum() if denom is None else denom
+    return -(picked * w).sum() / jnp.maximum(d, 1e-9)
 
 
-def mse(y, target):
+def mse(y, target, weights=None, denom=None):
+    """(mean-over-batch MSE, err wrt y); `weights` (N,) sample weights,
+    `denom` the (global) weight-sum normalizer as in ce_loss_from_logits."""
     n = y.shape[0]
     diff = y - target
-    return (diff * diff).sum() / n, 2.0 * diff / jnp.asarray(n, y.dtype)
+    if weights is None:
+        return (diff * diff).sum() / n, 2.0 * diff / jnp.asarray(n, y.dtype)
+    wb = weights.astype(y.dtype).reshape((n,) + (1,) * (y.ndim - 1))
+    d = weights.astype(y.dtype).sum() if denom is None else denom
+    d = jnp.maximum(d, 1e-9)
+    return (wb * diff * diff).sum() / d, 2.0 * diff * wb / d
 
 
 # ---------------------------------------------------------------------------

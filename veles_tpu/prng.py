@@ -39,6 +39,10 @@ class RandomGenerator:
     def randint(self, low: int, high: Optional[int] = None, size=None):
         return self.state.randint(low, high, size)
 
+    def choice(self, n: int, size=None, p=None, replace: bool = True):
+        """Weighted index draw (class-balanced Loader sampling)."""
+        return self.state.choice(n, size=size, replace=replace, p=p)
+
     def fill_uniform(self, shape, low: float, high: float,
                      dtype=np.float32) -> np.ndarray:
         """Weight-init fill (parity: reference `Forward` uniform fills)."""

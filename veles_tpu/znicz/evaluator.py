@@ -40,6 +40,11 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_classes = n_classes
         self.compute_confusion = compute_confusion
         self.labels = Array()
+        #: (N,) sample weights — StandardWorkflow aliases the Loader's
+        #: minibatch_valid pad mask here so wrapped final minibatches
+        #: yield EXACT epoch metrics (zero-weight rows drop out); an
+        #: unlinked evaluator defaults to all-ones (legacy behavior)
+        self.sample_weights = Array()
         self.n_err = 0
         self.confusion_matrix = Array(
             np.zeros((n_classes, n_classes), np.int64))
@@ -49,16 +54,33 @@ class EvaluatorSoftmax(EvaluatorBase):
             return False
         if not self.err_output or self.err_output.shape != self.input.shape:
             self.err_output.reset(np.zeros(self.input.shape, np.float32))
+        if not self.sample_weights:
+            self.sample_weights.reset(
+                np.ones(self.input.shape[0], np.float32))
+        # per-token LM heads flatten (N, S) rows to N·S while the Loader
+        # mask stays per-sample (N,): repeat each sample weight S times
+        n, nw = self.input.shape[0], self.sample_weights.shape[0]
+        if n != nw and n % nw:
+            raise ValueError(f"sample_weights ({nw}) incompatible with "
+                             f"evaluator rows ({n})")
+        self._w_repeat = n // nw
         return super().initialize(device=device, **kwargs)
 
     def xla_init(self):
+        import jax.numpy as jnp
+        r = self._w_repeat
         self._fn = self.jit(
-            lambda p, l: ox.softmax_ce(p, l, self.n_classes))
+            lambda p, l, w: ox.softmax_ce(
+                p, l, self.n_classes,
+                weights=jnp.repeat(w, r) if r > 1 else w))
         return None
 
     def numpy_run(self) -> None:
+        w = self.sample_weights.mem
+        if self._w_repeat > 1:
+            w = np.repeat(w, self._w_repeat)
         loss, err, n_err, conf = ref.softmax_ce(
-            self.input.mem, self.labels.mem, self.n_classes)
+            self.input.mem, self.labels.mem, self.n_classes, weights=w)
         self.loss = loss
         self.err_output.mem = err
         self.n_err = n_err
@@ -69,7 +91,8 @@ class EvaluatorSoftmax(EvaluatorBase):
     def xla_run(self) -> None:
         d = self.device
         loss, err, n_err, conf = self._fn(self.input.devmem(d),
-                                          self.labels.devmem(d))
+                                          self.labels.devmem(d),
+                                          self.sample_weights.devmem(d))
         self.err_output.set_devmem(err)
         # scalars cross to host here: the Decision unit is host-side logic
         self.loss = float(loss)
@@ -89,27 +112,33 @@ class EvaluatorMSE(EvaluatorBase):
     def __init__(self, workflow=None, **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.target = Array()
+        self.sample_weights = Array()   # see EvaluatorSoftmax
 
     def initialize(self, device=None, **kwargs: Any):
         if not self.input:
             return False
         if not self.err_output or self.err_output.shape != self.input.shape:
             self.err_output.reset(np.zeros(self.input.shape, np.float32))
+        if not self.sample_weights:
+            self.sample_weights.reset(
+                np.ones(self.input.shape[0], np.float32))
         return super().initialize(device=device, **kwargs)
 
     def xla_init(self):
-        self._fn = self.jit(ox.mse)
+        self._fn = self.jit(lambda y, t, w: ox.mse(y, t, weights=w))
         return None
 
     def numpy_run(self) -> None:
-        loss, err = ref.mse(self.input.mem, self.target.mem)
+        loss, err = ref.mse(self.input.mem, self.target.mem,
+                            weights=self.sample_weights.mem)
         self.loss = loss
         self.err_output.mem = err
         self.n_err = loss  # Decision tracks MSE as the "error" metric
 
     def xla_run(self) -> None:
         d = self.device
-        loss, err = self._fn(self.input.devmem(d), self.target.devmem(d))
+        loss, err = self._fn(self.input.devmem(d), self.target.devmem(d),
+                             self.sample_weights.devmem(d))
         self.err_output.set_devmem(err)
         self.loss = float(loss)
         self.n_err = self.loss

@@ -97,6 +97,10 @@ class StandardWorkflow(Workflow):
                                       ("target", "minibatch_labels"))
         else:
             raise ValueError(f"unknown loss {loss!r}")
+        # the Loader's pad mask weights the metrics: exact epoch totals
+        # even when the final minibatch wraps (loader/base.py docstring)
+        self.evaluator.link_attrs(self.loader,
+                                  ("sample_weights", "minibatch_valid"))
         self.evaluator.link_attrs(prev, ("input", "output"))
 
         # -- decision -------------------------------------------------------
@@ -221,10 +225,11 @@ class StandardWorkflow(Workflow):
                 loader.run()
                 x = loader.minibatch_data.mem
                 y = loader.minibatch_labels.mem
+                w = loader.minibatch_valid.mem  # pad mask: exact metrics
                 if loader.minibatch_class == TRAIN:
-                    state, (loss, n_err) = step.train(state, x, y)
+                    state, (loss, n_err) = step.train(state, x, y, w)
                 else:
-                    loss, n_err = step.evaluate(state, x, y)
+                    loss, n_err = step.evaluate(state, x, y, w)
                 acc_loss = loss if acc_loss is None else acc_loss + loss
                 acc_err = n_err if acc_err is None else acc_err + n_err
                 if bool(loader.last_minibatch):
