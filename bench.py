@@ -153,21 +153,18 @@ def child_main() -> None:
         # PJRT tunnel; a scalar device_get is. Fetch one param element.
         np.asarray(st["params"][-1]["bias"][:1])
 
-    # One dispatch per window via the scanned multi-step trainer (real
+    # One dispatch per window via the scanned repeat trainer (real
     # per-minibatch updates; removes host->device dispatch latency from
     # the measurement — through the remote tunnel that latency is not a
-    # property of the framework). train_many now composes with sharded
-    # meshes too (scan inside shard_map / GSPMD scan).
-    import jax.numpy as jnp
-    xs = jnp.broadcast_to(x, (STEPS_PER_WINDOW,) + x.shape)
-    ys = jnp.broadcast_to(y, (STEPS_PER_WINDOW,) + y.shape)
-    state, _ = step.train_many(state, xs, ys)   # warmup + compile
+    # property of the framework). train_repeat keeps ONE batch resident
+    # (train_many's (K, batch, ...) stack is 12+ GB at batch 1024).
+    state, _ = step.train_repeat(state, x, y, STEPS_PER_WINDOW)  # warmup
     sync(state)
 
     rates = []
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
-        state, _ = step.train_many(state, xs, ys)
+        state, _ = step.train_repeat(state, x, y, STEPS_PER_WINDOW)
         sync(state)
         dt = time.perf_counter() - t0
         rates.append(batch * STEPS_PER_WINDOW / dt)

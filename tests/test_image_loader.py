@@ -52,7 +52,7 @@ def test_prefetch_matches_sync_decode(image_tree):
         seen.append((loader.minibatch_indices.mem.copy(),
                      loader.minibatch_data.mem.copy()))
     for idx, x in seen:
-        gold, _ = loader._decode_batch(idx)
+        gold, _ = loader._produce_batch(idx)
         np.testing.assert_allclose(x, gold, rtol=1e-6, atol=1e-6)
     loader.stop()
 

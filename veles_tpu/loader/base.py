@@ -177,3 +177,74 @@ class Loader(AcceleratedUnit, IDistributable):
     def apply_data_from_master(self, data: Any) -> None:
         if data and "indices" in data:
             self.fill_minibatch(np.asarray(data["indices"]))
+
+
+class PrefetchingLoader(Loader):
+    """Loader whose minibatch production runs on background threads with
+    `prefetch` batches of exact lookahead (the within-epoch schedule is
+    deterministic, so future index sets are known). Subclasses implement
+    `_produce_batch(indices) -> (x, y)` — an image decode, a memmap
+    gather, … — and inherit the overlap machinery: host input prep runs
+    concurrently with device compute (the property that matters on TPU;
+    SURVEY.md §2.7)."""
+
+    def __init__(self, workflow=None, n_workers: int = 2,
+                 prefetch: int = 2, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_workers = n_workers
+        self.prefetch = prefetch
+        self._pool = None
+        self._pending: dict = {}
+
+    def _produce_batch(self, indices: np.ndarray):
+        raise NotImplementedError
+
+    def _indices_at(self, cursor: int) -> Optional[np.ndarray]:
+        if cursor >= len(self._schedule):
+            return None
+        cls, b, _ = self._schedule[cursor]
+        idx = self._indices_per_class[cls]
+        lo = b * self.minibatch_size
+        take = np.arange(lo, lo + self.minibatch_size) % len(idx)
+        return idx[take]
+
+    def fill_minibatch(self, indices: np.ndarray) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.n_workers,
+                thread_name_prefix=f"{self.name}-produce")
+        fut = self._pending.pop(self._cursor, None)
+        x, y = (fut.result() if fut is not None
+                else self._produce_batch(indices))
+        self.minibatch_data.reset(x)
+        self.minibatch_labels.reset(y)
+        for ahead in range(1, self.prefetch + 1):
+            pos = self._cursor + ahead
+            if pos in self._pending:
+                continue
+            nxt = self._indices_at(pos)
+            if nxt is None:
+                break
+            self._pending[pos] = self._pool.submit(self._produce_batch,
+                                                   nxt)
+
+    def run(self) -> None:
+        super().run()
+        if bool(self.epoch_ended):
+            # schedule was rebuilt (new shuffle): drop stale lookahead
+            for fut in self._pending.values():
+                fut.cancel()
+            self._pending.clear()
+
+    def stop(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+        self._pending.clear()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["_pool"] = None
+        d["_pending"] = {}
+        return d

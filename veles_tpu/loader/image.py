@@ -16,13 +16,11 @@ a thread pool instead of a C extension.
 from __future__ import annotations
 
 import os
-import threading
-from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu.loader.base import Loader
+from veles_tpu.loader.base import PrefetchingLoader
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm")
 
@@ -66,12 +64,12 @@ def decode_image(path: str, size_hw: Tuple[int, int],
     return arr / 127.5 - 1.0
 
 
-class ImageDirectoryLoader(Loader):
+class ImageDirectoryLoader(PrefetchingLoader):
     """Streaming minibatch loader over a class-per-directory image tree.
 
     The dataset index (paths + labels) lives in memory; pixels are decoded
-    per minibatch on `n_workers` background threads with `prefetch`
-    batches of lookahead, so decode overlaps device compute.
+    per minibatch on the PrefetchingLoader's background threads, so decode
+    overlaps device compute.
     """
 
     def __init__(self, workflow=None, data_path: str = "",
@@ -80,19 +78,16 @@ class ImageDirectoryLoader(Loader):
                  mean_normalize: bool = True,
                  n_workers: int = 4, prefetch: int = 2,
                  **kwargs: Any) -> None:
-        super().__init__(workflow, **kwargs)
+        super().__init__(workflow, n_workers=n_workers, prefetch=prefetch,
+                         **kwargs)
         self.data_path = data_path
         self.size_hw = tuple(size_hw)
         self.n_validation = n_validation
         self.mean_normalize = mean_normalize
-        self.n_workers = n_workers
-        self.prefetch = prefetch
         self.paths: List[str] = []
         self.path_labels: np.ndarray = np.empty(0, np.int64)
         self.class_names: List[str] = []
         self.mean_image: Optional[np.ndarray] = None
-        self._pool: Optional[ThreadPoolExecutor] = None
-        self._pending: Dict[int, Future] = {}
 
     # -- dataset index -------------------------------------------------------
 
@@ -137,8 +132,8 @@ class ImageDirectoryLoader(Loader):
             return None
         return self.path_labels[self._train_base]
 
-    def _decode_batch(self, indices: np.ndarray) -> Tuple[np.ndarray,
-                                                          np.ndarray]:
+    def _produce_batch(self, indices: np.ndarray) -> Tuple[np.ndarray,
+                                                           np.ndarray]:
         h, w = self.size_hw
         x = np.zeros((len(indices), h, w, 3), np.float32)
         for i, idx in enumerate(indices):
@@ -146,59 +141,3 @@ class ImageDirectoryLoader(Loader):
         if self.mean_image is not None:
             x -= self.mean_image
         return x, self.path_labels[indices]
-
-    def _indices_at(self, cursor: int) -> Optional[np.ndarray]:
-        if cursor >= len(self._schedule):
-            return None
-        cls, b, _ = self._schedule[cursor]
-        idx = self._indices_per_class[cls]
-        lo = b * self.minibatch_size
-        take = np.arange(lo, lo + self.minibatch_size) % len(idx)
-        return idx[take]
-
-    def _ensure_pool(self) -> ThreadPoolExecutor:
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(
-                max_workers=self.n_workers,
-                thread_name_prefix=f"{self.name}-decode")
-        return self._pool
-
-    def fill_minibatch(self, indices: np.ndarray) -> None:
-        pool = self._ensure_pool()
-        fut = self._pending.pop(self._cursor, None)
-        if fut is None:
-            x, y = self._decode_batch(indices)
-        else:
-            x, y = fut.result()
-        self.minibatch_data.reset(x)
-        self.minibatch_labels.reset(y)
-        # schedule lookahead for the positions after this one (within the
-        # current epoch: the schedule reshuffles at the boundary)
-        for ahead in range(1, self.prefetch + 1):
-            pos = self._cursor + ahead
-            if pos in self._pending:
-                continue
-            nxt = self._indices_at(pos)
-            if nxt is None:
-                break
-            self._pending[pos] = pool.submit(self._decode_batch, nxt)
-
-    def run(self) -> None:
-        super().run()
-        if bool(self.epoch_ended):
-            # schedule was rebuilt (new shuffle): drop stale lookahead
-            for fut in self._pending.values():
-                fut.cancel()
-            self._pending.clear()
-
-    def stop(self) -> None:
-        if self._pool is not None:
-            self._pool.shutdown(wait=False, cancel_futures=True)
-            self._pool = None
-        self._pending.clear()
-
-    def __getstate__(self):
-        d = super().__getstate__()
-        d["_pool"] = None
-        d["_pending"] = {}
-        return d

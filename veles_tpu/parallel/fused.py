@@ -74,6 +74,23 @@ def _vel_attr(gd_unit, param_name: str) -> Optional[str]:
     return None
 
 
+def pair_gd_configs(workflow):
+    """(gd_units, SGDConfigs) aligned with workflow.forwards — each
+    forward keeps its GD twin's hyperparameters (gds is built in reverse
+    order by StandardWorkflow). Shared by the fused and pipeline steps."""
+    gds = list(workflow.gds)
+    n = len(list(workflow.forwards))
+    gd_units = [gds[n - 1 - i] for i in range(n)]
+    cfgs = [optim.SGDConfig(
+        lr=getattr(g, "learning_rate", 0.0),
+        momentum=getattr(g, "gradient_moment", 0.0),
+        weight_decay=getattr(g, "weights_decay", 0.0),
+        l1_decay=getattr(g, "l1_decay", 0.0),
+        lr_bias_mult=getattr(g, "learning_rate_bias", 1.0))
+        for g in gd_units]
+    return gd_units, cfgs
+
+
 class FusedTrainStep:
     """Compile a StandardWorkflow's training chain into one sharded step.
 
@@ -106,20 +123,7 @@ class FusedTrainStep:
             raise ValueError(
                 "fused softmax loss needs an All2AllSoftmax final layer "
                 "(it emits logits for log-softmax CE)")
-        # pair each forward with its GD twin's hyperparams (gds is built in
-        # reverse order by StandardWorkflow)
-        gds = list(workflow.gds)
-        n = len(self.forwards)
-        self.cfgs: List[optim.SGDConfig] = []
-        self.gd_units = [gds[n - 1 - i] for i in range(n)]
-        for i in range(n):
-            g = gds[n - 1 - i]
-            self.cfgs.append(optim.SGDConfig(
-                lr=getattr(g, "learning_rate", 0.0),
-                momentum=getattr(g, "gradient_moment", 0.0),
-                weight_decay=getattr(g, "weights_decay", 0.0),
-                l1_decay=getattr(g, "l1_decay", 0.0),
-                lr_bias_mult=getattr(g, "learning_rate_bias", 1.0)))
+        self.gd_units, self.cfgs = pair_gd_configs(workflow)
         if mode == "auto":
             if mesh is None:
                 mode = "local"
@@ -608,6 +612,53 @@ class FusedTrainStep:
         x, y = self._seq_xy(x, y)
         w = self._weights_or_ones(w, np.shape(x)[0])
         return self._eval_fn(state["params"], x, y, w)
+
+    def train_repeat(self, state, x, y, k: int, w=None):
+        """K sequential updates on ONE device-resident minibatch in a
+        single dispatch (lax.scan with no scanned inputs). Same scanned
+        hot loop as train_many but device memory holds one batch
+        regardless of K — the benchmark path, where K× input copies
+        would dominate HBM at large batch. Returns
+        (state, (losses, n_errs)) with leading dim K."""
+        self._check_batch(np.shape(x)[0])
+        x, y = self._seq_xy(x, y)
+        w = self._weights_or_ones(w, np.shape(x)[0])
+        cache = getattr(self, "_train_repeat_fns", None)
+        if cache is None:
+            cache = self._train_repeat_fns = {}
+        if k not in cache:
+            axis = {"dp": DATA_AXIS, "seq": (DATA_AXIS, SEQ_AXIS)}.get(
+                self.mode)
+
+            def rep(state, x, y, w):
+                def step(st, _):
+                    st2, loss, n_err = self._train_body(st, x, y, w,
+                                                        axis=axis)
+                    return st2, (loss, n_err)
+                return lax.scan(step, state, None, length=k)
+
+            donate = (0,) if self.donate else ()
+            if self.mode == "local":
+                cache[k] = jax.jit(rep, donate_argnums=donate)
+            elif self.mode in ("dp", "seq"):
+                spec = (P(DATA_AXIS, SEQ_AXIS) if self.mode == "seq"
+                        else P(DATA_AXIS))
+                ssp = (self._smap_state_spec() if self.mode == "dp"
+                       else P())
+                sm = jax.shard_map(
+                    rep, mesh=self.mesh,
+                    in_specs=(ssp, spec, spec, P(DATA_AXIS)),
+                    out_specs=(ssp, (P(), P())))
+                cache[k] = jax.jit(sm, donate_argnums=donate)
+            elif self.mode == "gspmd":
+                xsh = NamedSharding(self.mesh, P(DATA_AXIS))
+                cache[k] = jax.jit(
+                    rep, in_shardings=(self._state_shardings(),
+                                       xsh, xsh, xsh),
+                    donate_argnums=donate)
+            else:
+                raise ValueError(f"unknown mode {self.mode!r}")
+        return cache[k](state, x, y, w)
 
     def train_many(self, state, xs, ys, ws=None):
         """K training steps in ONE dispatch: xs (K, batch, ...), ys

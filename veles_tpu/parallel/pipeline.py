@@ -3,25 +3,37 @@
 
 Absent in the reference (SURVEY.md §2.4: PP = NO) — added so the parallel
 layer covers the full dp/tp/sp/ep/pp axis set. The TPU-native shape of
-the idea (scaling-book recipe): each device owns ONE stage's params;
-a `lax.scan` runs M + S − 1 ticks; per tick every device applies its
-stage to its current activation and `ppermute`s the result to the next
-stage — at steady state all S stages compute concurrently on different
-microbatches. The bubble is the standard (S−1)/(M+S−1).
+the idea (scaling-book recipe): each device owns ONE stage; a `lax.scan`
+runs M + S − 1 ticks; per tick every device applies its stage to its
+current activation and `ppermute`s the result to the next stage — at
+steady state all S stages compute concurrently on different
+microbatches. The bubble is the standard (S−1)/(M+S−1). Autodiff flows
+through scan+ppermute, so `jax.grad` yields per-stage parameter
+gradients — no hand-written backward schedule.
 
-Constraints of this v1 (documented): every stage maps activations of one
-width to the same width (equal-width stages), and the microbatch count M
-must be ≥ 1. Autodiff flows through scan+ppermute, so `jax.grad` of a
-loss over `pipeline_apply` yields per-stage parameter gradients — no
-hand-written backward schedule.
+Two layers here:
+- `pipeline_apply`/`make_pipeline` — the homogeneous-stage primitive
+  (every stage same width; stacked per-stage params sharded over the
+  stage axis);
+- `PipelineTrainStep` — the WORKFLOW integration: partitions a
+  StandardWorkflow's forward chain into S contiguous HETEROGENEOUS
+  stages (different widths/ranks), runs each device's stage via
+  `lax.switch` on its stage index over width-padded flat activations,
+  computes the evaluator loss on the last stage's logits and applies
+  each GD twin's SGD hyperparameters — the same training semantics as
+  FusedTrainStep, scheduled as a pipeline. Params are replicated in v1
+  (each device COMPUTES only its stage; memory partitioning is the
+  documented follow-up), which keeps grads exact: the psum transpose
+  sums each param's gradient from the one stage that used it.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
@@ -84,3 +96,274 @@ def make_pipeline(mesh: Mesh, stage_fn: Callable,
     pspec = P(axis_name)   # prefix spec: applies to every params leaf
     return jax.jit(jax.shard_map(
         inner, mesh=mesh, in_specs=(pspec, P()), out_specs=P()))
+
+
+# ---------------------------------------------------------------------------
+# workflow integration: heterogeneous stages, trained
+# ---------------------------------------------------------------------------
+
+
+def make_stage_mesh(devices=None) -> Mesh:
+    """1-D mesh over the "stage" axis (one device per pipeline stage)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (STAGE_AXIS,))
+
+
+def split_stages(forwards: Sequence, n_stages: int,
+                 boundaries: Optional[Sequence[int]] = None) -> List[List]:
+    """Partition the forward chain into contiguous stages. Default
+    boundaries balance cumulative parameter bytes (the dominant per-stage
+    cost for FC chains); pass explicit `boundaries` (unit indices where a
+    new stage starts) to override."""
+    units = list(forwards)
+    if n_stages > len(units):
+        raise ValueError(
+            f"{n_stages} stages but only {len(units)} units — build the "
+            "stage mesh over at most len(forwards) devices")
+    if boundaries is not None:
+        if len(boundaries) != n_stages - 1:
+            raise ValueError(
+                f"boundaries must list the {n_stages - 1} stage-start "
+                f"indices (got {len(boundaries)})")
+        if list(boundaries) != sorted(set(boundaries)) or (
+                boundaries and (boundaries[0] < 1
+                                or boundaries[-1] >= len(units))):
+            raise ValueError(f"boundaries must be strictly increasing "
+                             f"unit indices in [1, {len(units) - 1}]: "
+                             f"{boundaries}")
+        bounds = [0] + list(boundaries) + [len(units)]
+    else:
+        costs = np.asarray([
+            max(1.0, sum(float(np.prod(a.shape)) if a else 0.0
+                         for a in u.param_arrays().values()))
+            for u in units])
+        cum = np.cumsum(costs) / costs.sum()
+        bounds = [0]
+        for s in range(1, n_stages):
+            target = s / n_stages
+            i = int(np.searchsorted(cum, target)) + 1
+            bounds.append(min(max(i, bounds[-1] + 1),
+                              len(units) - (n_stages - s)))
+        bounds.append(len(units))
+    stages = [units[bounds[i]:bounds[i + 1]] for i in range(n_stages)]
+    assert all(stages), f"empty stage: bounds={bounds}"
+    return stages
+
+
+class PipelineTrainStep:
+    """Train a StandardWorkflow chain as an S-stage GPipe pipeline.
+
+    The loader minibatch (N, …) splits into M microbatches of N/M; each
+    tick runs ONE stage per device (lax.switch on the stage index) on a
+    flat activation padded to the widest inter-stage boundary. Loss and
+    n_err use the same weighted forms as FusedTrainStep (evaluator
+    parity), and the per-layer SGD update applies each GD twin's
+    hyperparameters. Stochastic units (dropout/stochastic pooling) are
+    not yet supported in the pipeline schedule — build the step with a
+    deterministic chain."""
+
+    def __init__(self, workflow, mesh: Mesh, n_microbatches: int,
+                 boundaries: Optional[Sequence[int]] = None,
+                 compute_dtype: Optional[Any] = None,
+                 dispatch: str = "auto") -> None:
+        from veles_tpu.parallel.fused import pair_gd_configs
+        self.mesh = mesh
+        self.n_micro = n_microbatches
+        #: how a device picks its stage each tick:
+        #: - "switch": lax.switch — only the selected stage's ops execute
+        #:   (the pipelining point). VALIDATED ONLY ON TPU MESHES: on the
+        #:   CPU backend, switch over heterogeneous branches inside
+        #:   scan+shard_map corrupts the allocator heap (reproduced on
+        #:   jax 0.9 / 8-device virtual CPU: "free(): invalid next size"
+        #:   AND silently wrong step-2 numerics), so
+        #: - "select": compute every stage and lax.select_n the result —
+        #:   branchless and correct everywhere, at S× per-tick compute;
+        #:   the CPU-mesh default (tests, dryrun).
+        #: - "auto": "switch" on TPU devices, "select" otherwise.
+        if dispatch == "auto":
+            plat = mesh.devices.flat[0].platform
+            dispatch = "switch" if plat == "tpu" else "select"
+        assert dispatch in ("switch", "select"), dispatch
+        self.dispatch = dispatch
+        self.forwards = list(workflow.forwards)
+        for u in self.forwards:
+            if getattr(u, "fused_needs_key", False):
+                raise ValueError(
+                    f"{type(u).__name__} needs per-step RNG; the pipeline "
+                    "schedule does not thread keys yet (SURVEY.md §2.4 "
+                    "PP row) — use FusedTrainStep for stochastic chains")
+        self.loss_kind = workflow.loss
+        self.n_classes = getattr(workflow, "n_classes", None)
+        self.compute_dtype = compute_dtype
+        self.gd_units, self.cfgs = pair_gd_configs(workflow)
+        s = mesh.shape[STAGE_AXIS]
+        self.stages = split_stages(self.forwards, s, boundaries)
+        # unit index ranges per stage + boundary activation shapes
+        self._ranges = []
+        i = 0
+        for st in self.stages:
+            self._ranges.append((i, i + len(st)))
+            i += len(st)
+        # per-stage input sample shapes (known post-initialize)
+        self.in_shapes = [tuple(st[0].input.shape[1:])
+                          for st in self.stages]
+        self.out_shape = tuple(self.forwards[-1].output.shape[1:])
+        widths = [int(np.prod(sh)) for sh in
+                  self.in_shapes + [self.out_shape]]
+        self.pad_width = max(widths)
+        self._train_fn = None
+        self._eval_fn = None
+
+    # -- state (same layout as FusedTrainStep) -------------------------------
+
+    def init_state(self) -> Dict[str, Any]:
+        from veles_tpu import prng
+        params = tuple(
+            {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
+            for u in self.forwards)
+        vel = tuple(
+            {k: jnp.zeros_like(a) for k, a in p.items()}
+            for p in params)
+        return {"params": params, "vel": vel,
+                "key": prng.get().next_key(),
+                "lr_scale": jnp.float32(1.0)}
+
+    def write_back(self, state: Dict[str, Any]) -> None:
+        for u, p in zip(self.forwards, state["params"]):
+            for k, arr in u.param_arrays().items():
+                arr.reset(np.asarray(p[k]))
+
+    # -- stage bodies ---------------------------------------------------------
+
+    def _stage_branch(self, si: int):
+        lo, hi = self._ranges[si]
+        in_shape = self.in_shapes[si]
+        d_in = int(np.prod(in_shape))
+
+        def branch(params, x2d):
+            mb = x2d.shape[0]
+            x = x2d[:, :d_in].reshape((mb,) + in_shape)
+            for i in range(lo, hi):
+                p = params[i]
+                if self.compute_dtype is not None:
+                    from veles_tpu.parallel.fused import _tree_cast
+                    p = _tree_cast(p, self.compute_dtype)
+                x = self.forwards[i].fused_apply(p, x)
+            flat = x.reshape(mb, -1)
+            pad = self.pad_width - flat.shape[1]
+            if pad:
+                flat = jnp.pad(flat, ((0, 0), (0, pad)))
+            return flat
+
+        return branch
+
+    def _pipe_forward(self, params, xs_pad):
+        """xs_pad: (M, mb, pad_width) padded input microbatches ->
+        (M, mb, pad_width) last-stage outputs (psum-broadcast)."""
+        branches = [self._stage_branch(si)
+                    for si in range(len(self.stages))]
+
+        def stage_fn(p, x2d):
+            idx = lax.axis_index(STAGE_AXIS)
+            if self.dispatch == "switch":
+                # params ride the closure, not the switch operands: only
+                # the selected branch executes per tick
+                return lax.switch(idx, [
+                    (lambda xx, b=b: b(p, xx)) for b in branches], x2d)
+            return lax.select_n(idx, *[b(p, x2d) for b in branches])
+
+        return pipeline_apply(stage_fn, params, xs_pad, STAGE_AXIS)
+
+    def _loss(self, params, xs_pad, y, w):
+        from veles_tpu.ops import xla as ox
+        outs = self._pipe_forward(params, xs_pad)     # (M, mb, pad)
+        c = int(np.prod(self.out_shape))
+        logits = outs[..., :c].astype(jnp.float32)    # f32 loss/metrics
+        if self.loss_kind == "softmax":
+            wt = jnp.broadcast_to(w.reshape(y.shape[:w.ndim] +
+                                            (1,) * (y.ndim - w.ndim)),
+                                  y.shape).astype(jnp.float32)
+            loss = ox.ce_loss_from_logits(logits, y, self.n_classes,
+                                          weights=wt)
+            n_err = ((logits.reshape(-1, c).argmax(-1) != y.reshape(-1))
+                     & (wt.reshape(-1) > 0)).sum()
+        else:
+            loss, _ = ox.mse(logits.reshape((-1,) + (c,)),
+                             y.reshape(-1, c), weights=w.reshape(-1))
+            n_err = loss
+        return loss, n_err
+
+    # -- public API -----------------------------------------------------------
+
+    def _microbatch(self, x, y, w):
+        m = self.n_micro
+        n = x.shape[0]
+        assert n % m == 0, (n, m)
+        mb = n // m
+        flat = jnp.asarray(x).reshape(n, -1)
+        if self.compute_dtype is not None:
+            # inter-stage activations (and the ppermute traffic) ride the
+            # compute dtype; the loss head casts back to f32
+            flat = flat.astype(self.compute_dtype)
+        pad = self.pad_width - flat.shape[1]
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+        xs = flat.reshape(m, mb, self.pad_width)
+        y = jnp.asarray(y).reshape((m, mb) + jnp.asarray(y).shape[1:])
+        w = jnp.asarray(w, jnp.float32).reshape(m, mb)
+        return xs, y, w
+
+    def _build(self) -> None:
+        from veles_tpu.ops import optim
+
+        def train_body(state, xs, y, w):
+            def lf(p):
+                loss, n_err = self._loss(p, xs, y, w)
+                return loss, (loss, n_err)
+
+            (_, (loss, n_err)), grads = jax.value_and_grad(
+                lf, has_aux=True)(state["params"])
+            new_p, new_v = [], []
+            for p, g, v, cfg in zip(state["params"], grads,
+                                    state["vel"], self.cfgs):
+                if p:
+                    p2, v2 = optim.sgd_update(p, g, v, cfg,
+                                              lr_scale=state["lr_scale"])
+                else:
+                    p2, v2 = p, v
+                new_p.append(p2)
+                new_v.append(v2)
+            new_state = {"params": tuple(new_p), "vel": tuple(new_v),
+                         "key": state["key"],
+                         "lr_scale": state["lr_scale"]}
+            return new_state, loss, n_err
+
+        def eval_body(params, xs, y, w):
+            return self._loss(params, xs, y, w)
+
+        self._train_fn = jax.jit(jax.shard_map(
+            train_body, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P())))
+        self._eval_fn = jax.jit(jax.shard_map(
+            eval_body, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P())))
+
+    def train(self, state, x, y, w=None):
+        if self._train_fn is None:
+            self._build()
+        if w is None:
+            w = np.ones(np.shape(x)[0], np.float32)
+        xs, y, w = self._microbatch(x, y, w)
+        new_state, loss, n_err = self._train_fn(state, xs, y, w)
+        return new_state, (loss, n_err)
+
+    def evaluate(self, state, x, y, w=None):
+        if self._eval_fn is None:
+            self._build()
+        if w is None:
+            w = np.ones(np.shape(x)[0], np.float32)
+        xs, y, w = self._microbatch(x, y, w)
+        return self._eval_fn(state["params"], xs, y, w)

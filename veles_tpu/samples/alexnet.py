@@ -85,12 +85,20 @@ def create_workflow(minibatch_size: Optional[int] = None,
     hw = input_hw or cfg.loader.input_hw
     nc = n_classes or cfg.n_classes
     if cfg.loader.get("data_path"):
-        from veles_tpu.loader.image import ImageDirectoryLoader
-        loader = ImageDirectoryLoader(
-            data_path=cfg.loader.data_path, size_hw=(hw, hw),
-            n_validation=(n_validation if n_validation is not None
-                          else cfg.loader.n_validation),
-            minibatch_size=mb)
+        import os
+        path = cfg.loader.data_path
+        if os.path.exists(os.path.join(path, "manifest.json")):
+            # packed memmap format (loader/memmap.py): the ImageNet-scale
+            # path — pack once with pack_image_dataset, train many times
+            from veles_tpu.loader.memmap import MemmapImageLoader
+            loader = MemmapImageLoader(data_path=path, minibatch_size=mb)
+        else:
+            from veles_tpu.loader.image import ImageDirectoryLoader
+            loader = ImageDirectoryLoader(
+                data_path=path, size_hw=(hw, hw),
+                n_validation=(n_validation if n_validation is not None
+                              else cfg.loader.n_validation),
+                minibatch_size=mb)
     else:
         loader = SyntheticClassifierLoader(
             n_classes=min(nc, 64),  # prototype count, not the head width

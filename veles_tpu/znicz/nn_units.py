@@ -207,7 +207,8 @@ class GradientDescentVJP(GradientDescentBase):
         return self
 
     def initialize(self, device=None, **kwargs: Any):
-        if not self.err_output or not getattr(self, self._pnames[0]):
+        if not self.err_output or (
+                self._pnames and not getattr(self, self._pnames[0])):
             return False
         for name in self._pnames:
             vname = f"vel_{name}"

@@ -196,6 +196,17 @@ class StandardWorkflow(Workflow):
         return FusedTrainStep(self, mesh=mesh, mode=mode,
                               compute_dtype=compute_dtype, ep=ep)
 
+    def build_pipeline_step(self, mesh, n_microbatches: int = 4,
+                            boundaries=None, compute_dtype=None):
+        """Compile the chain as an S-stage GPipe pipeline over `mesh`'s
+        "stage" axis (see parallel.pipeline.PipelineTrainStep). The
+        workflow must be initialized first (stage shapes come from the
+        units' allocated activations)."""
+        from veles_tpu.parallel.pipeline import PipelineTrainStep
+        return PipelineTrainStep(self, mesh, n_microbatches,
+                                 boundaries=boundaries,
+                                 compute_dtype=compute_dtype)
+
     def run_fused(self, epochs: Optional[int] = None, device=None,
                   mesh=None, mode: str = "auto", compute_dtype=None,
                   ep: bool = False) -> None:
