@@ -1,0 +1,98 @@
+"""Live-attach manhole (SURVEY.md §2.5 manhole slot) + web-status
+cluster view (coordinator's worker registry)."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+
+
+class FakeWorkflow:
+    name = "FakeWF"
+    stopped = False
+    units = ()
+
+
+def _read_until(f, token: str, timeout: float = 10.0) -> str:
+    buf = []
+    end = time.time() + timeout
+    while time.time() < end:
+        ch = f.read(1)
+        if not ch:
+            break
+        buf.append(ch)
+        if "".join(buf).endswith(token):
+            return "".join(buf)
+    raise AssertionError(f"token {token!r} not seen in {''.join(buf)!r}")
+
+
+def test_manhole_attach_and_inspect():
+    """Attach to a live ManholeServer over TCP, inspect the workflow,
+    mutate state, detach — the process keeps running."""
+    from veles_tpu.manhole import ManholeServer
+    wf = FakeWorkflow()
+    srv = ManholeServer(wf, port=0).start()
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=10) as conn:
+            f = conn.makefile("rw", encoding="utf-8", newline="\n")
+            _read_until(f, ">>> ")
+            f.write("print(workflow.name)\n")
+            f.flush()
+            out = _read_until(f, ">>> ")
+            assert "FakeWF" in out
+            f.write("workflow.poked = 41 + 1\n")
+            f.flush()
+            _read_until(f, ">>> ")
+            f.write("exit()\n")
+            f.flush()
+        assert wf.poked == 42       # console ran IN the live process
+        # server still accepts a second attachment
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=10) as conn:
+            f = conn.makefile("rw", encoding="utf-8", newline="\n")
+            _read_until(f, ">>> ")
+            f.write("print(workflow.poked + 1)\n")
+            f.flush()
+            assert "43" in _read_until(f, ">>> ")
+    finally:
+        srv.stop()
+
+
+def test_web_status_cluster_heartbeats():
+    """Workers POST heartbeats; the coordinator's status.json lists them
+    with ages (the reference master's slave registry analog)."""
+    from veles_tpu.web_status import HeartbeatReporter, WebStatusServer
+    srv = WebStatusServer(FakeWorkflow(), port=0)
+    srv.start()
+    try:
+        rep = HeartbeatReporter("127.0.0.1", srv.port, process_id=1,
+                                interval=0.2)
+        rep._beat()                  # synchronous: no thread flakiness
+        rep2 = HeartbeatReporter("127.0.0.1", srv.port, process_id=2,
+                                 interval=0.2)
+        rep2._beat()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status.json",
+                timeout=10) as r:
+            status = json.loads(r.read())
+        assert set(status["workers"]) == {"1", "2"}
+        w = status["workers"]["1"]
+        assert w["age_s"] >= 0.0 and "host" in w
+        assert status["workflow"] == "FakeWF"
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_reporter_thread_survives_no_server():
+    """A worker beating into a dead coordinator port must not raise."""
+    from veles_tpu.web_status import HeartbeatReporter
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    rep = HeartbeatReporter("127.0.0.1", dead_port, process_id=0,
+                            interval=0.05).start()
+    time.sleep(0.2)
+    rep.stop()                      # no exception = pass

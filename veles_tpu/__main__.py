@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-w", "--web-status", action="store_true",
                    help="serve the status dashboard while running")
     p.add_argument("--web-port", type=int, default=8090)
+    p.add_argument("--manhole", nargs="?", const=0, default=None,
+                   type=int, metavar="PORT",
+                   help="listen for live-attach REPL connections on "
+                        "127.0.0.1:PORT (0 = auto-pick); attach with "
+                        "python -m veles_tpu.manhole <port>")
     p.add_argument("-p", "--profile", default="", metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
@@ -103,6 +108,15 @@ def main(argv=None) -> int:
         _import_file(args.config, "veles_config")
     apply_overrides(args.overrides)
 
+    if args.listen or args.master:
+        # MUST run before make_device: jax.distributed.initialize rejects
+        # any call after the XLA backend is touched (found by live drive;
+        # the Launcher's boot_distributed is idempotent and will no-op)
+        from veles_tpu.parallel.distributed import initialize_distributed
+        initialize_distributed(coordinator=args.listen or args.master,
+                               process_id=args.process_id,
+                               n_processes=args.n_processes)
+
     from veles_tpu.backends import make_device
     device = make_device(args.backend)
 
@@ -112,7 +126,7 @@ def main(argv=None) -> int:
         device=device, stats=not args.no_stats,
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
-        fused=args.fused)
+        fused=args.fused, manhole=args.manhole)
     if args.optimize:
         return run_optimize(module, args, device)
     return launcher.run_module(module)

@@ -33,7 +33,7 @@ class Launcher(Logger):
                  device: Any = None, stats: bool = True,
                  web_status: bool = False, web_port: int = 8090,
                  profile_dir: str = "", debug_nans: bool = False,
-                 fused: bool = False,
+                 fused: bool = False, manhole: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -53,9 +53,13 @@ class Launcher(Logger):
         self.show_stats = stats
         self.web_status_enabled = web_status
         self.web_port = web_port
+        #: None = disabled; int = port to listen on (0 auto-picks).
+        #: External live-attach REPL (reference manhole, SURVEY.md §2.5)
+        self.manhole_port = manhole
         self.workflow = None
         self.snapshot_loaded = False
         self._web = None
+        self._manhole = None
 
     # -- distributed bootstrap ----------------------------------------------
 
@@ -120,9 +124,26 @@ class Launcher(Logger):
             from veles_tpu.parallel.distributed import is_coordinator
             if self.mode == "standalone" or is_coordinator():
                 from veles_tpu.web_status import WebStatusServer
-                self._web = WebStatusServer(self.workflow,
+                # distributed: bind all interfaces so worker heartbeats
+                # from OTHER hosts can reach the cluster view (loopback
+                # binding would silently drop them); standalone stays
+                # loopback-only
+                host = ("127.0.0.1" if self.mode == "standalone"
+                        else "0.0.0.0")
+                self._web = WebStatusServer(self.workflow, host=host,
                                             port=self.web_port)
                 self._web.start()
+            else:
+                # workers report into the coordinator's cluster view
+                # (reference master's slave registry, SURVEY.md §2.5)
+                from veles_tpu.web_status import HeartbeatReporter
+                host = (self.master or self.listen).rsplit(":", 1)[0]
+                self._web = HeartbeatReporter(
+                    host, self.web_port, self.process_id).start()
+        if self.manhole_port is not None:
+            from veles_tpu.manhole import ManholeServer
+            self._manhole = ManholeServer(self.workflow,
+                                          port=self.manhole_port).start()
         profiling = False
         if self.profile_dir:
             import jax
@@ -176,6 +197,8 @@ class Launcher(Logger):
                 self.info("profiler trace -> %s", self.profile_dir)
             if self._web is not None:
                 self._web.stop()
+            if self._manhole is not None:
+                self._manhole.stop()
             if self.show_stats and hasattr(self.workflow, "print_stats"):
                 self.workflow.print_stats()
         return 0

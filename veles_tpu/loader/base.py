@@ -209,16 +209,20 @@ class PrefetchingLoader(Loader):
         return idx[take]
 
     def fill_minibatch(self, indices: np.ndarray) -> None:
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import CancelledError, ThreadPoolExecutor
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
                 max_workers=self.n_workers,
                 thread_name_prefix=f"{self.name}-produce")
         fut = self._pending.pop(self._cursor, None)
-        x, y = (fut.result() if fut is not None
-                else self._produce_batch(indices))
-        self.minibatch_data.reset(x)
-        self.minibatch_labels.reset(y)
+        try:
+            x, y = (fut.result() if fut is not None
+                    else self._produce_batch(indices))
+        except CancelledError:
+            # stop() from another thread (manhole, Ctrl-C handler)
+            # cancelled the lookahead mid-fill: produce synchronously so
+            # the pump loop winds down cleanly instead of crashing
+            x, y = self._produce_batch(indices)
         for ahead in range(1, self.prefetch + 1):
             pos = self._cursor + ahead
             if pos in self._pending:
@@ -226,8 +230,13 @@ class PrefetchingLoader(Loader):
             nxt = self._indices_at(pos)
             if nxt is None:
                 break
-            self._pending[pos] = self._pool.submit(self._produce_batch,
-                                                   nxt)
+            try:
+                self._pending[pos] = self._pool.submit(
+                    self._produce_batch, nxt)
+            except RuntimeError:     # pool shut down by concurrent stop()
+                break
+        self.minibatch_data.reset(x)
+        self.minibatch_labels.reset(y)
 
     def run(self) -> None:
         super().run()

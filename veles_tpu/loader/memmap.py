@@ -100,7 +100,9 @@ def pack_image_dataset(src_tree: str, out_dir: str,
         chunk = np.zeros((len(chunk_idx), h, w, 3), np.uint8)
         for j, src_i in enumerate(chunk_idx):
             img = decode_image(paths[int(src_i)], size_hw)  # [-1, 1] f32
-            chunk[j] = ((img + 1.0) * 127.5).astype(np.uint8)
+            # rint, not truncation: float32 round-trip lands epsilon below
+            # the integer for ~25% of values and astype would store v-1
+            chunk[j] = np.rint((img + 1.0) * 127.5).astype(np.uint8)
             if (lo + j) % mean_step == 0 and mean_cnt < mean_sample:
                 acc += img
                 mean_cnt += 1
@@ -224,7 +226,7 @@ class MemmapImageLoader(PrefetchingLoader):
             self.load_data()   # re-establish memmaps after unpickle
 
 
-def loader_throughput(loader: Loader, n_batches: int = 50) -> Dict[str, float]:
+def loader_throughput(loader, n_batches: int = 50) -> dict:
     """Host input-pipeline rate (samples/sec) over `n_batches` fills —
     the number to compare against the fused step's device rate: prefetch
     sustains overlap iff loader_rate >= device_rate."""

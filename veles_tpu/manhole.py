@@ -1,0 +1,143 @@
+"""Manhole: attach a REPL to a LIVE training process from outside.
+
+Parity: the reference vendored `manhole` (SURVEY.md §2.5) so a researcher
+could `nc` into a running Veles and inspect it; the in-graph `Shell` unit
+(interaction.py) only fires at its wired point in the graph. This is the
+attach-from-outside analog: a daemon thread listens on localhost TCP (or
+a unix socket) and serves a Python console over the connection with the
+live workflow in scope — connect with `nc 127.0.0.1 <port>` or
+`python -m veles_tpu.manhole <port>` while training continues.
+
+Security note (documented trust model, like the Snapshotter's): the
+console executes arbitrary code as the training process — the listener
+binds 127.0.0.1 only and should stay that way; use SSH port-forwarding
+for remote attach.
+"""
+
+from __future__ import annotations
+
+import code
+import contextlib
+import io
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from veles_tpu.logger import Logger
+
+BANNER = ("veles_tpu manhole — `workflow` is the LIVE workflow "
+          "(training continues concurrently); Ctrl-D / exit() detaches\n")
+
+#: serializes console pushes ACROSS attachments: redirect_stdout rebinds
+#: the process-global sys.stdout, and two interleaved attachments
+#: restoring out of order would leave it pointing at a dead StringIO
+#: forever. While one command executes, training-thread prints go to the
+#: attached client instead of the log — commands are short; documented
+#: trade-off, same as the reference's manhole.
+_PUSH_LOCK = threading.Lock()
+
+
+class ManholeServer(Logger):
+    """Serve Python consoles on localhost; one thread per attachment."""
+
+    def __init__(self, workflow=None, host: str = "127.0.0.1",
+                 port: int = 0, ctx: Optional[Dict[str, Any]] = None
+                 ) -> None:
+        super().__init__()
+        self.workflow = workflow
+        self.host = host
+        self.port = port
+        self.ctx = dict(ctx or {})
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+
+    def start(self) -> "ManholeServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(2)
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="manhole")
+        self._thread.start()
+        self.info("manhole listening on %s:%d (nc to attach)",
+                  self.host, self.port)
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return          # socket closed by stop()
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=f"manhole-{addr[1]}").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        f = conn.makefile("rw", encoding="utf-8", newline="\n")
+        ns = {"workflow": self.workflow, **self.ctx}
+        console = code.InteractiveConsole(ns)
+        try:
+            f.write(BANNER)
+            prompt = ">>> "
+            while True:
+                f.write(prompt)
+                f.flush()
+                line = f.readline()
+                if not line or line.strip() in ("exit()", "quit()",
+                                                "exit", "quit"):
+                    break
+                out = io.StringIO()
+                with _PUSH_LOCK, contextlib.redirect_stdout(out), \
+                        contextlib.redirect_stderr(out):
+                    more = console.push(line.rstrip("\n"))
+                if out.getvalue():
+                    f.write(out.getvalue())
+                prompt = "... " if more else ">>> "
+        except (OSError, ValueError):
+            pass                # client went away mid-write
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+def attach(port: int, host: str = "127.0.0.1") -> None:
+    """Interactive client (`python -m veles_tpu.manhole <port>`)."""
+    import sys
+    with socket.create_connection((host, port)) as conn:
+        conn_f = conn.makefile("rw", encoding="utf-8", newline="\n")
+        import select
+        sys.stdout.write(f"attached to {host}:{port}\n")
+        while True:
+            ready, _, _ = select.select([conn, sys.stdin], [], [])
+            if conn in ready:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                sys.stdout.write(data.decode())
+                sys.stdout.flush()
+            if sys.stdin in ready:
+                line = sys.stdin.readline()
+                if not line:
+                    break
+                conn_f.write(line)
+                conn_f.flush()
+
+
+if __name__ == "__main__":
+    import sys
+    attach(int(sys.argv[1]),
+           sys.argv[2] if len(sys.argv) > 2 else "127.0.0.1")

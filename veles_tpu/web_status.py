@@ -6,12 +6,20 @@ distributed mode) cluster membership. The reference used Tornado + a JS
 frontend; here a stdlib `http.server` on a daemon thread serves a
 self-contained page that polls a JSON endpoint — no extra dependency, same
 information.
+
+Cluster view (multi-process runs): the coordinator's server accepts
+`POST /heartbeat.json` from worker processes (`HeartbeatReporter`,
+started by the Launcher's worker role) and lists every process with its
+last-seen age — the analog of the reference master's slave registry,
+minus the job bookkeeping that synchronous SPMD made obsolete.
 """
 
 from __future__ import annotations
 
 import json
+import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -23,6 +31,10 @@ th{text-align:left;background:#222}h1{font-size:1.2em}
 </style></head><body>
 <h1>veles_tpu — workflow status</h1>
 <div id="meta"></div>
+<div id="cluster"></div>
+<table id="procs" style="display:none"><thead><tr><th>process</th>
+<th>host</th><th>devices</th><th>last seen</th></tr></thead>
+<tbody></tbody></table>
 <table id="units"><thead><tr><th>unit</th><th>runs</th><th>time (s)</th>
 </tr></thead><tbody></tbody></table>
 <script>
@@ -31,6 +43,20 @@ async function tick(){
   document.getElementById('meta').textContent =
     `workflow: ${s.workflow}  stopped: ${s.stopped}  ` +
     (s.epoch != null ? `epoch: ${s.epoch}  best_err: ${s.best_err}` : '');
+  const c = s.cluster;
+  document.getElementById('cluster').textContent = c ?
+    `cluster: process ${c.process_index}/${c.process_count}  ` +
+    `global devices: ${c.global_devices}  local: ${c.local_devices}` : '';
+  const pt = document.getElementById('procs');
+  const ptb = pt.querySelector('tbody'); ptb.innerHTML = '';
+  const workers = Object.entries(s.workers || {});
+  pt.style.display = workers.length ? '' : 'none';
+  for (const [pid, w] of workers){
+    const tr = document.createElement('tr');
+    tr.innerHTML = `<td>${pid}</td><td>${w.host}</td>` +
+      `<td>${w.local_devices}</td><td>${w.age_s.toFixed(1)}s ago</td>`;
+    ptb.appendChild(tr);
+  }
   const tb = document.querySelector('#units tbody'); tb.innerHTML = '';
   for (const u of s.units){
     const tr = document.createElement('tr');
@@ -60,6 +86,17 @@ def workflow_status(workflow) -> Dict[str, Any]:
     if decision is not None:
         status["epoch"] = decision.epoch_number
         status["best_err"] = decision.best_validation_err
+    try:
+        import jax
+        if jax.process_count() > 1:
+            status["cluster"] = {
+                "process_index": jax.process_index(),
+                "process_count": jax.process_count(),
+                "global_devices": jax.device_count(),
+                "local_devices": jax.local_device_count(),
+            }
+    except Exception:       # backend not initialized yet: no cluster row
+        pass
     return status
 
 
@@ -71,16 +108,25 @@ class WebStatusServer:
         self.workflow = workflow
         self.host = host
         self.port = port
+        #: worker heartbeats: process_id -> {host, local_devices, t}
+        self.workers: Dict[str, Dict[str, Any]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
         wf = self.workflow
+        workers = self.workers
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 if self.path.startswith("/status.json"):
-                    body = json.dumps(workflow_status(wf)).encode()
+                    status = workflow_status(wf)
+                    now = time.time()
+                    status["workers"] = {
+                        pid: {**{k: v for k, v in w.items() if k != "t"},
+                              "age_s": round(now - w["t"], 3)}
+                        for pid, w in sorted(workers.items())}
+                    body = json.dumps(status).encode()
                     ctype = "application/json"
                 else:
                     body = _PAGE.encode()
@@ -90,6 +136,26 @@ class WebStatusServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self) -> None:  # noqa: N802
+                if not self.path.startswith("/heartbeat.json"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    beat = json.loads(self.rfile.read(n) or b"{}")
+                    pid = str(beat.pop("process_id"))
+                    if not isinstance(beat, dict):
+                        raise ValueError(beat)
+                except (ValueError, KeyError, AttributeError, TypeError):
+                    self.send_response(400)   # malformed beat != crash
+                    self.end_headers()
+                    return
+                beat["t"] = time.time()
+                workers[pid] = beat
+                self.send_response(204)
+                self.end_headers()
 
             def log_message(self, *args: Any) -> None:
                 pass  # keep the training log clean
@@ -105,3 +171,57 @@ class WebStatusServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+class HeartbeatReporter:
+    """Worker-side: POST a liveness beat to the coordinator's web status
+    every `interval` seconds on a daemon thread (the Launcher starts one
+    per worker process when web status is enabled)."""
+
+    def __init__(self, coordinator_host: str, port: int,
+                 process_id: int, interval: float = 5.0) -> None:
+        self.url_host = coordinator_host
+        self.port = port
+        self.process_id = process_id
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _beat(self) -> None:
+        import http.client
+        try:
+            import jax
+            n_local = jax.local_device_count()
+        except Exception:
+            n_local = 0
+        body = json.dumps({
+            "process_id": self.process_id,
+            "host": socket.gethostname(),
+            "local_devices": n_local,
+        })
+        conn = http.client.HTTPConnection(self.url_host, self.port,
+                                          timeout=3)
+        try:
+            conn.request("POST", "/heartbeat.json", body,
+                         {"Content-Type": "application/json"})
+            conn.getresponse().read()
+        finally:
+            conn.close()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._beat()
+            except Exception:   # noqa: BLE001 — liveness thread must
+                pass            # outlive ANY transport hiccup (refused,
+                                # BadStatusLine, ...), not just OSError
+            self._stop.wait(self.interval)
+
+    def start(self) -> "HeartbeatReporter":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

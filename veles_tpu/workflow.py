@@ -85,17 +85,35 @@ class Workflow(Container):
             for src in unit._links_from:
                 unit._links_from[src] = False
         self.schedule(self.start_point)
-        while self._queue and not self.stopped:
-            self._queue.popleft().fire()
-        self.run_total_time += time.perf_counter() - start
-        for unit in self.units:
-            unit.stop()
+        try:
+            while self._queue and not self.stopped:
+                self._queue.popleft().fire()
+        finally:
+            # teardown must run even when a unit raised (Ctrl-C mid-run
+            # used to leave prefetch/plotter threads alive): every unit's
+            # stop() is invoked, failures logged, none masking the
+            # original exception
+            self.run_total_time += time.perf_counter() - start
+            self._stop_units()
 
     def on_end_point(self) -> None:
         self.stopped = True
 
+    def _stop_units(self) -> None:
+        for unit in self.units:
+            if unit is self:
+                continue
+            try:
+                unit.stop()
+            except Exception as e:   # noqa: BLE001 — teardown best-effort
+                self.warning("stop() of %s failed: %s", unit.name, e)
+
     def stop(self) -> None:
+        """Stop the pump loop AND release unit-owned background resources
+        (prefetch pools, plotter renderer threads) — callable from any
+        thread and idempotent."""
         self.stopped = True
+        self._stop_units()
 
     # -- reporting -----------------------------------------------------------
 

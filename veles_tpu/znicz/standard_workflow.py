@@ -265,3 +265,4 @@ class StandardWorkflow(Workflow):
             loader.on_device = prev_on_device
             step.write_back(state)
             self.fused_state = state
+            self._stop_units()   # release loader prefetch threads etc.
