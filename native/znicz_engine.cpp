@@ -296,6 +296,7 @@ struct Layer {
   int ky = 0, kx = 0, sy = 1, sx = 1, py = 0, px = 0;
   float k = 2.f, alpha = 1e-4f, beta = 0.75f;
   int nwin = 5;
+  float scale = 1.f, offset = 0.f;  // "affine" (input_normalize export)
   std::vector<int> w_shape;
   std::vector<float> weights, bias;
 };
@@ -375,6 +376,8 @@ Engine* load_package(const std::string& dir) {
     l.alpha = (float)lj.numval("alpha", 1e-4);
     l.beta = (float)lj.numval("beta", 0.75);
     l.nwin = (int)lj.numval("n", 5);
+    l.scale = (float)lj.numval("scale", 1.0);
+    l.offset = (float)lj.numval("offset", 0.0);
     const auto& arrays = lj.at("arrays").arr;
     if (!arrays.empty()) {
       l.weights = read_blob(pool, arrays[0]);
@@ -415,6 +418,26 @@ void run_forward(Engine* eng, Tensor* t) {
       out.data.resize(t->data.size());
       for (size_t i = 0; i < t->data.size(); ++i)
         out.data[i] = activate(l.activation, t->data[i]);
+    } else if (l.type == "affine") {
+      // input_normalize export: y = x*scale + offset - mean (mean is an
+      // optional per-sample-shaped blob in weights)
+      size_t sample = (size_t)(t->size() / t->shape[0]);
+      if (!l.weights.empty() && l.weights.size() != sample)
+        throw std::runtime_error("affine mean size mismatch");
+      out.shape = t->shape;
+      out.data.resize(t->data.size());
+      size_t n = t->data.size() / sample;
+      for (size_t b = 0; b < n; ++b) {      // sample-major: direct mean
+        const float* src = t->data.data() + b * sample;
+        float* dst = out.data.data() + b * sample;
+        if (l.weights.empty()) {
+          for (size_t i = 0; i < sample; ++i)
+            dst[i] = src[i] * l.scale + l.offset;
+        } else {
+          for (size_t i = 0; i < sample; ++i)
+            dst[i] = src[i] * l.scale + l.offset - l.weights[i];
+        }
+      }
     } else if (l.type == "identity") {
       continue;
     } else {

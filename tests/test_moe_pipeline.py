@@ -319,3 +319,30 @@ def test_pipeline_differentiable(eight_devices):
     np.testing.assert_allclose(np.asarray(g_pipe["w"]),
                                np.asarray(g_seq["w"]),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_moe_workflow_snapshot_roundtrip(tmp_path):
+    """MoE workflows snapshot/restore like every other family: params
+    (incl. expert tensors + router) survive the pickle and training
+    continues from the restored state."""
+    import pickle
+
+    from veles_tpu.backends import XLADevice
+    wf = _build_moe_wf(seed=777)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    w1_before = wf.forwards[0].w1.mem.copy()
+    err_before = wf.decision.best_validation_err
+    blob = pickle.dumps(wf)
+    wf2 = pickle.loads(blob)
+    np.testing.assert_array_equal(wf2.forwards[0].w1.mem, w1_before)
+    assert wf2.decision.best_validation_err == err_before
+    # restored workflow keeps training (gates re-derived); this snapshot
+    # was taken AFTER completion, so extending the run means raising
+    # max_epochs AND clearing the completion latch (reference semantics:
+    # `complete` is state, not derived)
+    wf2.decision.max_epochs += 2
+    wf2.decision.complete <<= False
+    wf2.initialize(device=XLADevice())
+    wf2.run()
+    assert wf2.decision.epoch_number > wf.decision.epoch_number

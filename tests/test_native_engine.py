@@ -146,3 +146,28 @@ def test_corrupt_manifest_rejected(tmp_path):
         "offset", 10 ** 12))
     corrupt(lambda t: t["layers"][0]["arrays"][0].__setitem__(
         "shape", [2 ** 31, 2 ** 31]))
+
+
+def test_input_normalize_package_matches_golden(tmp_path):
+    """uint8-pipeline models (leading input_normalize with a mean image)
+    export with their normalization baked in: the C++ "affine" op must
+    match the Python golden forward."""
+    wf = build_wf(
+        [{"type": "input_normalize"},
+         {"type": "conv_strictrelu", "n_kernels": 4, "kx": 3, "ky": 3,
+          "weights_stddev": 0.1},
+         {"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(6, 6, 3))
+    # simulate a loader-provided mean image
+    mean = np.random.RandomState(3).randn(6, 6, 3).astype(np.float32) * 0.1
+    wf.forwards[0]._mean = mean
+    pkg = export_workflow(wf, str(tmp_path / "pkg_norm"))
+
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(1).randint(
+        0, 256, (5, 6, 6, 3)).astype(np.float32)   # raw byte values
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    np.testing.assert_allclose(got, gold, rtol=2e-5, atol=2e-6)

@@ -83,6 +83,17 @@ def _export_activation(u):
     return ({"type": "activation", "activation": u.activation}, [])
 
 
+@_exporter("InputNormalize")
+def _export_input_normalize(u):
+    # serving twin of the on-device normalize: the C++ engine applies
+    # y = x*scale + offset - mean, so uint8-pipeline models deploy with
+    # their training-time normalization baked into the package
+    arrays = ([np.asarray(u._mean, np.float32)]
+              if u._mean is not None else [])
+    return ({"type": "affine", "scale": float(u.scale),
+             "offset": float(u.offset)}, arrays)
+
+
 def export_workflow(workflow, directory: str) -> str:
     """Write topology.json + weights.bin for the workflow's forward chain.
     Returns the package directory. Raises on layers with no native twin
