@@ -87,3 +87,45 @@ def test_workflow_with_plotters_and_results(tmp_path):
     assert res["epochs"] == 3
     assert res["best_validation_err"] is not None
     assert any(u["name"] == "repeater" for u in res["units"])
+
+
+def test_standard_workflow_plot_config_granular_and_fused(tmp_path):
+    """plot_config wires the reference's standard plot set; error curves
+    accumulate one point per epoch in BOTH granular and fused modes."""
+    from veles_tpu import prng
+    from veles_tpu.backends import XLADevice
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    def build():
+        prng.seed_all(31)
+        loader = SyntheticClassifierLoader(
+            n_classes=4, sample_shape=(8,), n_validation=32, n_train=96,
+            minibatch_size=32, noise=0.4)
+        return StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 12,
+                     "weights_stddev": 0.1},
+                    {"type": "softmax", "output_sample_shape": 4,
+                     "weights_stddev": 0.05}],
+            loader=loader, loss="softmax", n_classes=4,
+            decision_config={"max_epochs": 3, "fail_iterations": 50},
+            gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+            plot_config={"error_curve": True, "confusion": True,
+                         "weights": True},
+            name="PlotWF")
+
+    wf = build()
+    assert len(wf.plotters) == 4          # 2 curves + confusion + weights
+    wf.initialize(device=XLADevice())
+    wf.run()
+    curves = [p for p in wf.plotters if hasattr(p, "values")]
+    assert all(len(p.values) == 3 for p in curves), \
+        [(p.label, p.values) for p in curves]
+    # validation curve tracks the decision's per-epoch metric
+    val = next(p for p in curves if p.label == "validation")
+    assert val.values[-1] == wf.decision.epoch_metrics[1]
+
+    wf2 = build()
+    wf2.run_fused()
+    curves2 = [p for p in wf2.plotters if hasattr(p, "values")]
+    assert all(len(p.values) == 3 for p in curves2)

@@ -42,6 +42,10 @@ class GraphicsRenderer(Logger):
         self._q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self.rendered: List[str] = []
+        #: per-plot-name merged line series: several AccumulatingPlotters
+        #: publishing under one name (train/validation error) draw on ONE
+        #: figure, like the reference's multi-series error chart
+        self._series: Dict[str, Dict[str, Any]] = {}
 
     def start(self) -> None:
         os.makedirs(self.directory, exist_ok=True)
@@ -51,6 +55,13 @@ class GraphicsRenderer(Logger):
 
     def publish(self, spec: Dict[str, Any]) -> None:
         self._q.put(spec)
+
+    def clear_series(self, name: str) -> None:
+        """Drop the merged line-series cache for `name` (rides the queue,
+        so it is ordered with in-flight publishes): a NEW workflow
+        plotting under a name an earlier run used starts clean instead
+        of inheriting the old curves."""
+        self._q.put({"name": name, "kind": "__clear__"})
 
     def stop(self) -> None:
         if self._thread is None:
@@ -75,7 +86,16 @@ class GraphicsRenderer(Logger):
 
     def _render(self, spec: Dict[str, Any]) -> Optional[str]:
         name = spec["name"]
+        if spec.get("kind") == "__clear__":
+            self._series.pop(name, None)    # new run under the same name
+            return None
         base = os.path.join(self.directory, name)
+        if spec.get("kind") == "lines":
+            # merge multi-publisher series (train/validation under one
+            # name) for BOTH the png and the headless-json paths
+            merged = self._series.setdefault(name, {})
+            merged.update(spec["series"])
+            spec = dict(spec, series=dict(merged))
         if not _have_matplotlib():
             path = base + ".json"
             with open(path, "w") as f:

@@ -48,6 +48,13 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err = 0
         self.confusion_matrix = Array(
             np.zeros((n_classes, n_classes), np.int64))
+        #: None — accumulate confusion over every minibatch (legacy);
+        #: a class index (0/1/2) — only that split's minibatches count
+        #: (requires `minibatch_class` linked from the loader). The
+        #: plot_config wiring sets VALIDATION here so the confusion plot
+        #: is the reference's per-epoch validation matrix.
+        self.confusion_split = None
+        self.minibatch_class = None
 
     def initialize(self, device=None, **kwargs: Any):
         if not self.input:
@@ -84,7 +91,7 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.loss = loss
         self.err_output.mem = err
         self.n_err = n_err
-        if self.compute_confusion:
+        if self._accumulate_confusion():
             self.confusion_matrix.map_write()
             self.confusion_matrix.mem += conf
 
@@ -97,9 +104,15 @@ class EvaluatorSoftmax(EvaluatorBase):
         # scalars cross to host here: the Decision unit is host-side logic
         self.loss = float(loss)
         self.n_err = int(n_err)
-        if self.compute_confusion:
+        if self._accumulate_confusion():
             self.confusion_matrix.map_write()
             self.confusion_matrix.mem += np.asarray(conf)
+
+    def _accumulate_confusion(self) -> bool:
+        if not self.compute_confusion:
+            return False
+        split = getattr(self, "confusion_split", None)
+        return split is None or self.minibatch_class == split
 
     def reset_metrics(self) -> None:
         self.confusion_matrix.reset(

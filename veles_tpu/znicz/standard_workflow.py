@@ -56,6 +56,7 @@ class StandardWorkflow(Workflow):
                  decision_config: Optional[Dict[str, Any]] = None,
                  gd_config: Optional[Dict[str, Any]] = None,
                  snapshot_config: Optional[Dict[str, Any]] = None,
+                 plot_config: Optional[Dict[str, Any]] = None,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.layers_config = list(layers)
@@ -128,6 +129,12 @@ class StandardWorkflow(Workflow):
             self.snapshotter = Snapshotter(self, **snapshot_config)
             # gating (link_decision) happens in _wire_gates below
 
+        # -- plotters (optional; reference StandardWorkflow wired error
+        # curves / confusion / weight tiles from config the same way) ----
+        self.plotters: List[Unit] = []
+        if plot_config:
+            self._build_plotters(plot_config)
+
         # -- control wiring --------------------------------------------------
         # start → repeater → loader → fwds → evaluator → decision → gds
         #   … last gd → repeater (loop); decision → end_point when complete
@@ -149,6 +156,61 @@ class StandardWorkflow(Workflow):
             self.snapshotter.link_from(self.decision)
         self._wire_gates()
 
+    def _build_plotters(self, cfg: Dict[str, Any]) -> None:
+        """Wire the reference's standard plot set from a config dict:
+        {"error_curve": True, "confusion": True, "weights": True} (any
+        subset). Plotters fire once per epoch (gated on the loader's
+        epoch boundary) in granular mode; run_fused drives the same
+        units at its epoch boundaries (note: the confusion matrix is a
+        granular-evaluator product — under run_fused it stays at its
+        initial zeros, since the fused step keeps metrics as scalars)."""
+        from veles_tpu.plotting_units import (AccumulatingPlotter,
+                                              MatrixPlotter, Weights2D)
+        if cfg.get("error_curve"):
+            from veles_tpu.plotter import get_renderer
+            get_renderer().clear_series("epoch_err")   # fresh per build
+            for cls_idx, label in ((1, "validation"), (2, "train")):
+                p = AccumulatingPlotter(self, plot_name="epoch_err",
+                                        label=label,
+                                        name=f"plot_err_{label}")
+                p._metric_class = cls_idx
+                self.plotters.append(p)
+        if cfg.get("confusion") and self.loss == "softmax":
+            p = MatrixPlotter(self, name="plot_confusion")
+            p.link_attrs(self.evaluator, ("input", "confusion_matrix"))
+            # per-epoch VALIDATION confusion (the reference's plot), not
+            # an all-splits all-epochs accumulation: restrict the
+            # evaluator's accumulation and reset it after each render
+            self.evaluator.confusion_split = 1  # VALIDATION
+            self.evaluator.link_attrs(self.loader, "minibatch_class")
+            self.plotters.append(p)
+        if cfg.get("weights") and self.forwards:
+            p = Weights2D(self, name="plot_weights")
+            p.link_attrs(self.forwards[0], ("input", "weights"))
+            self.plotters.append(p)
+        # one driver unit fires the whole set at epoch boundaries in the
+        # granular pulse graph (run_fused calls _fire_plotters directly)
+        driver = Unit(self, name="plot_driver")
+        driver.run = self._fire_plotters  # type: ignore[method-assign]
+        driver.link_from(self.decision)
+        driver.gate_skip = ~self.loader.epoch_ended
+        self._plot_driver = driver
+
+    def _fire_plotters(self) -> None:
+        """Refresh every plotter from current state (epoch boundary)."""
+        for p in self.plotters:
+            cls_idx = getattr(p, "_metric_class", None)
+            if cls_idx is not None:
+                if self.loader.class_lengths[cls_idx] == 0:
+                    continue    # no such split: don't plot a fake curve
+                m = self.decision.epoch_metrics[cls_idx]
+                if m is None:
+                    continue
+                p.input = float(m)
+            p.run()
+        if getattr(self.evaluator, "confusion_split", None) is not None:
+            self.evaluator.reset_metrics()   # next epoch starts fresh
+
     def _wire_gates(self) -> None:
         """(Re)build the derived gate Bools. Called from __init__ AND from
         initialize(): pickle snapshots freeze derived Bools to plain values
@@ -160,6 +222,11 @@ class StandardWorkflow(Workflow):
         # drop it from pickles and need it re-established after restore
         for g, fwd in zip(self.gds, reversed(self.forwards)):
             g.link_forward(fwd)
+        if getattr(self, "_plot_driver", None) is not None:
+            # derived Bool: freezes to a plain value in snapshots like
+            # every other gate — re-derive or restored runs plot never
+            # (frozen True) or per-minibatch (frozen False)
+            self._plot_driver.gate_skip = ~self.loader.epoch_ended
         # skip weight updates on test/validation minibatches; freeze the
         # chain entirely once training completed
         for g in self.gds:
@@ -255,6 +322,15 @@ class StandardWorkflow(Workflow):
                     ev.loss = 0.0
                     ev.n_err = 0
                 dec.run()
+                if getattr(self, "plotters", None) \
+                        and bool(loader.epoch_ended):
+                    # weight plots need the CURRENT fused params in the
+                    # unit Arrays, not the init-time values
+                    if any(type(p).__name__ == "Weights2D"
+                           for p in self.plotters):
+                        step.write_back(state)
+                    self._fire_plotters()   # same per-epoch plot set as
+                    # the granular graph's plot_driver
                 # fused mode bypasses the pulse graph, so the snapshot
                 # gating is applied here by hand: same improved-gated
                 # behavior as granular mode (run_fused's contract)
