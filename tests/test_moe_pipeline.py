@@ -346,3 +346,17 @@ def test_moe_workflow_snapshot_roundtrip(tmp_path):
     wf2.initialize(device=XLADevice())
     wf2.run()
     assert wf2.decision.epoch_number > wf.decision.epoch_number
+
+
+def test_run_pipelined_end_to_end(eight_devices):
+    """run_pipelined drives Loader/Decision bookkeeping over the GPipe
+    step (the CLI --pp path): trains to low error with stage count capped
+    at the unit count."""
+    wf = _build_pp_wf(seed=515)
+    wf.decision.max_epochs = 6
+    wf.run_pipelined(n_microbatches=4)
+    assert wf.decision.epoch_number == 6
+    assert wf.decision.best_validation_err < 12, \
+        wf.decision.best_validation_err
+    # weights were written back from the pipeline state
+    assert wf.forwards[0].weights.mem.std() > 0

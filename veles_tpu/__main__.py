@@ -74,6 +74,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
+    p.add_argument("--pp", type=int, default=None, metavar="MICROBATCHES",
+                   help="train as a GPipe pipeline over the local devices "
+                        "(one stage per device) with this many microbatches")
     p.add_argument("--fused", action="store_true",
                    help="train via the fused one-dispatch-per-minibatch "
                         "XLA step instead of the granular unit graph")
@@ -126,7 +129,7 @@ def main(argv=None) -> int:
         device=device, stats=not args.no_stats,
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
-        fused=args.fused, manhole=args.manhole)
+        fused=args.fused, manhole=args.manhole, pp=args.pp)
     if args.optimize:
         return run_optimize(module, args, device)
     return launcher.run_module(module)

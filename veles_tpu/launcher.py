@@ -34,6 +34,7 @@ class Launcher(Logger):
                  web_status: bool = False, web_port: int = 8090,
                  profile_dir: str = "", debug_nans: bool = False,
                  fused: bool = False, manhole: Optional[int] = None,
+                 pp: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -45,6 +46,18 @@ class Launcher(Logger):
         #: run via the one-dispatch-per-minibatch fused XLA step instead
         #: of the granular unit graph (same Decision/Snapshotter behavior)
         self.fused = fused
+        #: GPipe pipeline mode: microbatch count (stages = local devices)
+        if pp is not None and pp < 1:
+            raise SystemExit(f"--pp needs a microbatch count >= 1 "
+                             f"(got {pp})")
+        if pp and fused:
+            raise SystemExit("--pp and --fused are mutually exclusive "
+                             "execution modes")
+        if pp and (listen or master):
+            raise SystemExit("--pp is single-process (pipeline over the "
+                             "local stage mesh); distributed runs use "
+                             "the fused dp step")
+        self.pp = pp
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -177,6 +190,13 @@ class Launcher(Logger):
                     self.workflow.snapshotter = None
                 self.workflow.run_fused(device=self.device, mesh=mesh,
                                         mode="dp", **kwargs)
+            elif self.pp:
+                if not hasattr(self.workflow, "run_pipelined"):
+                    raise SystemExit(
+                        f"--pp: {type(self.workflow).__name__} has no "
+                        "pipeline step (StandardWorkflow-family only)")
+                self.workflow.run_pipelined(n_microbatches=self.pp,
+                                            device=self.device, **kwargs)
             elif self.fused:
                 if not hasattr(self.workflow, "run_fused"):
                     raise SystemExit(

@@ -167,8 +167,6 @@ class StandardWorkflow(Workflow):
         from veles_tpu.plotting_units import (AccumulatingPlotter,
                                               MatrixPlotter, Weights2D)
         if cfg.get("error_curve"):
-            from veles_tpu.plotter import get_renderer
-            get_renderer().clear_series("epoch_err")   # fresh per build
             for cls_idx, label in ((1, "validation"), (2, "train")):
                 p = AccumulatingPlotter(self, plot_name="epoch_err",
                                         label=label,
@@ -198,6 +196,15 @@ class StandardWorkflow(Workflow):
 
     def _fire_plotters(self) -> None:
         """Refresh every plotter from current state (epoch boundary)."""
+        from veles_tpu.plotting_units import MatrixPlotter
+        if not getattr(self, "_plot_series_cleared", False):
+            # a NEW workflow plotting under names an earlier run used in
+            # this process starts clean (lazy: first fire, so building a
+            # workflow that never runs starts no renderer thread)
+            for p in self.plotters:
+                if hasattr(p, "values"):
+                    p.renderer.clear_series(p.plot_name)
+            self._plot_series_cleared = True
         for p in self.plotters:
             cls_idx = getattr(p, "_metric_class", None)
             if cls_idx is not None:
@@ -207,6 +214,10 @@ class StandardWorkflow(Workflow):
                 if m is None:
                     continue
                 p.input = float(m)
+            if isinstance(p, MatrixPlotter) and p.input is not None \
+                    and p.input and not np.any(p.input.mem):
+                continue    # never accumulated (fused mode): a zeros
+                # heatmap would read as a real (perfect-failure) matrix
             p.run()
         if getattr(self.evaluator, "confusion_split", None) is not None:
             self.evaluator.reset_metrics()   # next epoch starts fresh
@@ -281,13 +292,42 @@ class StandardWorkflow(Workflow):
         the real Loader drives minibatches and the real Decision unit does
         the epoch/stop bookkeeping (so snapshot gating, best-error tracking
         and the `complete` Bool behave exactly as in granular mode)."""
-        from veles_tpu.loader.base import TRAIN
         if epochs is not None:
             self.decision.max_epochs = epochs
         if not self.is_initialized:
             self.initialize(device=device)
         step = self.build_fused_step(mesh=mesh, mode=mode,
                                      compute_dtype=compute_dtype, ep=ep)
+        self._run_with_step(step)
+
+    def run_pipelined(self, mesh=None, n_microbatches: int = 4,
+                      epochs: Optional[int] = None, device=None,
+                      boundaries=None, compute_dtype=None) -> None:
+        """Train as a GPipe pipeline over `mesh`'s "stage" axis (default:
+        one stage per device) with the same Loader/Decision/Snapshotter
+        semantics as run_fused. The CLI exposes this as `--pp M`
+        (M = microbatches)."""
+        if epochs is not None:
+            self.decision.max_epochs = epochs
+        if not self.is_initialized:
+            self.initialize(device=device)
+        if mesh is None:
+            import jax
+
+            from veles_tpu.parallel.pipeline import make_stage_mesh
+            # one stage per device, capped at one UNIT per stage
+            mesh = make_stage_mesh(
+                jax.devices()[:max(1, len(self.forwards))])
+        step = self.build_pipeline_step(mesh, n_microbatches,
+                                        boundaries=boundaries,
+                                        compute_dtype=compute_dtype)
+        self._run_with_step(step)
+
+    def _run_with_step(self, step) -> None:
+        """Drive any train/evaluate/write_back step object through the
+        Loader + Decision bookkeeping (shared by run_fused /
+        run_pipelined)."""
+        from veles_tpu.loader.base import TRAIN
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
         # the fused step uploads (sharded) itself; the loader's granular-path
@@ -326,7 +366,8 @@ class StandardWorkflow(Workflow):
                         and bool(loader.epoch_ended):
                     # weight plots need the CURRENT fused params in the
                     # unit Arrays, not the init-time values
-                    if any(type(p).__name__ == "Weights2D"
+                    from veles_tpu.plotting_units import Weights2D
+                    if any(isinstance(p, Weights2D)
                            for p in self.plotters):
                         step.write_back(state)
                     self._fire_plotters()   # same per-epoch plot set as
