@@ -146,3 +146,35 @@ def test_wine_sample_trains():
     # 40 validation samples / 3 classes: chance ~27 errors
     assert wf.decision.best_validation_err < 15, \
         wf.decision.best_validation_err
+
+
+def test_log_file_sink(tmp_path):
+    """--log-file duplicates veles logging to a DEBUG-detail file while
+    the console keeps its own verbosity (reference Logger file sink)."""
+    import logging
+
+    from veles_tpu.logger import (Logger, add_log_file, remove_log_file,
+                                  setup_logging)
+    prev_level = logging.getLogger("veles").level
+    setup_logging(logging.WARNING)
+    path = tmp_path / "run.log"
+    handler = add_log_file(str(path))
+    try:
+        class Thing(Logger):
+            name = "thing"
+
+        t = Thing()
+        t.debug("debug detail %d", 42)
+        t.warning("warn %s", "msg")
+        for h in logging.getLogger("veles").handlers:
+            h.flush()
+        text = path.read_text()
+        assert "debug detail 42" in text
+        assert "warn msg" in text
+        # console verbosity stays independently adjustable
+        from veles_tpu.logger import set_verbosity
+        set_verbosity(2)
+        assert logging.getLogger("veles").level == logging.DEBUG
+    finally:
+        remove_log_file(handler)
+        logging.getLogger("veles").setLevel(prev_level)

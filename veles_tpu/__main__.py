@@ -20,7 +20,7 @@ import sys
 
 from veles_tpu import prng
 from veles_tpu.launcher import Launcher, apply_overrides
-from veles_tpu.logger import set_verbosity
+from veles_tpu.logger import add_log_file, set_verbosity
 
 
 def _import_file(path: str, name: str):
@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="total process count in the distributed job")
     p.add_argument("-v", "--verbose", action="count", default=0,
                    help="-v info, -vv debug")
+    p.add_argument("--log-file", default="", metavar="PATH",
+                   help="also write DEBUG-level logs to this file")
     p.add_argument("--no-stats", action="store_true",
                    help="skip the per-unit run-time table")
     p.add_argument("-w", "--web-status", action="store_true",
@@ -96,6 +98,8 @@ def main(argv=None) -> int:
         args.overrides.insert(0, args.config)
         args.config = ""
     set_verbosity(args.verbose)
+    if args.log_file:
+        add_log_file(args.log_file)
     if args.random_seed is not None:
         prng.seed_all(args.random_seed)
 

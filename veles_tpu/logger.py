@@ -12,28 +12,70 @@ import sys
 from typing import Optional
 
 _initialized = False
+_console_handler: Optional[logging.Handler] = None
+_file_handlers: list = []
 
 
-def setup_logging(level: int = logging.INFO, stream=None) -> None:
-    """Install the root handler once; safe to call repeatedly."""
-    global _initialized
+def _sync_logger_level() -> None:
+    """The logger passes the UNION of what any sink wants; each handler
+    filters at its own level — so console verbosity and file detail are
+    independent knobs that cannot corrupt each other."""
+    handlers = ([_console_handler] if _console_handler else []) \
+        + _file_handlers
+    if handlers:
+        logging.getLogger("veles").setLevel(min(h.level for h in handlers))
+
+
+def setup_logging(level: Optional[int] = None, stream=None) -> None:
+    """Install the console handler once; safe to call repeatedly. `level`
+    None means "don't change an already-configured console level" (first
+    call defaults to INFO)."""
+    global _initialized, _console_handler
     if _initialized:
-        logging.getLogger("veles").setLevel(level)
+        if level is not None:
+            _console_handler.setLevel(level)
+            _sync_logger_level()
         return
-    handler = logging.StreamHandler(stream or sys.stderr)
-    handler.setFormatter(logging.Formatter(
+    level = logging.INFO if level is None else level
+    _console_handler = logging.StreamHandler(stream or sys.stderr)
+    _console_handler.setFormatter(logging.Formatter(
         "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"))
+    _console_handler.setLevel(level)
     log = logging.getLogger("veles")
-    log.addHandler(handler)
-    log.setLevel(level)
+    log.addHandler(_console_handler)
     log.propagate = False
     _initialized = True
+    _sync_logger_level()
 
 
 def set_verbosity(count: int) -> None:
     """CLI -v mapping: 0 -> warning, 1 -> info, 2+ -> debug."""
     level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(count, 2)]
     setup_logging(level)
+
+
+def add_log_file(path: str, level: int = logging.DEBUG) -> logging.Handler:
+    """Duplicate all "veles" logging to a file (reference parity: the
+    Logger supported file sinks, SURVEY.md §2.1). The file gets DEBUG
+    detail regardless of (and independent from) the console verbosity.
+    Returns the handler so callers/tests can remove_log_file it."""
+    setup_logging()
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s: %(message)s"))
+    handler.setLevel(level)
+    logging.getLogger("veles").addHandler(handler)
+    _file_handlers.append(handler)
+    _sync_logger_level()
+    return handler
+
+
+def remove_log_file(handler: logging.Handler) -> None:
+    if handler in _file_handlers:
+        _file_handlers.remove(handler)
+    logging.getLogger("veles").removeHandler(handler)
+    handler.close()
+    _sync_logger_level()
 
 
 class Logger:
