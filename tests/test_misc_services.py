@@ -177,4 +177,5 @@ def test_log_file_sink(tmp_path):
         assert logging.getLogger("veles").level == logging.DEBUG
     finally:
         remove_log_file(handler)
+        setup_logging(prev_level)   # restores console handler level too
         logging.getLogger("veles").setLevel(prev_level)

@@ -223,3 +223,14 @@ def test_snapshotter_latest(tmp_path):
     latest = Snapshotter.latest(str(tmp_path))
     assert latest == wf.snapshotter.destination
     assert Snapshotter.latest(str(tmp_path / "nope")) is None
+
+
+def test_snapshotter_latest_ignores_inflight_tmp(tmp_path):
+    """A crash mid-export leaves a truncated .tmp with the newest mtime;
+    latest() must never hand it to the resume path."""
+    import time as _time
+    good = tmp_path / "wf_0.10.pickle.gz"
+    good.write_bytes(b"x" * 10)
+    _time.sleep(0.01)
+    (tmp_path / "wf_0.05.pickle.gz.tmp").write_bytes(b"trunc")
+    assert Snapshotter.latest(str(tmp_path)) == str(good)

@@ -147,3 +147,33 @@ def test_granular_paths_work_after_seq_trace(eight_devices):
     out = embed._apply(params, x)
     assert np.isfinite(np.asarray(out)).all()
     embed.numpy_run()
+
+
+def test_seq_mode_pad_mask_drops_samples(eight_devices):
+    """The loader pad mask composes with sequence parallelism: zero-weight
+    SAMPLES drop out of the seq-sharded metrics exactly as in local mode
+    (weights stay per-sample while labels shard over (data, seq))."""
+    wf_l = fresh_wf("local")
+    step_l = wf_l.build_fused_step()
+    sl = step_l.init_state()
+    wf_s = fresh_wf("ring")
+    mesh = make_mesh(jax.devices()[:8], seq=4)
+    step_s = wf_s.build_fused_step(mesh, mode="seq")
+    ss = step_s.init_state()
+
+    (x, y), = batches(wf_l, k=1)
+    n = x.shape[0]
+    w = (np.arange(n) < n - 3).astype(np.float32)   # 3 padded samples
+
+    loss_l, err_l = step_l.evaluate(sl, x, y, w)
+    loss_s, err_s = step_s.evaluate(ss, x, y, w)
+    np.testing.assert_allclose(float(loss_l), float(loss_s),
+                               rtol=2e-5, atol=1e-6)
+    assert int(err_l) == int(err_s)
+
+    # golden: evaluating only the real rows at their natural size
+    loss_g, err_g = step_l.evaluate(sl, x[:n - 3],
+                                    y.reshape(n, -1)[:n - 3].reshape(-1))
+    np.testing.assert_allclose(float(loss_l), float(loss_g),
+                               rtol=2e-5, atol=1e-6)
+    assert int(err_l) == int(err_g)

@@ -1,0 +1,78 @@
+"""Layer-family ablation: wall-clock attribution for the AlexNet step.
+
+Usage (on a machine with the TPU visible):
+    python tools/ablate.py full no-LRN no-dropout no-bigFC
+
+Each variant builds the AlexNet fused train step with a layer family
+removed and reports samples/s via train_repeat — the deltas attribute
+step time to layer families (the measurement behind ROOFLINE.md).
+Do NOT enable the persistent compilation cache here (hangs on the axon
+backend — see the r3 session notes)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+BATCH = 512
+K = 8
+
+
+def measure(layers, name: str) -> float:
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    prng.seed_all(1)
+    loader = SyntheticClassifierLoader(
+        n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
+        n_train=128, minibatch_size=BATCH, noise=0.5)
+    wf = StandardWorkflow(
+        layers=layers, loader=loader, loss="softmax", n_classes=64,
+        decision_config={"max_epochs": 1, "fail_iterations": 9},
+        gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
+        name=name)
+    wf.initialize(device=None)
+    step = wf.build_fused_step(compute_dtype="bfloat16")
+    state = step.init_state()
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randn(BATCH, 227, 227, 3).astype(np.float32))
+    y = jax.device_put(rng.randint(0, 64, BATCH))
+    state, _ = step.train_repeat(state, x, y, K)       # compile + warm
+    np.asarray(state["params"][-1]["bias"][:1])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, _ = step.train_repeat(state, x, y, K)
+        np.asarray(state["params"][-1]["bias"][:1])
+        best = min(best, time.perf_counter() - t0)
+    rate = BATCH * K / best
+    print(f"ABLATE {name}: {rate:.0f} samples/s", flush=True)
+    return rate
+
+
+def variant(name: str):
+    from veles_tpu.samples.alexnet import alexnet_layers
+    full = alexnet_layers(64, 1.0, 4096)
+    if name == "full":
+        return full
+    if name == "no-LRN":
+        return [l for l in full if l["type"] not in ("lrn", "norm")]
+    if name == "no-dropout":
+        return [l for l in full if l["type"] != "dropout"]
+    if name == "no-bigFC":
+        return [l for l in full
+                if not l["type"].startswith("all2all")
+                and l["type"] != "softmax"] + [
+            {"type": "softmax", "output_sample_shape": 64,
+             "weights_stddev": 0.01}]
+    raise SystemExit(f"unknown variant {name}")
+
+
+if __name__ == "__main__":
+    for v in (sys.argv[1:] or ["full"]):
+        measure(variant(v), v)

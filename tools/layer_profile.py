@@ -1,0 +1,57 @@
+"""Per-LAYER wall-time profile of AlexNet on the device, using the
+granular unit graph's built-in per-unit timing table (the reference's
+profiler) with a device sync after every unit so times are attributable.
+
+Usage: python tools/layer_profile.py [batch] [steps]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def main(batch: int = 256, steps: int = 10) -> None:
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.loader.base import TRAIN
+    from veles_tpu.samples.alexnet import create_workflow
+
+    prng.seed_all(1)
+    wf = create_workflow(minibatch_size=batch, n_train=2 * batch,
+                         n_validation=batch)
+    wf.initialize(device=None)
+
+    # drive forward+backward by hand with a sync after every unit so the
+    # per-unit table (workflow.print_stats) attributes device time to the
+    # unit that queued it
+    import time as _t
+
+    def timed(u):
+        t0 = _t.perf_counter()
+        u.run()
+        out = getattr(u, "output", None) or getattr(u, "err_input", None)
+        if out and u.device is not None:
+            jax.block_until_ready(out.devmem(u.device))
+        u.run_time += _t.perf_counter() - t0
+        u.run_count += 1
+
+    ld = wf.loader
+    done = 0
+    while done < steps:
+        ld.run()
+        if ld.minibatch_class != TRAIN:
+            continue
+        for u in wf.forwards:
+            timed(u)
+        timed(wf.evaluator)
+        for g in wf.gds:
+            timed(g)
+        done += 1
+    print(wf.print_stats())
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:3]))

@@ -153,8 +153,11 @@ class Snapshotter(SnapshotterBase):
         recovery, SURVEY.md §5.3: the SPMD fault model is resume, not
         mid-step elasticity)."""
         try:
+            # exclude in-flight ".tmp" files: a crash mid-export leaves a
+            # truncated newest-mtime .tmp that would poison the resume
             names = [n for n in os.listdir(directory)
-                     if ".pickle" in n and n.startswith(prefix)]
+                     if ".pickle" in n and n.startswith(prefix)
+                     and not n.endswith(".tmp")]
         except FileNotFoundError:
             return None
         if not names:
