@@ -26,8 +26,16 @@ from veles_tpu.znicz.nn_units import (Forward, GradientDescentVJP,
 
 
 class MoELayer(Forward):
-    """Top-1 (switch) MoE FFN: x (N, D) -> (N, D). Params: router wr
-    (D, E), expert FFNs w1 (E, D, H), b1, w2 (E, H, D), b2."""
+    """Top-1 (switch) MoE FFN. Params: router wr (D, E), expert FFNs
+    w1 (E, D, H), b1, w2 (E, H, D), b2.
+
+    Input forms (`route` selects; D is always the routing feature dim):
+    - (N, D) classifier features — each SAMPLE is a routing token;
+    - (N, S, D) sequence activations (transformer stacks) — each TOKEN
+      routes independently (the standard MoE-transformer block; output
+      keeps the (N, S, D) shape, optionally residual).
+    route="auto" treats 3-D input as a token sequence; pass "sample" to
+    flatten 3-D samples (e.g. images) to one routing row per sample."""
 
     #: params sharded on their leading (expert) dim when the fused step
     #: runs expert-parallel; the router wr stays replicated (every shard
@@ -36,11 +44,18 @@ class MoELayer(Forward):
 
     def __init__(self, workflow=None, n_experts: int = 4,
                  hidden: int = 64, capacity_factor: float = 2.0,
+                 residual: bool = False, route: str = "auto",
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
+        assert route in ("auto", "token", "sample"), route
         self.n_experts = n_experts
         self.hidden = hidden
         self.capacity_factor = capacity_factor
+        #: y = x + moe(x) — the transformer-block form (tokens the
+        #: capacity dropped keep their residual value instead of zero)
+        self.residual = residual
+        #: "auto" | "token" | "sample" — see class docstring
+        self.route = route
         #: mesh axis name the expert dim is sharded over; set by
         #: FusedTrainStep(ep=True) at trace time so fused_apply runs the
         #: all_to_all expert exchange instead of the dense local form.
@@ -63,9 +78,20 @@ class MoELayer(Forward):
     def initialize(self, device=None, **kwargs: Any):
         if not self.input:
             return False
-        n = self.input.shape[0]
-        d = int(np.prod(self.input.shape[1:]))
+        shape = self.input.shape
+        # (N, S, D) sequence input: the token feature dim routes;
+        # (N, ...) classifier input: the flattened sample routes
+        token_wise = self._token_wise(len(shape))
+        d = (int(shape[-1]) if token_wise
+             else int(np.prod(shape[1:])))
+        out_shape = (tuple(shape) if token_wise else (shape[0], d))
         e, h = self.n_experts, self.hidden
+        if self.wr and self.wr.shape[0] != d:
+            raise ValueError(
+                f"{self.name}: router expects feature dim "
+                f"{self.wr.shape[0]} but input routes dim {d} — a "
+                "restored snapshot trained under a different `route` "
+                f"mode? (route={self.route!r}, input {tuple(shape)})")
         if not self.wr:
             std = self.weights_stddev or self.default_stddev(d)
             self.wr.reset(self._fill((d, e), self.weights_filling, std))
@@ -75,12 +101,29 @@ class MoELayer(Forward):
                                      self.weights_stddev
                                      or self.default_stddev(h)))
             self.b2.reset(np.zeros((e, d), np.float32))
-        if not self.output or self.output.shape != (n, d):
-            self.output.reset(np.zeros((n, d), np.float32))
+        if not self.output or self.output.shape != out_shape:
+            self.output.reset(np.zeros(out_shape, np.float32))
         return super().initialize(device=device, **kwargs)
 
+    def _token_wise(self, ndim: int) -> bool:
+        if self.route == "token":
+            return True
+        if self.route == "sample":
+            return False
+        return ndim == 3      # auto: 3-D activations are token sequences
+
     def _apply(self, params, x, axis_name=None):
+        if self._token_wise(x.ndim):   # (N, S, D): route per TOKEN
+            n, s, d = x.shape
+            y = self._apply_tokens(params, x.reshape(n * s, d),
+                                   axis_name)
+            y = y.reshape(n, s, d)
+            return x + y if self.residual else y
         x2 = x.reshape(x.shape[0], -1)
+        y = self._apply_tokens(params, x2, axis_name)
+        return x2 + y if self.residual else y
+
+    def _apply_tokens(self, params, x2, axis_name):
         if axis_name is not None:
             # inside shard_map: x2.shape[0] is the per-shard token count.
             # When capacity_factor·n_loc/n_experts divides exactly, the
