@@ -52,17 +52,13 @@ def test_granular_transformer_trains():
         (wf.decision.best_validation_err, chance)
 
 
-@pytest.mark.parametrize("parallel_mode", ["ring", "ulysses"])
-def test_seq_parallel_training_matches_local(parallel_mode,
-                                             eight_devices):
-    """Fused "seq" training over a data(2) x seq(4) mesh reproduces the
-    local-mode loss trajectory AND final params (ring/Ulysses attention
-    are exact, the distributed CE mean is the global mean, and the
-    grad psum is the transpose of the replicated-param broadcast)."""
+def assert_seq_matches_local(parallel_mode, devices, loss_rtol=2e-5):
+    """Shared harness: train local vs seq-sharded on identical batches,
+    assert per-step losses/err AND final params agree."""
     wf_l = fresh_wf("local")
     steps_l = wf_l.build_fused_step()
     wf_s = fresh_wf(parallel_mode)
-    mesh = make_mesh(eight_devices, seq=4)
+    mesh = make_mesh(devices, seq=4)
     steps_s = wf_s.build_fused_step(mesh=mesh, mode="seq")
     # identical initial params (same seed), identical batches
     bs = batches(wf_l)
@@ -72,13 +68,23 @@ def test_seq_parallel_training_matches_local(parallel_mode,
         sl, (loss_l, err_l) = steps_l.train(sl, x, y)
         ss, (loss_s, err_s) = steps_s.train(ss, x, y)
         np.testing.assert_allclose(float(loss_l), float(loss_s),
-                                   rtol=2e-5, atol=1e-6)
+                                   rtol=loss_rtol, atol=1e-6)
         assert int(err_l) == int(err_s)
     for pl, ps in zip(sl["params"], ss["params"]):
         for k in pl:
             np.testing.assert_allclose(np.asarray(pl[k]),
                                        np.asarray(ps[k]),
                                        rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("parallel_mode", ["ring", "ulysses"])
+def test_seq_parallel_training_matches_local(parallel_mode,
+                                             eight_devices):
+    """Fused "seq" training over a data(2) x seq(4) mesh reproduces the
+    local-mode loss trajectory AND final params (ring/Ulysses attention
+    are exact, the distributed CE mean is the global mean, and the
+    grad psum is the transpose of the replicated-param broadcast)."""
+    assert_seq_matches_local(parallel_mode, eight_devices)
 
 
 def test_seq_parallel_evaluate_matches_local(eight_devices):
@@ -177,3 +183,20 @@ def test_seq_mode_pad_mask_drops_samples(eight_devices):
     np.testing.assert_allclose(float(loss_l), float(loss_g),
                                rtol=2e-5, atol=1e-6)
     assert int(err_l) == int(err_g)
+
+
+def test_moe_transformer_seq_parallel_matches_local(eight_devices):
+    """SP x MoE composition: the char-transformer with a token-routed MoE
+    FFN trains under the seq-sharded step and matches local-mode losses
+    AND final params (per-token routing is shard-local under the seq
+    axis — identical to global routing at the zero-drop capacity)."""
+    from veles_tpu.config import root
+    prev = root.char_transformer.moe_experts
+    prev_cf = root.char_transformer.moe_capacity_factor
+    root.char_transformer.moe_experts = 4
+    root.char_transformer.moe_capacity_factor = 4.0   # zero drops
+    try:
+        assert_seq_matches_local("ring", eight_devices, loss_rtol=2e-4)
+    finally:
+        root.char_transformer.moe_experts = prev
+        root.char_transformer.moe_capacity_factor = prev_cf

@@ -28,6 +28,14 @@ root.char_transformer.embed = 64
 root.char_transformer.n_heads = 4
 root.char_transformer.ffn = 128
 root.char_transformer.parallel_mode = "local"  # | "ring" | "ulysses"
+#: 0 = dense SeqFFN; N = replace it with an N-expert token-routed MoE
+#: (composes with parallel_mode: per-token routing is shard-local under
+#: the seq axis, identical to global routing at ample capacity)
+root.char_transformer.moe_experts = 0
+#: per-expert slot budget (capacity = factor x tokens / experts). 2.0 is
+#: the standard conditional-compute setting; raise to n_experts for
+#: zero-drop exact-equivalence runs (the SP x MoE test does)
+root.char_transformer.moe_capacity_factor = 2.0
 root.char_transformer.decision.max_epochs = 5
 root.char_transformer.decision.fail_iterations = 20
 root.char_transformer.gd.learning_rate = 0.2
@@ -45,6 +53,15 @@ def create_workflow(text: str = None) -> CharTransformerWorkflow:
         n_validation=cfg.loader.n_validation,
         minibatch_size=cfg.loader.minibatch_size)
     e = cfg.embed
+    if cfg.moe_experts:
+        from veles_tpu.znicz import moe  # noqa: F401 (registers "moe")
+        ffn = {"type": "moe", "n_experts": cfg.moe_experts,
+               "hidden": cfg.ffn, "residual": True,
+               "capacity_factor": float(cfg.moe_capacity_factor),
+               "weights_stddev": 0.05}
+    else:
+        ffn = {"type": "seq_ffn", "hidden": cfg.ffn,
+               "activation": "tanh", "weights_stddev": 0.05}
     return CharTransformerWorkflow(
         layers=[
             {"type": "seq_linear", "output_features": e,
@@ -52,8 +69,7 @@ def create_workflow(text: str = None) -> CharTransformerWorkflow:
             {"type": "attention", "n_heads": cfg.n_heads, "causal": True,
              "residual": True, "parallel_mode": cfg.parallel_mode,
              "weights_stddev": 0.05},
-            {"type": "seq_ffn", "hidden": cfg.ffn, "activation": "tanh",
-             "weights_stddev": 0.05},
+            ffn,
             {"type": "seq_softmax", "output_features": loader.n_vocab,
              "weights_stddev": 0.05},
         ],
