@@ -13,6 +13,15 @@ Semantics change (documented, SURVEY.md §7 "hard parts"): the reference's
 updates were asynchronous/stale; this build is synchronous SPMD. Slave
 drop/rejoin becomes "restart the job from the last snapshot" — mid-step
 elasticity is meaningless when every step is a collective.
+
+Data-plane convention (single-controller emulation): every process's
+Loader materializes the same global minibatch (same seeds -> same
+schedule), and jit's `in_shardings`/shard_map specs make each process
+DEVICE-TRANSFER only the rows its addressable shards own — so the
+ICI/DCN data plane carries no duplicate rows; only host-side decode is
+replicated. (The reference shipped full weight payloads per slave per
+step over TCP — strictly more traffic than this scheme's zero weight
+motion + per-shard batch rows.)
 """
 
 from __future__ import annotations

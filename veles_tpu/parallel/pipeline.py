@@ -71,9 +71,11 @@ def pipeline_apply(stage_fn: Callable, params, xs, axis_name: str = STAGE_AXIS):
         return (act_next, outputs), None
 
     # the scan carry mixes with device-varying values (idx, params), so
-    # it must start varying over the stage axis (shard_map vma typing)
-    act0 = lax.pvary(jnp.zeros((mb, d), xs.dtype), (axis_name,))
-    out0 = lax.pvary(jnp.zeros_like(xs), (axis_name,))
+    # it must start varying over the stage axis (shard_map vma typing;
+    # pcast is the non-deprecated spelling of pvary)
+    act0 = lax.pcast(jnp.zeros((mb, d), xs.dtype), (axis_name,),
+                     to="varying")
+    out0 = lax.pcast(jnp.zeros_like(xs), (axis_name,), to="varying")
     (act, outputs), _ = lax.scan(tick, (act0, out0),
                                  jnp.arange(ticks))
     # broadcast the last stage's outputs to every device (simple v1
