@@ -204,6 +204,16 @@ def _error_record(err: str, attempt: int, provisional: bool = False):
            "vs_baseline": None, "error": err[:500], "attempts": attempt}
     if provisional:
         rec["provisional"] = True
+    # the tunnel can die between in-session measurement and the driver's
+    # capture run (it did in r3): attach the committed same-harness
+    # measurements so a dead tunnel still leaves machine-readable
+    # evidence of what the chip did earlier
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "MEASURED.json")) as f:
+            rec["last_measured"] = json.load(f)
+    except (OSError, ValueError):
+        pass
     return rec
 
 
