@@ -1,0 +1,107 @@
+"""Sharded checkpointing of fused training state via Orbax.
+
+The Snapshotter's whole-workflow pickle (reference parity, SURVEY.md
+§5.4) gathers every array to host process 0 — right for the reference's
+scale, wrong past it. This is the at-scale companion (the SURVEY §7
+"orbax for arrays" slot): the fused step's state pytree (params,
+velocities, PRNG key, lr scale) saves and restores WITH its shardings —
+each host writes/reads only its addressable shards, so TP/EP-partitioned
+states never materialize on one host. The workflow pickle still carries
+topology/config; `save_state`/`restore_state` carry the tensors.
+
+Restore targets come from the step itself (`init_state` under
+eval_shape), so a state saved from a dp/gspmd/ep step restores into a
+freshly built step of the same geometry without running a real init on
+device.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def _unwrap_key(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Typed PRNG key arrays are an extended dtype Orbax cannot
+    serialize; carry the raw uint32 key data instead."""
+    out = dict(state)
+    if "key" in out:
+        out["key"] = jax.random.key_data(out["key"])
+    return out
+
+
+def save_state(state: Dict[str, Any], directory: str) -> str:
+    """Write the state pytree (sharded jax arrays) to `directory`/state.
+    Every process participates (multi-host safe); returns the path."""
+    path = os.path.join(os.path.abspath(directory), "state")
+    ckptr = _checkpointer()
+    ckptr.save(path, _unwrap_key(state), force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def _abstract_state(step) -> Dict[str, Any]:
+    """ShapeDtypeStructs of the step's state (key carried as raw uint32
+    data), built from the units' HOST-side shapes: no device allocation,
+    no PRNG draw — a restore target for states too big to double-buffer."""
+    import jax.numpy as jnp
+    params = tuple(
+        {k: jax.ShapeDtypeStruct(a.shape, a.mem.dtype)
+         for k, a in u.param_arrays().items()}
+        for u in step.forwards)
+    key_shape = jax.eval_shape(
+        lambda: jax.random.key_data(jax.random.PRNGKey(0)))
+    return {"params": params, "vel": params,
+            "key": jax.ShapeDtypeStruct(key_shape.shape, key_shape.dtype),
+            "lr_scale": jax.ShapeDtypeStruct((), jnp.float32)}
+
+
+def restore_state(step, directory: str) -> Dict[str, Any]:
+    """Restore a state pytree saved by `save_state` into the shardings
+    of `step` (a FusedTrainStep-compatible object). The abstract target
+    is built from host-side shapes + the step's own sharding plan, so
+    nothing is allocated on device before Orbax streams the shards in,
+    and the global PRNG stream is untouched (reproducible resume)."""
+    path = os.path.join(os.path.abspath(directory), "state")
+    template = _abstract_state(step)
+    shardings = _target_shardings(step, template)
+    target = jax.tree_util.tree_map(
+        lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+        template, shardings)
+    ckptr = _checkpointer()
+    state = ckptr.restore(path, target)
+    state["key"] = jax.random.wrap_key_data(state["key"])
+    return state
+
+
+def _target_shardings(step, template):
+    """Per-leaf restore shardings from the step's OWN plan: gspmd states
+    use the named-sharding tree (megatron col/row specs), shard_map
+    modes (dp/seq) use the spec tree — replicated leaves span the WHOLE
+    mesh (a single-device leaf would collide with the mesh computation)
+    and EP expert tensors land pre-partitioned over the data axis."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    mesh = getattr(step, "mesh", None)
+    mode = getattr(step, "mode", None)
+    if mesh is None:
+        from jax.sharding import SingleDeviceSharding
+        sh = SingleDeviceSharding(jax.devices()[0])   # local-mode step
+        return jax.tree_util.tree_map(lambda a: sh, template)
+    if mode == "gspmd":
+        return step._state_shardings()
+    if mode == "dp":
+        specs = step._smap_state_spec()
+    else:
+        specs = jax.tree_util.tree_map(lambda _: P(), template)
+    return jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs,
+        is_leaf=lambda x: isinstance(x, P))
