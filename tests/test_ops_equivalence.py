@@ -299,3 +299,31 @@ def test_adam_decreases_quadratic():
         g = jax.grad(loss)(params)
         params, state = adam_update(params, g, state, cfg)
     assert float(loss(params)) < 0.5
+
+
+def test_conv_space_to_depth_exact():
+    """The s2d rewrite of a strided conv equals the direct lowering for
+    the AlexNet stem geometry (227x227x3, 11x11/4) and assorted others."""
+    import jax.numpy as jnp
+
+    from veles_tpu.ops import xla as ox
+    rng = np.random.RandomState(0)
+    cases = [
+        ((2, 227, 227, 3), (11, 11, 3, 8), 4, (0, 0)),   # AlexNet stem
+        ((2, 32, 32, 3), (7, 7, 3, 4), 2, (0, 0)),
+        ((1, 29, 29, 2), (5, 5, 2, 6), 3, (2, 2)),       # with padding
+        ((2, 16, 16, 4), (4, 4, 4, 8), 4, (0, 0)),       # kernel == b
+    ]
+    for xshape, wshape, s, pad in cases:
+        x = rng.randn(*xshape).astype(np.float32)
+        w = rng.randn(*wshape).astype(np.float32) * 0.1
+        b = rng.randn(wshape[-1]).astype(np.float32)
+        gold = np.asarray(ox.conv2d_forward(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+            stride=(s, s), padding=pad))
+        got = np.asarray(ox.conv2d_forward(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+            stride=(s, s), padding=pad, s2d=True))
+        assert got.shape == gold.shape, (xshape, got.shape, gold.shape)
+        np.testing.assert_allclose(got, gold, rtol=1e-5, atol=1e-5,
+                                   err_msg=str((xshape, wshape, s, pad)))

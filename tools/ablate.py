@@ -64,6 +64,14 @@ def variant(name: str):
         return [l for l in full if l["type"] not in ("lrn", "norm")]
     if name == "no-dropout":
         return [l for l in full if l["type"] != "dropout"]
+    if name == "s2d-stem":
+        # A/B the space-to-depth entry-conv rewrite (exact numerics;
+        # flip the Conv default if this wins on the chip)
+        out = [dict(l) for l in full]
+        for l in out:
+            if l["type"].startswith("conv"):
+                l["s2d"] = "auto"
+        return out
     if name == "no-bigFC":
         return [l for l in full
                 if not l["type"].startswith("all2all")

@@ -96,12 +96,58 @@ def all2all_softmax_forward(x, w, b):
 
 def conv2d_forward(x, w, b, stride: Tuple[int, int] = (1, 1),
                    padding: Tuple[int, int] = (0, 0),
-                   activation: str = "linear"):
+                   activation: str = "linear", s2d: bool = False):
     ph, pw = padding
-    y = lax.conv_general_dilated(
-        x, w, window_strides=stride, padding=[(ph, ph), (pw, pw)],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if s2d and stride[0] == stride[1] and stride[0] > 1:
+        y = conv2d_space_to_depth(x, w, stride[0], (ph, pw))
+    else:
+        y = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=[(ph, ph), (pw, pw)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
     return act_forward(activation, y + b)
+
+
+def conv2d_space_to_depth(x, w, b_: int, padding: Tuple[int, int]):
+    """EXACT rewrite of a stride-b conv as a stride-1 conv on a
+    space-to-depth-packed input — the classic TPU entry-conv trick for
+    thin-channel inputs (AlexNet/ResNet stems: cin=3 fills 3/128 of an
+    MXU tile; packing b×b stride blocks into channels yields cin·b² and
+    a b×-smaller spatial extent, so the systolic array runs full tiles).
+
+    Equivalence: pad H/W and the kernel up to multiples of b with zeros
+    (zero taps read anything, contribute nothing), rearrange both input
+    and kernel into (H/b, W/b, C·b²) blocks, convolve stride 1. Output
+    matches lax.conv_general_dilated bit-for-math on the same dtype.
+    """
+    n, h, wdt, c = x.shape
+    kh, kw, _, co = w.shape
+    ph, pw = padding
+    if ph or pw:
+        x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        h, wdt = h + 2 * ph, wdt + 2 * pw
+    # valid output extent of the ORIGINAL conv
+    oh = (h - kh) // b_ + 1
+    ow = (wdt - kw) // b_ + 1
+    # pad kernel to multiples of b (zero taps), input so every tap exists
+    kh2 = -(-kh // b_) * b_
+    kw2 = -(-kw // b_) * b_
+    need_h = (oh - 1) * b_ + kh2
+    need_w = (ow - 1) * b_ + kw2
+    x = jnp.pad(x, ((0, 0), (0, max(0, need_h - h)),
+                    (0, max(0, need_w - wdt)), (0, 0)))
+    w = jnp.pad(w, ((0, kh2 - kh), (0, kw2 - kw), (0, 0), (0, 0)))
+    hb, wb = need_h // b_, need_w // b_
+    # space-to-depth: (N, Hb, b, Wb, b, C) -> (N, Hb, Wb, b*b*C)
+    xs = x[:, :hb * b_, :wb * b_, :].reshape(n, hb, b_, wb, b_, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, hb, wb, b_ * b_ * c)
+    # kernel: (kh2, kw2, C, O) -> (kh2/b, b, kw2/b, b, C, O) ->
+    # (kh2/b, kw2/b, b*b*C, O), matching the input channel packing
+    ws = w.reshape(kh2 // b_, b_, kw2 // b_, b_, c, co)
+    ws = ws.transpose(0, 2, 1, 3, 4, 5).reshape(kh2 // b_, kw2 // b_,
+                                                b_ * b_ * c, co)
+    return lax.conv_general_dilated(
+        xs, ws, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def deconv2d_forward(x, w, stride: Tuple[int, int] = (1, 1),

@@ -24,9 +24,17 @@ import jax
 import numpy as np
 
 
+_CKPTR = None
+
+
 def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+    """One cached AsyncCheckpointer per process: constructing one per
+    call leaks its background thread/barrier resources over long runs."""
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
 
 
 def _unwrap_key(state: Dict[str, Any]) -> Dict[str, Any]:
@@ -58,7 +66,7 @@ def _abstract_state(step) -> Dict[str, Any]:
          for k, a in u.param_arrays().items()}
         for u in step.forwards)
     key_shape = jax.eval_shape(
-        lambda: jax.random.key_data(jax.random.PRNGKey(0)))
+        lambda: jax.random.key_data(jax.random.key(0)))
     return {"params": params, "vel": params,
             "key": jax.ShapeDtypeStruct(key_shape.shape, key_shape.dtype),
             "lr_scale": jax.ShapeDtypeStruct((), jnp.float32)}

@@ -31,6 +31,7 @@ class Conv(Forward):
                  kx: int = 3, ky: int = 3,
                  stride: Tuple[int, int] = (1, 1),
                  padding: Tuple[int, int] = (0, 0),
+                 s2d: str = "off",
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.n_kernels = n_kernels
@@ -38,6 +39,24 @@ class Conv(Forward):
         self.ky = ky
         self.stride = tuple(stride)
         self.padding = tuple(padding)
+        #: space-to-depth rewrite for thin-channel strided stems
+        #: (ops.xla.conv2d_space_to_depth — exact, MXU-tile-friendly):
+        #: "auto" = on when stride is square >1 and cin < 8; "on"/"off"
+        #: force. Numerics identical either way (equivalence-tested).
+        #: DEFAULT off until measured on the chip (tools/ablate.py s2d
+        #: variant) — the r3 tunnel died before the A/B could run.
+        if s2d not in ("off", "on", "auto"):
+            raise ValueError(f"s2d must be 'off'|'on'|'auto', got {s2d!r}")
+        self.s2d = s2d
+
+    def _use_s2d(self, cin: int) -> bool:
+        if self.s2d == "off":
+            return False
+        sy, sx = self.stride
+        square = sy == sx and sy > 1
+        if self.s2d == "on":
+            return square
+        return square and cin < 8
 
     def output_hw(self) -> Tuple[int, int]:
         _, h, w, _ = self.input.shape
@@ -61,12 +80,15 @@ class Conv(Forward):
     def xla_init(self):
         self._fn = self.jit(partial(
             ox.conv2d_forward, stride=self.stride, padding=self.padding,
-            activation=self.activation))
+            activation=self.activation,
+            s2d=self._use_s2d(self.input.shape[-1])))
         return None
 
     def fused_apply(self, params, x, *, key=None, train=True):
         return ox.conv2d_forward(x, params["weights"], params["bias"],
-                                 self.stride, self.padding, self.activation)
+                                 self.stride, self.padding,
+                                 self.activation,
+                                 s2d=self._use_s2d(x.shape[-1]))
 
     def numpy_run(self) -> None:
         self.output.mem = ref.conv2d_forward(
