@@ -1,0 +1,152 @@
+"""In-process HTTP inference serving for trained workflows.
+
+Parity: the reference's Python serving story (SURVEY.md §3.4 "REST-ish
+serving inside Python: run forward sub-graph per request") — the C++
+engine (native/) and StableHLO export cover out-of-process serving; this
+covers the "stand up the model you just trained" path: a stdlib HTTP
+server exposing the workflow's jitted fused forward.
+
+Endpoints:
+- POST /predict    {"inputs": [[...], ...]}  ->  {"outputs": [[...]]}
+  (softmax heads also return "classes": argmax per row)
+- GET  /info       model metadata (model_info()) (input shape, layer types, n_classes)
+
+The forward is compiled ONCE for a fixed max batch; requests are padded
+to it (static shapes — the jit contract) and unpadded on the way out.
+Localhost by default; same trust model as the manhole.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from veles_tpu.logger import Logger
+
+
+class InferenceServer(Logger):
+    """Serve a trained workflow's forward pass over HTTP."""
+
+    def __init__(self, workflow, host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 64) -> None:
+        super().__init__()
+        self.workflow = workflow
+        self.host = host
+        self.port = port
+        self.max_batch = max_batch
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()   # jit dispatch is thread-safe but
+        # serialized anyway: one device, no benefit to interleaving
+        self._build()
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        wf = self.workflow
+        step = wf.build_fused_step()
+        self._state = step.init_state()
+        self._sample_shape = tuple(wf.loader.minibatch_data.shape[1:])
+        self._softmax = getattr(wf, "loss", None) == "softmax"
+
+        def fwd(params, x):
+            out = step._forward(params, x, jax.random.PRNGKey(0), False)
+            if self._softmax:
+                out = jax.nn.softmax(out, axis=-1)
+            return out
+
+        self._fn = jax.jit(fwd)
+        # warm the cache at the fixed serving batch
+        probe = jnp.zeros((self.max_batch,) + self._sample_shape,
+                          jnp.float32)
+        self._fn(self._state["params"], probe).block_until_ready()
+
+    # -- request handling -----------------------------------------------------
+
+    def predict(self, inputs: np.ndarray) -> Dict[str, Any]:
+        x = np.asarray(inputs, np.float32)
+        if x.shape[1:] != self._sample_shape:
+            raise ValueError(
+                f"expected per-sample shape {self._sample_shape}, got "
+                f"{x.shape[1:]}")
+        if len(x) > self.max_batch:
+            raise ValueError(f"batch {len(x)} exceeds max_batch "
+                             f"{self.max_batch}")
+        n = len(x)
+        pad = self.max_batch - n
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + self._sample_shape,
+                                            np.float32)])
+        with self._lock:
+            out = np.asarray(self._fn(self._state["params"], x))[:n]
+        out = out.reshape(n, -1)
+        resp: Dict[str, Any] = {"outputs": out.tolist()}
+        if self._softmax:
+            resp["classes"] = out.argmax(axis=-1).tolist()
+        return resp
+
+    def model_info(self) -> Dict[str, Any]:
+        wf = self.workflow
+        return {
+            "workflow": getattr(wf, "name", type(wf).__name__),
+            "input_shape": list(self._sample_shape),
+            "max_batch": self.max_batch,
+            "n_classes": getattr(wf, "n_classes", None),
+            "layers": [type(u).__name__ for u in wf.forwards],
+        }
+
+    # -- http lifecycle --------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path.startswith("/info"):
+                    self._send(200, srv.model_info())
+                else:
+                    self._send(404, {"error": "unknown endpoint"})
+
+            def do_POST(self) -> None:  # noqa: N802
+                if not self.path.startswith("/predict"):
+                    self._send(404, {"error": "unknown endpoint"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n))
+                    resp = srv.predict(req["inputs"])
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)[:300]})
+                    return
+                self._send(200, resp)
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="inference")
+        self._thread.start()
+        self.info_log = f"serving on http://{self.host}:{self.port}"
+        self.info("inference %s (POST /predict, GET /info)", self.info_log)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -47,16 +47,20 @@ class Conv(Forward):
         #: variant) — the r3 tunnel died before the A/B could run.
         if s2d not in ("off", "on", "auto"):
             raise ValueError(f"s2d must be 'off'|'on'|'auto', got {s2d!r}")
+        if s2d == "on" and not (self.stride[0] == self.stride[1]
+                                and self.stride[0] > 1):
+            raise ValueError(
+                f"s2d='on' needs a square stride > 1 (got "
+                f"{self.stride}): the rewrite repacks stride blocks")
         self.s2d = s2d
 
     def _use_s2d(self, cin: int) -> bool:
+        if self.s2d == "on":
+            return True         # applicability validated in __init__
         if self.s2d == "off":
             return False
         sy, sx = self.stride
-        square = sy == sx and sy > 1
-        if self.s2d == "on":
-            return square
-        return square and cin < 8
+        return sy == sx and sy > 1 and cin < 8
 
     def output_hw(self) -> Tuple[int, int]:
         _, h, w, _ = self.input.shape
