@@ -216,3 +216,26 @@ def test_balanced_without_labels_raises():
         data, targets, 0, 0, 20)
     with pytest.raises(ValueError, match="balanced_train"):
         loader.initialize(device=None)
+
+
+def test_no_validation_split_tracks_train(eight_devices):
+    """n_validation=0: the Decision falls back to tracking the train
+    class (reference behavior) in fused mode without errors."""
+    wf = build_wf(minibatch=30, n_validation=0, n_train=90)
+    wf.run_fused()
+    assert wf.decision.epoch_number == 2
+    assert wf.decision.best_validation_err is not None
+
+
+def test_validation_smaller_than_minibatch_exact(eight_devices):
+    """A validation split SMALLER than one minibatch wraps heavily; the
+    pad mask keeps metrics exact (<= unique count) in fused AND granular
+    modes."""
+    wf = build_wf(minibatch=30, n_validation=7, n_train=60)
+    wf.run_fused()
+    assert wf.decision.best_validation_err <= 7
+
+    wf2 = build_wf(minibatch=30, n_validation=7, n_train=60)
+    wf2.initialize(device=None)
+    wf2.run()
+    assert wf2.decision.best_validation_err <= 7
