@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
+    p.add_argument("--serve", nargs="?", const=0, default=None, type=int,
+                   metavar="PORT",
+                   help="serve the (snapshot-restored) model over HTTP "
+                        "instead of training: POST /predict, GET /info")
     p.add_argument("--pp", type=int, default=None, metavar="MICROBATCHES",
                    help="train as a GPipe pipeline over the local devices "
                         "(one stage per device) with this many microbatches")
@@ -133,8 +137,11 @@ def main(argv=None) -> int:
         device=device, stats=not args.no_stats,
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
-        fused=args.fused, manhole=args.manhole, pp=args.pp)
+        fused=args.fused, manhole=args.manhole, pp=args.pp,
+        serve=args.serve)
     if args.optimize:
+        if args.serve is not None:
+            raise SystemExit("--serve and --optimize are exclusive modes")
         return run_optimize(module, args, device)
     return launcher.run_module(module)
 

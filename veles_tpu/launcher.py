@@ -34,7 +34,7 @@ class Launcher(Logger):
                  web_status: bool = False, web_port: int = 8090,
                  profile_dir: str = "", debug_nans: bool = False,
                  fused: bool = False, manhole: Optional[int] = None,
-                 pp: Optional[int] = None,
+                 pp: Optional[int] = None, serve: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -46,6 +46,13 @@ class Launcher(Logger):
         #: run via the one-dispatch-per-minibatch fused XLA step instead
         #: of the granular unit graph (same Decision/Snapshotter behavior)
         self.fused = fused
+        #: serve-only mode: skip training, expose the (typically
+        #: snapshot-restored) model over HTTP on this port (0 = auto)
+        if serve is not None and (pp or fused or listen or master):
+            raise SystemExit(
+                "--serve is a serve-only mode: it conflicts with "
+                "--pp/--fused and distributed -l/-m")
+        self.serve_port = serve
         #: GPipe pipeline mode: microbatch count (stages = local devices)
         if pp is not None and pp < 1:
             raise SystemExit(f"--pp needs a microbatch count >= 1 "
@@ -163,6 +170,27 @@ class Launcher(Logger):
             jax.profiler.start_trace(self.profile_dir)
             profiling = True
         try:
+            if self.serve_port is not None:
+                # serve-only: the reference's "run the forward sub-graph
+                # per request" path (SURVEY.md §3.4). Typically paired
+                # with -s <snapshot>; an unrestored workflow serves its
+                # initialization (useful for smoke tests only).
+                if not hasattr(self.workflow, "build_fused_step"):
+                    raise SystemExit(
+                        f"--serve: {type(self.workflow).__name__} has no "
+                        "fused forward (StandardWorkflow-family only)")
+                from veles_tpu.serving import InferenceServer
+                self.workflow.initialize(device=self.device, **kwargs)
+                srv = InferenceServer(self.workflow,
+                                      port=self.serve_port).start()
+                print(f"SERVING http://127.0.0.1:{srv.port}", flush=True)
+                try:
+                    while True:
+                        import time
+                        time.sleep(3600)
+                except KeyboardInterrupt:
+                    srv.stop()
+                return 0
             if self.mode != "standalone":
                 # distributed run: every process executes the same SPMD
                 # program over the GLOBAL device mesh; gradient averaging
