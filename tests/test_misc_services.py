@@ -240,3 +240,47 @@ def test_inference_server_serves_trained_model():
             assert e.code == 400
     finally:
         srv.stop()
+
+
+def test_forge_roundtrip_moe_transformer_family(tmp_path):
+    """Forge packaging handles the TPU-era unit families (attention +
+    token-MoE): publish a trained workflow, fetch it, predictions
+    match."""
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.forge import Forge
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    prng.seed_all(61)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(4, 8), n_validation=32, n_train=96,
+        minibatch_size=32, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[{"type": "attention", "n_heads": 2, "residual": True,
+                 "weights_stddev": 0.15},
+                {"type": "moe", "n_experts": 4, "hidden": 16,
+                 "residual": True, "weights_stddev": 0.15},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 3, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.05, "gradient_moment": 0.9},
+        name="ForgeTfMoE")
+    wf.run_fused()
+
+    zoo = Forge(str(tmp_path / "zoo"))
+    zoo.publish(wf, "tfmoe", author="test")
+    _meta, fetched = zoo.fetch("tfmoe")
+
+    x = loader.data.mem[:8]
+    def logits(w):
+        ps = [{k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
+              for u in w.forwards]
+        out = jnp.asarray(x)
+        for u, p in zip(w.forwards, ps):
+            out = u.fused_apply(p, out)
+        return np.asarray(out)
+    np.testing.assert_allclose(logits(fetched), logits(wf),
+                               rtol=1e-6, atol=1e-7)
