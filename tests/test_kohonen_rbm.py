@@ -127,3 +127,24 @@ def test_rbm_trainer_unit_reduces_reconstruction():
     wf.run()
     assert len(first) == 8 * 4  # 8 epochs x 4 minibatches
     assert first[-1] < first[0], (first[0], first[-1])
+
+
+def test_kohonen_workflow_plots_hits(tmp_path):
+    """The SOM sample's KohonenHits plotter renders the per-epoch
+    activation map (reference nn_plotting_units parity)."""
+    from veles_tpu.config import root
+    from veles_tpu.samples.kohonen import create_workflow
+    prev = root.kohonen.plot
+    root.kohonen.plot = True
+    try:
+        prng.seed_all(77)
+        wf = create_workflow()
+        wf.initialize(device=None)
+        wf.run()
+        spec = wf.plotter.make_spec()
+        assert spec["kind"] == "matrix"
+        hits = np.asarray(spec["data"])
+        assert hits.shape == tuple(root.kohonen.shape)
+        assert hits.sum() > 0              # winners were recorded
+    finally:
+        root.kohonen.plot = prev

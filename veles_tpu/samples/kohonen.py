@@ -20,13 +20,17 @@ root.kohonen.loader.n_train = 500
 root.kohonen.shape = (6, 6)
 root.kohonen.max_epochs = 10
 root.kohonen.learning_rate = 0.5
+root.kohonen.plot = False
 
 
 class KohonenWorkflow(Workflow):
-    """repeater → loader → trainer → forward(winners) → decision → loop."""
+    """repeater → loader → trainer → forward(winners) → decision → loop,
+    with the reference's KohonenHits activation map rendered per epoch
+    when `plot=True`."""
 
     def __init__(self, workflow=None, shape=(6, 6), max_epochs: int = 10,
-                 learning_rate: float = 0.5, loader=None, **kwargs) -> None:
+                 learning_rate: float = 0.5, loader=None, plot: bool = False,
+                 **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         assert loader is not None
         self.repeater = Repeater(self, name="repeater")
@@ -47,11 +51,19 @@ class KohonenWorkflow(Workflow):
                                  "last_minibatch", "class_lengths")
         self.trainer.link_decision(self.decision)
 
+        self.plotter = None
+        if plot:
+            from veles_tpu.plotting_units import KohonenHits
+            self.plotter = KohonenHits(self, shape=shape)
+            self.plotter.link_attrs(self.forward, ("input", "hits"))
+
         self.repeater.link_from(self.start_point)
         self.loader.link_from(self.repeater)
         self.trainer.link_from(self.loader)
         self.forward.link_from(self.trainer)
         self.decision.link_from(self.forward)
+        if self.plotter is not None:
+            self.plotter.link_from(self.decision)
         self.repeater.link_from(self.decision)
         self.end_point.link_from(self.decision)
         self._wire_gates()
@@ -59,6 +71,9 @@ class KohonenWorkflow(Workflow):
     def _wire_gates(self) -> None:
         self.end_point.gate_block = ~self.decision.complete
         self.repeater.gate_block = self.decision.complete
+        if self.plotter is not None:
+            # once per epoch, like the reference's SOM-hits rendering
+            self.plotter.gate_skip = ~self.loader.epoch_ended
 
     def initialize(self, device=None, **kwargs) -> None:
         self._wire_gates()
@@ -74,6 +89,7 @@ def create_workflow() -> KohonenWorkflow:
     return KohonenWorkflow(shape=tuple(cfg.shape),
                            max_epochs=cfg.max_epochs,
                            learning_rate=cfg.learning_rate,
+                           plot=bool(cfg.plot),
                            loader=loader, name="KohonenWorkflow")
 
 
