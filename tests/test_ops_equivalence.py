@@ -327,3 +327,52 @@ def test_conv_space_to_depth_exact():
         assert got.shape == gold.shape, (xshape, got.shape, gold.shape)
         np.testing.assert_allclose(got, gold, rtol=1e-5, atol=1e-5,
                                    err_msg=str((xshape, wshape, s, pad)))
+
+
+def test_finite_difference_gradcheck_composite_stack():
+    """Independent-of-autodiff validation: central finite differences on
+    a conv+LRN+pool+FC+softmax-CE stack match jax.grad to float64
+    precision. Every other gradient test compares implementations
+    against each other; this one compares against the definition."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops import xla as ox
+
+    with jax.enable_x64(True):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(3, 10, 10, 2), jnp.float64)
+        y = jnp.asarray(rng.randint(0, 4, 3))
+        params = {
+            "cw": jnp.asarray(rng.randn(3, 3, 2, 4) * 0.3, jnp.float64),
+            "cb": jnp.asarray(rng.randn(4) * 0.1, jnp.float64),
+            "fw": jnp.asarray(rng.randn(4 * 4 * 4, 4) * 0.2, jnp.float64),
+            "fb": jnp.asarray(rng.randn(4) * 0.1, jnp.float64),
+        }
+
+        def loss(p):
+            h = ox.conv2d_forward(x, p["cw"], p["cb"],
+                                  stride=(1, 1), padding=(0, 0),
+                                  activation="strictrelu")
+            h = ox.lrn_forward(h, k=2.0, alpha=1e-3, beta=0.75, n=3)
+            h = ox.maxpool_forward(h, (2, 2), (2, 2))
+            logits = h.reshape(3, -1) @ p["fw"] + p["fb"]
+            return ox.ce_loss_from_logits(logits, y, 4)
+
+        grads = jax.grad(loss)(params)
+        eps = 1e-6
+        for name in params:
+            flat = np.asarray(params[name]).ravel()
+            # probe a handful of coordinates per tensor
+            idxs = rng.choice(flat.size, size=min(6, flat.size),
+                              replace=False)
+            for i in idxs:
+                d = np.zeros_like(flat)
+                d[i] = eps
+                bump = d.reshape(params[name].shape)
+                pp = dict(params); pp[name] = params[name] + bump
+                pm = dict(params); pm[name] = params[name] - bump
+                fd = (float(loss(pp)) - float(loss(pm))) / (2 * eps)
+                ad = float(np.asarray(grads[name]).ravel()[i])
+                assert fd == pytest.approx(ad, rel=2e-4, abs=1e-7), \
+                    (name, int(i), fd, ad)
