@@ -253,11 +253,23 @@ def test_pipeline_trains_workflow_matches_fused(eight_devices):
                                    rtol=2e-4, atol=1e-5)
         assert int(el) == int(ep), (i, int(el), int(ep))
 
-    for pl, pp_ in zip(sl["params"], sp["params"]):
+    for pl, pp_ in zip(sl["params"], pp.params_dicts(sp)):
         for k in pl:
             np.testing.assert_allclose(
                 np.asarray(pl[k]), np.asarray(pp_[k]),
                 rtol=2e-4, atol=2e-5, err_msg=k)
+
+    # v2 memory contract: params are STAGE-RESIDENT — each device holds
+    # exactly one (1, L) row, so per-device param HBM is the widest
+    # stage, NOT the whole model (round-3 verdict item 5)
+    total_bytes = sum(
+        int(np.prod(a.shape)) * 4
+        for u in wf_p.forwards for a in u.param_arrays().values() if a)
+    shard_rows = {s.data.shape[0] for s in
+                  sp["params"].addressable_shards}
+    assert shard_rows == {1}, shard_rows
+    per_dev = sp["params"].addressable_shards[0].data.nbytes
+    assert per_dev < total_bytes / 2, (per_dev, total_bytes)
 
     # pad-mask parity: a wrapped minibatch drops its filler rows
     x = rng.randn(32, 12).astype(np.float32)

@@ -408,3 +408,58 @@ def test_seq_mode_rejects_bad_labels(eight_devices):
             step.train(state, x, bad_y)
     finally:
         root.char_transformer.parallel_mode = prev
+
+
+@pytest.mark.parametrize("mesh_kw,mode", [
+    (None, "local"),
+    (dict(), "dp"),
+    (dict(model=2), "gspmd"),
+])
+def test_fused_adam_trains(mesh_kw, mode, eight_devices):
+    """gd_config={"optimizer": "adam"} threads through pair_gd_configs
+    into the fused update: Adam state ({m, v, t}) replaces the velocity
+    tree, t counts steps, sharded modes carry the Adam tree through their
+    state specs, and every mode computes the SAME update as local."""
+    def build_adam():
+        prng.seed_all(99)
+        loader = SyntheticClassifierLoader(
+            n_classes=10, sample_shape=(8, 8), n_validation=48,
+            n_train=240, minibatch_size=48, noise=0.6)
+        return StandardWorkflow(
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 32,
+                 "weights_stddev": 0.05},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "weights_stddev": 0.05},
+            ],
+            loader=loader, loss="softmax", n_classes=10,
+            decision_config={"max_epochs": 2, "fail_iterations": 50},
+            gd_config={"learning_rate": 3e-3, "optimizer": "adam"},
+            name="AdamTest")
+
+    wf_ref = build_adam()
+    x, y = first_batch(wf_ref)
+    step_ref = wf_ref.build_fused_step()
+    s_ref = step_ref.init_state()
+    assert set(s_ref["vel"][0]) == {"m", "v", "t"}
+    losses = []
+    for _ in range(5):
+        s_ref, (loss, _err) = step_ref.train(s_ref, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert int(s_ref["vel"][0]["t"]) == 5
+
+    if mesh_kw is None:
+        return
+    wf_b = build_adam()
+    first_batch(wf_b)
+    mesh = make_mesh(**mesh_kw)
+    step_b = wf_b.build_fused_step(mesh=mesh, mode=mode)
+    sb = step_b.init_state()
+    for _ in range(5):
+        sb, _ = step_b.train(sb, x, y)
+    for pa, pb in zip(s_ref["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]),
+                                       np.asarray(pb[k]),
+                                       rtol=2e-5, atol=2e-6)

@@ -106,3 +106,53 @@ def test_ep_sharded_roundtrip(tmp_path, eight_devices):
         np.asarray(restored["params"][0]["w1"]))
     s2, (loss, _) = step2.train(restored, x, y)
     assert np.isfinite(float(loss))
+
+
+def test_roundtrip_nondefault_prng_impl_and_adam(tmp_path):
+    """Round-3 advisor: a state saved under a non-default PRNG impl (rbg
+    key data is (4,), not threefry's (2,)) must restore with the SAVED
+    impl regardless of the restoring process's default — and an Adam
+    state tree ({m, v, t}) round-trips through the abstract template."""
+    import jax
+
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    def build_adam(seed):
+        prng.seed_all(seed)
+        loader = SyntheticClassifierLoader(
+            n_classes=10, sample_shape=(8, 8), n_validation=48,
+            n_train=240, minibatch_size=48, noise=0.6)
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 32,
+                     "weights_stddev": 0.05},
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "weights_stddev": 0.05}],
+            loader=loader, loss="softmax", n_classes=10,
+            decision_config={"max_epochs": 2, "fail_iterations": 50},
+            gd_config={"learning_rate": 3e-3, "optimizer": "adam"},
+            name="CkptAdam")
+        wf.initialize(device=None)
+        return wf
+
+    wf = build_adam(1234)
+    step = wf.build_fused_step()
+    state = step.init_state()
+    state["key"] = jax.random.key(7, impl="rbg")   # non-default impl
+    rng = np.random.RandomState(0)
+    x = rng.randn(48, 8, 8).astype(np.float32)
+    y = rng.randint(0, 10, 48)
+    state, _ = step.train(state, x, y)
+    save_state(state, str(tmp_path))
+
+    wf2 = build_adam(999)
+    step2 = wf2.build_fused_step()
+    restored = restore_state(step2, str(tmp_path))
+    assert np.asarray(jax.random.key_data(restored["key"])).shape == (4,)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(restored["key"])),
+        np.asarray(jax.random.key_data(state["key"])))
+    assert int(restored["vel"][0]["t"]) == 1
+    s1, (l1, _) = step.train(state, x, y)
+    s2, (l2, _) = step2.train(restored, x, y)
+    assert float(l1) == pytest.approx(float(l2), rel=1e-6)

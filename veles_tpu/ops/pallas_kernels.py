@@ -206,12 +206,13 @@ lrn_pallas.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
     """Grid (B·H, q_blocks, k_blocks) with KV innermost: each step streams
     ONE (blk_k, d) K/V tile through VMEM (O(blk) footprint — long-context
     safe) and folds it into the online-softmax scratch; the last KV step
-    writes the normalized output block."""
+    writes the normalized output block plus the per-row logsumexp (the
+    backward's softmax residual)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -254,17 +255,196 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(ki == nk - 1)
     def _():
         o_ref[0] = acc_scr[:] / l_scr[:]
+        lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                     dq_ref, dq_scr, *, scale: float, causal: bool):
+    """dQ with the SAME grid/streaming as the forward (KV innermost):
+    recompute P = exp(S·scale − lse) per tile from the saved logsumexp,
+    dS = P ⊙ (dO·Vᵀ − D), dQ += dS·K·scale. O(blk) VMEM footprint."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    blk_q, blk_k = q.shape[0], kb.shape[0]
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = qi * blk_q \
+                + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_idx = ki * blk_k \
+                + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(k_idx <= q_idx, s, -1e30)
+        p = jnp.exp(s - lse_ref[0])                       # (blk_q, blk_k)
+        dp = jnp.dot(do_ref[0], vb.T,
+                     preferred_element_type=jnp.float32)  # (blk_q, blk_k)
+        ds = p * (dp - di_ref[0]) * scale
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * blk_k <= qi * blk_q + blk_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:]
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      scale: float, causal: bool):
+    """dK/dV with the transposed streaming order — grid (B·H, k_blocks,
+    q_blocks), Q innermost: each KV tile stays VMEM-resident while Q/dO
+    tiles stream past. dV += Pᵀ·dO, dK += dSᵀ·Q·scale."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    q = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    blk_q, blk_k = q.shape[0], kb.shape[0]
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = qi * blk_q \
+                + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_idx = ki * blk_k \
+                + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(k_idx <= q_idx, s, -1e30)
+        p = jnp.exp(s - lse_ref[0])
+        do = do_ref[0]
+        dv_scr[:] = dv_scr[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - di_ref[0]) * scale
+        dk_scr[:] = dk_scr[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        # a Q tile entirely BEFORE this KV tile contributes nothing
+        pl.when(qi * blk_q + blk_q - 1 >= ki * blk_k)(compute)
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def _qspec(blk_q, d):
+    return pl.BlockSpec((1, blk_q, d), lambda bh, i, t: (bh, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _kspec(blk_k, d):
+    return pl.BlockSpec((1, blk_k, d), lambda bh, i, t: (bh, t, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k):
+    """(B·H, S, D) f32 in -> (out, lse); lse is (B·H, S, 1)."""
+    bh, s, d = qf.shape
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)),
+        grid=(bh, s // blk_q, s // blk_k),
+        in_specs=[_qspec(blk_q, d), _kspec(blk_k, d), _kspec(blk_k, d)],
+        out_specs=(_qspec(blk_q, d), _qspec(blk_q, 1)),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((blk_q, 1), jnp.float32),   # running denom
+            pltpu.VMEM((blk_q, d), jnp.float32),   # unnormalized out
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attn(qf, kf, vf, scale, causal, blk_q, blk_k):
+    return _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k)[0]
+
+
+def _flash_attn_fwd(qf, kf, vf, scale, causal, blk_q, blk_k):
+    out, lse = _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k)
+    return out, (qf, kf, vf, out, lse)
+
+
+def _flash_attn_bwd(scale, causal, blk_q, blk_k, res, do):
+    qf, kf, vf, out, lse = res
+    bh, s, d = qf.shape
+    do = do.astype(jnp.float32)
+    # D_i = rowsum(dO ⊙ O) — the softmax-jacobian diagonal term; tiny
+    # elementwise reduce, XLA fuses it, no kernel needed
+    di = jnp.sum(do * out, axis=-1, keepdims=True)        # (bh, s, 1)
+    lspec = pl.BlockSpec((1, blk_q, 1), lambda b, i, t: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        grid=(bh, s // blk_q, s // blk_k),
+        in_specs=[_qspec(blk_q, d), _kspec(blk_k, d), _kspec(blk_k, d),
+                  _qspec(blk_q, d), lspec, lspec],
+        out_specs=_qspec(blk_q, d),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, do, lse, di)
+    # transposed grid: KV outer, Q inner (indices (b, t, i) name the
+    # (kv, q) block pair, so the q-side specs index with the LAST axis)
+    qspec_t = pl.BlockSpec((1, blk_q, d), lambda b, t, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kspec_t = pl.BlockSpec((1, blk_k, d), lambda b, t, i: (b, t, 0),
+                           memory_space=pltpu.VMEM)
+    lspec_t = pl.BlockSpec((1, blk_q, 1), lambda b, t, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),) * 2,
+        grid=(bh, s // blk_k, s // blk_q),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, lspec_t, lspec_t],
+        out_specs=(kspec_t, kspec_t),
+        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, do, lse, di)
+    return dq, dk, dv
+
+
+_flash_attn.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 
 def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
                            causal: bool = False, blk_q: int = 512,
                            blk_k: int = 1024):
-    """Intra-chip blocked attention. q/k/v: (B, S, H, D) -> (B, S, H, D).
-    Requires S % blk == 0 (pad upstream). Grid (B·H, S/blk_q, S/blk_k),
-    KV innermost, so the (S, S) score matrix never materializes — O(S·D)
-    memory instead of O(S²). Block defaults tuned on v5e (2026-07-29:
-    22 ms vs 51 ms for the XLA einsum path at B1·S16384·H8·D64 causal —
-    2.3× — while small-S workloads should just use ops.attention)."""
+    """Intra-chip blocked attention, DIFFERENTIABLE (custom-VJP pair of
+    Pallas kernels). q/k/v: (B, S, H, D) -> (B, S, H, D). Requires
+    S % 128 == 0 (pad upstream). Grid (B·H, S/blk_q, S/blk_k), KV
+    innermost, so the (S, S) score matrix never materializes — O(S·D)
+    memory instead of O(S²). The backward is recompute-based: the forward
+    saves only the per-row logsumexp; dQ streams KV tiles (same grid as
+    forward), dK/dV streams Q tiles on the transposed grid. Forward block
+    defaults tuned on v5e (2026-07-29: 22 ms vs 51 ms for the XLA einsum
+    path at B1·S16384·H8·D64 causal — 2.3× — while small-S workloads
+    should just use ops.attention)."""
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -282,28 +462,8 @@ def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
     def heads_first(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    qf, kf, vf = heads_first(q), heads_first(k), heads_first(v)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
-        grid=(b * h, s // blk_q, s // blk_k),
-        in_specs=[
-            pl.BlockSpec((1, blk_q, d), lambda bh, i, t: (bh, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d), lambda bh, i, t: (bh, t, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d), lambda bh, i, t: (bh, t, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i, t: (bh, i, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
-            pltpu.VMEM((blk_q, 1), jnp.float32),   # running denom
-            pltpu.VMEM((blk_q, d), jnp.float32),   # unnormalized out
-        ],
-        interpret=_interpret(),
-    )(qf.astype(jnp.float32), kf.astype(jnp.float32),
-      vf.astype(jnp.float32))
+    out = _flash_attn(heads_first(q).astype(jnp.float32),
+                      heads_first(k).astype(jnp.float32),
+                      heads_first(v).astype(jnp.float32),
+                      float(scale), causal, blk_q, blk_k)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -37,6 +37,9 @@ def _checkpointer():
     return _CKPTR
 
 
+from veles_tpu.prng import key_impl_name as _key_impl_name  # noqa: E402
+
+
 def _unwrap_key(state: Dict[str, Any]) -> Dict[str, Any]:
     """Typed PRNG key arrays are an extended dtype Orbax cannot
     serialize; carry the raw uint32 key data instead."""
@@ -48,26 +51,40 @@ def _unwrap_key(state: Dict[str, Any]) -> Dict[str, Any]:
 
 def save_state(state: Dict[str, Any], directory: str) -> str:
     """Write the state pytree (sharded jax arrays) to `directory`/state.
-    Every process participates (multi-host safe); returns the path."""
+    Every process participates (multi-host safe); returns the path. The
+    key's PRNG impl name rides in a sidecar so a restore under a
+    different jax_default_prng_impl re-wraps with the SAVED impl (key
+    geometry differs between impls: threefry (2,) vs rbg (4,))."""
     path = os.path.join(os.path.abspath(directory), "state")
     ckptr = _checkpointer()
     ckptr.save(path, _unwrap_key(state), force=True)
     ckptr.wait_until_finished()
+    if "key" in state and jax.process_index() == 0:
+        with open(os.path.join(os.path.abspath(directory),
+                               "key_impl.txt"), "w") as f:
+            f.write(_key_impl_name(state["key"]))
     return path
 
 
-def _abstract_state(step) -> Dict[str, Any]:
+def _abstract_state(step, key_impl: str) -> Dict[str, Any]:
     """ShapeDtypeStructs of the step's state (key carried as raw uint32
     data), built from the units' HOST-side shapes: no device allocation,
     no PRNG draw — a restore target for states too big to double-buffer."""
     import jax.numpy as jnp
+
+    from veles_tpu.ops import optim
     params = tuple(
         {k: jax.ShapeDtypeStruct(a.shape, a.mem.dtype)
          for k, a in u.param_arrays().items()}
         for u in step.forwards)
+    cfgs = getattr(step, "cfgs", None) or [None] * len(params)
+    vel = tuple(
+        {"m": p, "v": p, "t": jax.ShapeDtypeStruct((), jnp.int32)}
+        if isinstance(c, optim.AdamConfig) else p
+        for p, c in zip(params, cfgs))
     key_shape = jax.eval_shape(
-        lambda: jax.random.key_data(jax.random.key(0)))
-    return {"params": params, "vel": params,
+        lambda: jax.random.key_data(jax.random.key(0, impl=key_impl)))
+    return {"params": params, "vel": vel,
             "key": jax.ShapeDtypeStruct(key_shape.shape, key_shape.dtype),
             "lr_scale": jax.ShapeDtypeStruct((), jnp.float32)}
 
@@ -77,16 +94,25 @@ def restore_state(step, directory: str) -> Dict[str, Any]:
     of `step` (a FusedTrainStep-compatible object). The abstract target
     is built from host-side shapes + the step's own sharding plan, so
     nothing is allocated on device before Orbax streams the shards in,
-    and the global PRNG stream is untouched (reproducible resume)."""
-    path = os.path.join(os.path.abspath(directory), "state")
-    template = _abstract_state(step)
+    and the global PRNG stream is untouched (reproducible resume). The
+    key re-wraps with the impl recorded at save time, independent of the
+    process's jax_default_prng_impl."""
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, "state")
+    impl_path = os.path.join(directory, "key_impl.txt")
+    if os.path.exists(impl_path):
+        with open(impl_path) as f:
+            key_impl = f.read().strip()
+    else:   # pre-sidecar save: assume the jax default at save time
+        key_impl = "threefry2x32"
+    template = _abstract_state(step, key_impl)
     shardings = _target_shardings(step, template)
     target = jax.tree_util.tree_map(
         lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
         template, shardings)
     ckptr = _checkpointer()
     state = ckptr.restore(path, target)
-    state["key"] = jax.random.wrap_key_data(state["key"])
+    state["key"] = jax.random.wrap_key_data(state["key"], impl=key_impl)
     return state
 
 
@@ -108,6 +134,11 @@ def _target_shardings(step, template):
         return step._state_shardings()
     if mode == "dp":
         specs = step._smap_state_spec()
+    elif mode == "seq":
+        # seq mode may carry shard_map TP (model-axis param sharding):
+        # restore into those specs so TP-sharded params stream in
+        # partitioned instead of materializing whole per device
+        specs = step._seq_state_spec()
     else:
         specs = jax.tree_util.tree_map(lambda _: P(), template)
     return jax.tree_util.tree_map(

@@ -75,19 +75,30 @@ def _vel_attr(gd_unit, param_name: str) -> Optional[str]:
 
 
 def pair_gd_configs(workflow):
-    """(gd_units, SGDConfigs) aligned with workflow.forwards — each
+    """(gd_units, optimizer configs) aligned with workflow.forwards — each
     forward keeps its GD twin's hyperparameters (gds is built in reverse
-    order by StandardWorkflow). Shared by the fused and pipeline steps."""
+    order by StandardWorkflow). Shared by the fused and pipeline steps.
+    gd_config={"optimizer": "adam"} selects AdamConfig for a layer; the
+    default is the reference SGD+momentum rule."""
     gds = list(workflow.gds)
     n = len(list(workflow.forwards))
     gd_units = [gds[n - 1 - i] for i in range(n)]
-    cfgs = [optim.SGDConfig(
-        lr=getattr(g, "learning_rate", 0.0),
-        momentum=getattr(g, "gradient_moment", 0.0),
-        weight_decay=getattr(g, "weights_decay", 0.0),
-        l1_decay=getattr(g, "l1_decay", 0.0),
-        lr_bias_mult=getattr(g, "learning_rate_bias", 1.0))
-        for g in gd_units]
+    cfgs = []
+    for g in gd_units:
+        if getattr(g, "optimizer", "sgd") == "adam":
+            cfgs.append(optim.AdamConfig(
+                lr=getattr(g, "learning_rate", 0.0),
+                b1=getattr(g, "adam_beta1", 0.9),
+                b2=getattr(g, "adam_beta2", 0.999),
+                eps=getattr(g, "adam_eps", 1e-8),
+                weight_decay=getattr(g, "weights_decay", 0.0)))
+        else:
+            cfgs.append(optim.SGDConfig(
+                lr=getattr(g, "learning_rate", 0.0),
+                momentum=getattr(g, "gradient_moment", 0.0),
+                weight_decay=getattr(g, "weights_decay", 0.0),
+                l1_decay=getattr(g, "l1_decay", 0.0),
+                lr_bias_mult=getattr(g, "learning_rate_bias", 1.0)))
     return gd_units, cfgs
 
 
@@ -189,7 +200,11 @@ class FusedTrainStep:
             {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
             for u in self.forwards)
 
-        def seed_vel(u, g, p):
+        def seed_vel(u, g, p, cfg):
+            if isinstance(cfg, optim.AdamConfig):
+                # Adam moments live only in the fused state (round-trip
+                # via the sharded checkpoint, not the GD-twin Arrays)
+                return optim.adam_init(p)
             # resume from the GD twin's velocity buffers when present
             # (written by write_back / restored from a snapshot)
             out = {}
@@ -202,8 +217,8 @@ class FusedTrainStep:
                     out[k] = jnp.zeros_like(a)
             return out
 
-        vel = tuple(seed_vel(u, g, p) for u, g, p in
-                    zip(self.forwards, self.gd_units, params))
+        vel = tuple(seed_vel(u, g, p, c) for u, g, p, c in
+                    zip(self.forwards, self.gd_units, params, self.cfgs))
         state = {"params": params, "vel": vel,
                  "key": prng.get().next_key(),
                  "lr_scale": jnp.float32(1.0)}
@@ -224,12 +239,16 @@ class FusedTrainStep:
         def deleted(a) -> bool:
             return getattr(a, "is_deleted", lambda: False)()
 
-        for u, g, p, v in zip(self.forwards, self.gd_units,
-                              state["params"], state["vel"]):
+        for u, g, p, v, cfg in zip(self.forwards, self.gd_units,
+                                   state["params"], state["vel"],
+                                   self.cfgs):
+            adam = isinstance(cfg, optim.AdamConfig)
             for k, arr in u.param_arrays().items():
-                if deleted(p[k]) or deleted(v[k]):
+                if deleted(p[k]) or (not adam and deleted(v[k])):
                     continue  # donated-away buffer: keep last value
                 arr.reset(np.asarray(p[k]))
+                if adam:
+                    continue  # moments stay in the fused state pytree
                 # momentum velocities land in the GD twin so a snapshot
                 # resumes with optimizer state intact (reference parity:
                 # whole-workflow pickle includes optimizer state)
@@ -289,6 +308,12 @@ class FusedTrainStep:
                 # set at trace time so several step objects (different
                 # modes) over one workflow each trace the right kernel
                 u.seq_axis_name = seq_axis
+            if hasattr(u, "model_axis_name"):
+                # shard_map TP (seq mode + model axis): the unit psums
+                # over the model axis exactly when its params were
+                # sharded by _seq_param_specs — same gate both places
+                u.model_axis_name = (
+                    MODEL_AXIS if self._seq_tp_active(u) else None)
             if hasattr(u, "ep_axis_name"):
                 u.ep_axis_name = ep_axis
             k = jax.random.fold_in(key, i) if u.fused_needs_key else None
@@ -401,7 +426,10 @@ class FusedTrainStep:
         new_params, new_vel = [], []
         for p, g, v, cfg in zip(state["params"], grads, state["vel"],
                                 self.cfgs):
-            if p:
+            if p and isinstance(cfg, optim.AdamConfig):
+                np_, nv_ = optim.adam_update(p, g, v, cfg,
+                                             lr_scale=state["lr_scale"])
+            elif p:
                 np_, nv_ = optim.sgd_update(p, g, v, cfg,
                                             lr_scale=state["lr_scale"])
             else:
@@ -440,9 +468,47 @@ class FusedTrainStep:
                           for k in u.param_arrays()})
         return tuple(specs)
 
+    def _seq_tp_active(self, u) -> bool:
+        """True when seq-mode shard_map TP shards this unit's params."""
+        if self.mode != "seq" or self.mesh is None:
+            return False
+        m = self.mesh.shape.get(MODEL_AXIS, 1)
+        return (m > 1 and hasattr(u, "tp_param_specs")
+                and u.tp_param_specs(MODEL_AXIS, m) is not None)
+
+    def _seq_param_specs(self):
+        """Per-layer shard_map param specs for seq mode: megatron TP over
+        the mesh's model axis for units that declare a plan
+        (tp_param_specs), replicated otherwise — the third axis of the
+        data x seq x model long-context recipe."""
+        m = self.mesh.shape.get(MODEL_AXIS, 1)
+        specs = []
+        for u in self.forwards:
+            pd = {k: P() for k in u.param_arrays()}
+            if m > 1 and hasattr(u, "tp_param_specs"):
+                tp = u.tp_param_specs(MODEL_AXIS, m)
+                if tp:
+                    pd.update(tp)
+            specs.append(pd)
+        return tuple(specs)
+
+    def _seq_state_spec(self):
+        psp = self._seq_param_specs()
+        return {"params": psp, "vel": self._vel_specs(psp, P()),
+                "key": P(), "lr_scale": P()}
+
+    def _vel_specs(self, per_layer, scalar):
+        """Optimizer-state specs mirroring each layer's param specs —
+        Adam layers carry {"m", "v", "t"} instead of a velocity dict."""
+        return tuple(
+            {"m": sp, "v": sp, "t": scalar}
+            if isinstance(cfg, optim.AdamConfig) else sp
+            for cfg, sp in zip(self.cfgs, per_layer))
+
     def _smap_state_spec(self):
         psp = self._smap_param_specs()
-        return {"params": psp, "vel": psp, "key": P(), "lr_scale": P()}
+        return {"params": psp, "vel": self._vel_specs(psp, P()),
+                "key": P(), "lr_scale": P()}
 
     # -- compilation ---------------------------------------------------------
 
@@ -477,15 +543,16 @@ class FusedTrainStep:
             axes = (DATA_AXIS, SEQ_AXIS)
             xspec = P(DATA_AXIS, SEQ_AXIS)  # (N, S, ...) batch x sequence
             wsp = P(DATA_AXIS)              # weights stay per-SAMPLE
+            ssp = self._seq_state_spec()    # TP-sharded when model axis
             train = jax.shard_map(
                 lambda s, x, y, w: self._train_body(s, x, y, w, axis=axes),
                 mesh=mesh,
-                in_specs=(P(), xspec, xspec, wsp),
-                out_specs=(P(), P(), P()))
+                in_specs=(ssp, xspec, xspec, wsp),
+                out_specs=(ssp, P(), P()))
             evalf = jax.shard_map(
                 lambda p, x, y, w: self._eval_body(p, x, y, w, axis=axes),
                 mesh=mesh,
-                in_specs=(P(), xspec, xspec, wsp),
+                in_specs=(ssp["params"], xspec, xspec, wsp),
                 out_specs=(P(), P()))
             self._train_fn = jax.jit(train, donate_argnums=donate)
             self._eval_fn = jax.jit(evalf)
@@ -572,7 +639,8 @@ class FusedTrainStep:
     def _state_shardings(self):
         psh = self._param_shardings()
         repl = NamedSharding(self.mesh, P())
-        return {"params": psh, "vel": psh, "key": repl, "lr_scale": repl}
+        return {"params": psh, "vel": self._vel_specs(psh, repl),
+                "key": repl, "lr_scale": repl}
 
     def _shard_state(self, state):
         return jax.device_put(state, self._state_shardings())

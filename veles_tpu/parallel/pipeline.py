@@ -21,10 +21,18 @@ Two layers here:
   `lax.switch` on its stage index over width-padded flat activations,
   computes the evaluator loss on the last stage's logits and applies
   each GD twin's SGD hyperparameters — the same training semantics as
-  FusedTrainStep, scheduled as a pipeline. Params are replicated in v1
-  (each device COMPUTES only its stage; memory partitioning is the
-  documented follow-up), which keeps grads exact: the psum transpose
-  sums each param's gradient from the one stage that used it.
+  FusedTrainStep, scheduled as a pipeline.
+
+  Params are STAGE-RESIDENT (v2): each stage's heterogeneous param
+  dicts flatten into one row of an (S, L) f32 array sharded over the
+  stage axis, so per-device param HBM is the largest stage (≈ total/S),
+  not the whole model — the reason pipeline parallelism exists. Each
+  branch statically unflattens ITS stage's layout from the local row;
+  gradients stay stage-local (the flat array enters shard_map varying,
+  so no cross-stage psum touches params), and the SGD+momentum update
+  runs elementwise on the flat rows with per-element coefficient groups
+  (layer lr / bias-lr / decay looked up by group id), which is exactly
+  the per-layer `sgd_update` math fused into one VPU pass.
 """
 
 from __future__ import annotations
@@ -199,6 +207,11 @@ class PipelineTrainStep:
         self.n_classes = getattr(workflow, "n_classes", None)
         self.compute_dtype = compute_dtype
         self.gd_units, self.cfgs = pair_gd_configs(workflow)
+        from veles_tpu.ops import optim as _optim
+        if any(isinstance(c, _optim.AdamConfig) for c in self.cfgs):
+            raise ValueError(
+                "PipelineTrainStep supports the SGD family only "
+                "(gd_config optimizer='adam' -> use FusedTrainStep)")
         s = mesh.shape[STAGE_AXIS]
         self.stages = split_stages(self.forwards, s, boundaries)
         # unit index ranges per stage + boundary activation shapes
@@ -214,27 +227,88 @@ class PipelineTrainStep:
         widths = [int(np.prod(sh)) for sh in
                   self.in_shapes + [self.out_shape]]
         self.pad_width = max(widths)
+        self._build_param_layout()
         self._train_fn = None
         self._eval_fn = None
 
-    # -- state (same layout as FusedTrainStep) -------------------------------
+    # -- stage-resident flat parameter layout (v2) ---------------------------
+
+    def _build_param_layout(self) -> None:
+        """Each stage's params flatten into one row of an (S, L) array
+        (L = widest stage); `_layouts[si]` records (unit, name, shape,
+        lo, hi) slices. Every flat element gets a coefficient GROUP id
+        (2·unit + is_bias; L-padding -> the frozen group 0 with lr=0) so
+        the fused elementwise update applies exactly the per-layer /
+        per-bias SGD hyperparameters of `ops.optim.sgd_update`."""
+        self._layouts = []
+        rows = []
+        for lo_u, hi_u in self._ranges:
+            off, lay = 0, []
+            for i in range(lo_u, hi_u):
+                for name, arr in self.forwards[i].param_arrays().items():
+                    if not arr:
+                        continue
+                    size = int(np.prod(arr.shape))
+                    lay.append((i, name, tuple(arr.shape), off, off + size))
+                    off += size
+            self._layouts.append(lay)
+            rows.append(off)
+        self.param_row = max(rows + [1])
+        s = len(self.stages)
+        gid = np.zeros((s, self.param_row), np.int32)   # 0 = frozen pad
+        n_groups = 2 * len(self.forwards) + 1
+        tabs = np.zeros((4, n_groups), np.float32)      # lr/mom/wd/l1
+        for si, lay in enumerate(self._layouts):
+            for i, name, shape, lo, hi in lay:
+                cfg = self.cfgs[i]
+                bias = len(shape) == 1
+                g = 1 + 2 * i + int(bias)
+                gid[si, lo:hi] = g
+                lr = cfg.lr * (cfg.lr_bias_mult
+                               if bias and cfg.lr_bias_mult != 1.0
+                               else 1.0)
+                tabs[:, g] = (lr, cfg.momentum, cfg.weight_decay,
+                              cfg.l1_decay)
+        self._gid_host = gid
+        self._coef_tabs = tabs
+
+    def _stage_sharding(self):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, P(STAGE_AXIS))
+
+    # -- state ----------------------------------------------------------------
 
     def init_state(self) -> Dict[str, Any]:
         from veles_tpu import prng
-        params = tuple(
-            {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
-            for u in self.forwards)
-        vel = tuple(
-            {k: jnp.zeros_like(a) for k, a in p.items()}
-            for p in params)
-        return {"params": params, "vel": vel,
+        s = len(self.stages)
+        flat = np.zeros((s, self.param_row), np.float32)
+        for si, lay in enumerate(self._layouts):
+            for i, name, shape, lo, hi in lay:
+                flat[si, lo:hi] = \
+                    self.forwards[i].param_arrays()[name].mem.ravel()
+        sh = self._stage_sharding()
+        if getattr(self, "_gid", None) is None:
+            self._gid = jax.device_put(self._gid_host, sh)
+        return {"params": jax.device_put(flat, sh),
+                "vel": jax.device_put(np.zeros_like(flat), sh),
                 "key": prng.get().next_key(),
                 "lr_scale": jnp.float32(1.0)}
 
+    def params_dicts(self, state) -> tuple:
+        """Host-side per-layer param dicts recovered from the flat rows
+        (tests/introspection; write_back uses the same unflatten)."""
+        flat = np.asarray(state["params"])
+        out = [dict() for _ in self.forwards]
+        for si, lay in enumerate(self._layouts):
+            for i, name, shape, lo, hi in lay:
+                out[i][name] = flat[si, lo:hi].reshape(shape)
+        return tuple(out)
+
     def write_back(self, state: Dict[str, Any]) -> None:
-        for u, p in zip(self.forwards, state["params"]):
+        for u, p in zip(self.forwards, self.params_dicts(state)):
             for k, arr in u.param_arrays().items():
-                arr.reset(np.asarray(p[k]))
+                if k in p:
+                    arr.reset(p[k])
 
     # -- stage bodies ---------------------------------------------------------
 
@@ -242,8 +316,12 @@ class PipelineTrainStep:
         lo, hi = self._ranges[si]
         in_shape = self.in_shapes[si]
         d_in = int(np.prod(in_shape))
+        lay = self._layouts[si]
 
-        def branch(params, x2d):
+        def branch(flat_row, x2d):
+            params = {i: {} for i in range(lo, hi)}
+            for i, name, shape, p_lo, p_hi in lay:
+                params[i][name] = flat_row[p_lo:p_hi].reshape(shape)
             mb = x2d.shape[0]
             x = x2d[:, :d_in].reshape((mb,) + in_shape)
             for i in range(lo, hi):
@@ -260,8 +338,9 @@ class PipelineTrainStep:
 
         return branch
 
-    def _pipe_forward(self, params, xs_pad):
-        """xs_pad: (M, mb, pad_width) padded input microbatches ->
+    def _pipe_forward(self, flat_row, xs_pad):
+        """flat_row: this device's (param_row,) stage params;
+        xs_pad: (M, mb, pad_width) padded input microbatches ->
         (M, mb, pad_width) last-stage outputs (psum-broadcast)."""
         branches = [self._stage_branch(si)
                     for si in range(len(self.stages))]
@@ -270,16 +349,22 @@ class PipelineTrainStep:
             idx = lax.axis_index(STAGE_AXIS)
             if self.dispatch == "switch":
                 # params ride the closure, not the switch operands: only
-                # the selected branch executes per tick
+                # the selected branch executes per tick — and each branch
+                # reads its OWN stage's layout from the local row
                 return lax.switch(idx, [
                     (lambda xx, b=b: b(p, xx)) for b in branches], x2d)
+            # select_n: every branch unflattens the local row with ITS
+            # layout; non-selected results (garbage reinterpretations of
+            # another stage's bytes) are discarded, and select_n's VJP
+            # routes cotangents only to the selected branch, so grads
+            # stay exact
             return lax.select_n(idx, *[b(p, x2d) for b in branches])
 
-        return pipeline_apply(stage_fn, params, xs_pad, STAGE_AXIS)
+        return pipeline_apply(stage_fn, flat_row, xs_pad, STAGE_AXIS)
 
-    def _loss(self, params, xs_pad, y, w):
+    def _loss(self, flat_row, xs_pad, y, w):
         from veles_tpu.ops import xla as ox
-        outs = self._pipe_forward(params, xs_pad)     # (M, mb, pad)
+        outs = self._pipe_forward(flat_row, xs_pad)   # (M, mb, pad)
         c = int(np.prod(self.out_shape))
         logits = outs[..., :c].astype(jnp.float32)    # f32 loss/metrics
         if self.loss_kind == "softmax":
@@ -317,40 +402,41 @@ class PipelineTrainStep:
         return xs, y, w
 
     def _build(self) -> None:
-        from veles_tpu.ops import optim
+        tabs = jnp.asarray(self._coef_tabs)   # (4, G): lr/mom/wd/l1
 
-        def train_body(state, xs, y, w):
-            def lf(p):
-                loss, n_err = self._loss(p, xs, y, w)
+        def train_body(state, gid, xs, y, w):
+            def lf(pf):
+                loss, n_err = self._loss(pf[0], xs, y, w)
                 return loss, (loss, n_err)
 
-            (_, (loss, n_err)), grads = jax.value_and_grad(
+            (_, (loss, n_err)), g = jax.value_and_grad(
                 lf, has_aux=True)(state["params"])
-            new_p, new_v = [], []
-            for p, g, v, cfg in zip(state["params"], grads,
-                                    state["vel"], self.cfgs):
-                if p:
-                    p2, v2 = optim.sgd_update(p, g, v, cfg,
-                                              lr_scale=state["lr_scale"])
-                else:
-                    p2, v2 = p, v
-                new_p.append(p2)
-                new_v.append(v2)
-            new_state = {"params": tuple(new_p), "vel": tuple(new_v),
-                         "key": state["key"],
+            p, v = state["params"], state["vel"]
+            # fused elementwise SGD over the local stage row: exactly
+            # sgd_update's per-layer math, coefficients gathered by group
+            lr = jnp.take(tabs[0], gid) * state["lr_scale"]
+            mom = jnp.take(tabs[1], gid)
+            wd = jnp.take(tabs[2], gid)
+            l1 = jnp.take(tabs[3], gid)
+            reg = g + wd * p + l1 * jnp.sign(p)
+            v2 = mom * v - lr * reg
+            p2 = p + v2
+            new_state = {"params": p2, "vel": v2, "key": state["key"],
                          "lr_scale": state["lr_scale"]}
             return new_state, loss, n_err
 
         def eval_body(params, xs, y, w):
-            return self._loss(params, xs, y, w)
+            return self._loss(params[0], xs, y, w)
 
+        ssp = {"params": P(STAGE_AXIS), "vel": P(STAGE_AXIS),
+               "key": P(), "lr_scale": P()}
         self._train_fn = jax.jit(jax.shard_map(
             train_body, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P()),
-            out_specs=(P(), P(), P())))
+            in_specs=(ssp, P(STAGE_AXIS), P(), P(), P()),
+            out_specs=(ssp, P(), P())))
         self._eval_fn = jax.jit(jax.shard_map(
             eval_body, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P()),
+            in_specs=(P(STAGE_AXIS), P(), P(), P()),
             out_specs=(P(), P())))
 
     def train(self, state, x, y, w=None):
@@ -359,7 +445,7 @@ class PipelineTrainStep:
         if w is None:
             w = np.ones(np.shape(x)[0], np.float32)
         xs, y, w = self._microbatch(x, y, w)
-        new_state, loss, n_err = self._train_fn(state, xs, y, w)
+        new_state, loss, n_err = self._train_fn(state, self._gid, xs, y, w)
         return new_state, (loss, n_err)
 
     def evaluate(self, state, x, y, w=None):

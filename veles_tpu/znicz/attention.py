@@ -45,6 +45,10 @@ class MultiHeadAttention(Forward):
         #: FusedTrainStep's "seq" mode so fused_apply runs the ring /
         #: Ulysses kernel instead of the local one. None = local.
         self.seq_axis_name = None
+        #: mesh axis for megatron TP under shard_map (heads split across
+        #: the model axis: wq/wk/wv column-sharded, wo row-sharded + one
+        #: psum). Set by FusedTrainStep at trace time; None = whole.
+        self.model_axis_name = None
         #: "auto": the Pallas flash kernel on TPU when S is long enough to
         #: beat the XLA einsum (and divisible into blocks); "on"/"off"
         #: force it. See ops/pallas_kernels.flash_attention_pallas.
@@ -86,16 +90,30 @@ class MultiHeadAttention(Forward):
 
     # -- pure forward ---------------------------------------------------------
 
-    def _apply(self, params, x, axis_name=None, allow_flash=False):
+    def tp_param_specs(self, model_axis: str, m: int):
+        """Megatron TP for shard_map mode: whole heads split across the
+        model axis (each shard attends with n_heads/m local heads), wo
+        row-sharded with the psum in _apply. None when heads don't
+        divide."""
+        from jax.sharding import PartitionSpec as P
+        if self.n_heads % m:
+            return None
+        return {"wq": P(None, model_axis), "wk": P(None, model_axis),
+                "wv": P(None, model_axis), "wo": P(model_axis, None)}
+
+    def _apply(self, params, x, axis_name=None, allow_flash=True,
+               model_axis=None):
         n, s, e = x.shape
-        h, d = self.n_heads, self.head_dim
+        d = self.head_dim
+        # local head count follows the (possibly model-sharded) params
+        h = params["wq"].shape[1] // d
         q = (x @ params["wq"]).reshape(n, s, h, d)
         k = (x @ params["wk"]).reshape(n, s, h, d)
         v = (x @ params["wv"]).reshape(n, s, h, d)
         if axis_name is None or self.parallel_mode == "local":
-            # the Pallas kernel has no VJP: inference-only paths opt in
-            # (granular xla_run); the differentiated fused/GD paths use
-            # the einsum form, which jax.grad handles
+            # the Pallas kernel is a custom-VJP fwd/bwd pair, so the
+            # differentiated fused/GD paths use it too when the gate says
+            # it beats the XLA einsum (long S on a real TPU)
             if allow_flash and self._flash_ok(s):
                 from veles_tpu.ops import pallas_kernels as pk
                 o = pk.flash_attention_pallas(q, k, v, causal=self.causal)
@@ -110,22 +128,29 @@ class MultiHeadAttention(Forward):
             raise ValueError(f"unknown parallel_mode "
                              f"{self.parallel_mode!r}")
         y = o.reshape(n, s, h * d) @ params["wo"]
+        if model_axis is not None:
+            # row-parallel wo: per-head-group partials sum over model
+            y = jax.lax.psum(y, model_axis)
         return x + y if self.residual else y
 
     def fused_apply(self, params, x, *, key=None, train=True):
-        return self._apply(params, x, axis_name=self.seq_axis_name)
+        return self._apply(params, x, axis_name=self.seq_axis_name,
+                           model_axis=self.model_axis_name)
 
     def xla_init(self):
-        self._fn = self.jit(lambda x, p: self._apply(p, x,
-                                                     allow_flash=True))
+        self._fn = self.jit(lambda x, p: self._apply(p, x))
         return None
 
     def numpy_run(self) -> None:
         # golden path: same math through jax on host (attention has no
-        # 2015-reference numpy twin to mirror; mha_forward IS the model)
+        # 2015-reference numpy twin to mirror; mha_forward IS the model).
+        # allow_flash=False so this stays an INDEPENDENT reference — a
+        # golden that routed through the Pallas kernel would cross-check
+        # the kernel against itself.
         params = {k: jnp.asarray(a.mem)
                   for k, a in self.param_arrays().items()}
-        self.output.mem = np.asarray(self._apply(params, self.input.mem))
+        self.output.mem = np.asarray(
+            self._apply(params, self.input.mem, allow_flash=False))
 
     def xla_run(self) -> None:
         dv = self.device

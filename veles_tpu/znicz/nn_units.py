@@ -148,6 +148,10 @@ class GradientDescentBase(XLAUnit):
                  weights_decay: float = 0.0,
                  l1_decay: float = 0.0,
                  learning_rate_bias: float = 2.0,
+                 optimizer: str = "sgd",
+                 adam_beta1: float = 0.9,
+                 adam_beta2: float = 0.999,
+                 adam_eps: float = 1e-8,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.learning_rate = learning_rate
@@ -155,6 +159,15 @@ class GradientDescentBase(XLAUnit):
         self.weights_decay = weights_decay
         self.l1_decay = l1_decay
         self.learning_rate_bias = learning_rate_bias
+        #: "sgd" (reference update rule) or "adam" — consumed by the fused
+        #: step via pair_gd_configs; the granular per-unit backward keeps
+        #: the reference SGD+momentum rule (its velocity buffers round-trip
+        #: through snapshots; Adam state lives in the fused state pytree
+        #: and round-trips through the sharded checkpoint instead).
+        self.optimizer = optimizer
+        self.adam_beta1 = adam_beta1
+        self.adam_beta2 = adam_beta2
+        self.adam_eps = adam_eps
         #: runtime-scalable lr multiplier (driven by the lr_adjust unit).
         self.lr_scale = 1.0
         self.err_output = Array()

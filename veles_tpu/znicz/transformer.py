@@ -124,6 +124,10 @@ class SeqFFN(Forward):
         self.activation = activation
         self.w2 = Array()
         self.b2 = Array()
+        #: mesh axis for megatron TP under shard_map ("seq" mode with a
+        #: model axis): W1 column-sharded, W2 row-sharded, one psum here.
+        #: Set by FusedTrainStep at trace time; None = params whole.
+        self.model_axis_name = None
 
     def param_arrays(self) -> Dict[str, Array]:
         return {"weights": self.weights, "bias": self.bias,
@@ -143,13 +147,27 @@ class SeqFFN(Forward):
             self.output.reset(np.zeros((n, s, e), np.float32))
         return super().initialize(device=device, **kwargs)
 
-    def _apply(self, params, x):
+    def tp_param_specs(self, model_axis: str, m: int):
+        """Megatron pair for shard_map TP: W1/b1 column-sharded (local
+        hidden H/m, zero comms), W2 row-sharded (one psum in _apply).
+        None when the hidden width does not divide the model axis."""
+        from jax.sharding import PartitionSpec as P
+        if self.hidden % m:
+            return None
+        return {"weights": P(None, model_axis), "bias": P(model_axis),
+                "w2": P(model_axis, None), "b2": P()}
+
+    def _apply(self, params, x, model_axis=None):
         hmid = ox.act_forward(self.activation,
                               x @ params["weights"] + params["bias"])
-        return x + hmid @ params["w2"] + params["b2"]
+        y = hmid @ params["w2"]
+        if model_axis is not None:
+            # row-parallel W2: partial products sum over the model axis
+            y = lax.psum(y, model_axis)
+        return x + y + params["b2"]
 
     def fused_apply(self, params, x, *, key=None, train=True):
-        return self._apply(params, x)
+        return self._apply(params, x, model_axis=self.model_axis_name)
 
     def xla_init(self):
         self._fn = self.jit(lambda x, p: self._apply(p, x))
