@@ -18,6 +18,11 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Spawned child processes (parallel ensemble, two-process distributed
+# tests) re-run sitecustomize and would aim at the TPU tunnel; they honor
+# this env var via their worker initializers.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

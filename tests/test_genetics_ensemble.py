@@ -82,3 +82,39 @@ def test_ensemble_beats_or_matches_mean_member():
     assert res["n_samples"] == 50
     mean_member = np.mean(res["member_errs"])
     assert res["n_err"] <= mean_member + 2, res
+
+
+def _slow_member(seed):
+    """Module-level (picklable) factory recording which process trained
+    it and when; slow enough that overlap is measurable."""
+    import os
+    import time
+    t0 = time.time()
+    time.sleep(0.6)
+    return {"seed": seed, "pid": os.getpid(), "t0": t0, "t1": time.time()}
+
+
+def test_ensemble_parallel_truly_concurrent():
+    """train(parallel=True): members train in DISTINCT processes with
+    real wall-clock overlap (round-3 verdict item 7), seed order kept."""
+    ens = Ensemble(_slow_member, seeds=(1, 2, 3)).train(parallel=True)
+    assert [m["seed"] for m in ens.members] == [1, 2, 3]
+    assert len({m["pid"] for m in ens.members}) > 1
+    # at least one PAIR of members was in-flight simultaneously (a
+    # sequential run can never overlap); robust to spawn stagger
+    spans = [(m["t0"], m["t1"]) for m in ens.members]
+    assert any(a0 < b1 and b0 < a1
+               for i, (a0, a1) in enumerate(spans)
+               for (b0, b1) in spans[i + 1:]), spans
+
+
+def test_ensemble_parallel_real_workflows():
+    """The pickled-workflow path: parallel-trained REAL members predict
+    like sequentially trained ones (same seeds -> same weights)."""
+    import functools
+    factory = functools.partial(_make_wf, 0.1, 16, max_epochs=1)
+    seq = Ensemble(factory, seeds=(11, 22)).train()
+    par = Ensemble(factory, seeds=(11, 22)).train(parallel=True)
+    x = seq.members[0].loader.data.mem[:20]
+    np.testing.assert_allclose(seq.predict(x), par.predict(x),
+                               rtol=1e-5, atol=1e-6)

@@ -96,3 +96,51 @@ def test_heartbeat_reporter_thread_survives_no_server():
                             interval=0.05).start()
     time.sleep(0.2)
     rep.stop()                      # no exception = pass
+
+
+def test_heartbeat_hardening_token_whitelist_cap():
+    """Round-3 advisor: the heartbeat endpoint must reject wrong/missing
+    tokens, discard non-whitelisted/oversized beat payloads, and bound
+    the worker registry."""
+    import http.client
+
+    from veles_tpu.web_status import HeartbeatReporter, WebStatusServer
+    srv = WebStatusServer(FakeWorkflow(), port=0, token="sekrit",
+                          max_workers=2)
+    srv.start()
+
+    def post(body, token=None):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["X-Veles-Token"] = token
+        try:
+            conn.request("POST", "/heartbeat.json", json.dumps(body),
+                         headers)
+            return conn.getresponse().status
+        finally:
+            conn.close()
+
+    good = {"process_id": 1, "host": "h1", "local_devices": 4}
+    try:
+        assert post(good) == 403                      # no token
+        assert post(good, "wrong") == 403
+        assert post(good, "sekrit") == 204
+        # junk fields / wrong types never enter the registry
+        assert post({"process_id": 2, "host": 5,
+                     "local_devices": 1}, "sekrit") == 400
+        assert post({"process_id": 2, "evil": "x" * 10000,
+                     "host": "h2", "local_devices": 1}, "sekrit") == 204
+        assert set(srv.workers["2"]) == {"host", "local_devices", "t"}
+        # registry bounded: a THIRD process id is refused, existing
+        # ids keep updating
+        assert post({"process_id": 3, "host": "h3",
+                     "local_devices": 1}, "sekrit") == 429
+        assert post({"process_id": 1, "host": "h1",
+                     "local_devices": 8}, "sekrit") == 204
+        assert srv.workers["1"]["local_devices"] == 8
+        # reporter sends the token itself
+        HeartbeatReporter("127.0.0.1", srv.port, process_id=2,
+                          token="sekrit")._beat()
+    finally:
+        srv.stop()

@@ -16,18 +16,59 @@ import numpy as np
 from veles_tpu.logger import Logger
 
 
+def _worker_platform_init() -> None:
+    """Spawned workers re-run sitecustomize, which may pin jax at a
+    remote accelerator the parent deliberately avoided; honor the
+    JAX_PLATFORMS env var (which plain config pinning outranks) before
+    the child's first backend touch."""
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:   # noqa: BLE001 — member training decides fate
+            pass
+
+
 class Ensemble(Logger):
     """`factory(seed) -> trained workflow` is called per member; members
-    expose their forward chain for averaged inference."""
+    expose their forward chain for averaged inference.
+
+    Population-parallel like genetics (SURVEY.md §2.4 checklist row —
+    the reference distributed ensemble individuals across slaves):
+    `train(parallel=True)` runs one `factory(seed)` per process in a
+    ProcessPool, so members train concurrently on independent hosts/
+    slices; the trained workflows return by pickle (the same
+    whole-workflow pickle the Snapshotter uses). The factory must be
+    picklable (module-level function or partial)."""
 
     def __init__(self, factory: Callable[[int], Any],
-                 seeds: Sequence[int] = (1, 2, 3)) -> None:
+                 seeds: Sequence[int] = (1, 2, 3),
+                 max_workers: Optional[int] = None) -> None:
         super().__init__()
         self.factory = factory
         self.seeds = list(seeds)
+        self.max_workers = max_workers
         self.members: List[Any] = []
 
-    def train(self) -> "Ensemble":
+    def train(self, parallel: bool = False) -> "Ensemble":
+        if parallel:
+            import concurrent.futures as cf
+            import multiprocessing as mp
+            workers = min(self.max_workers or len(self.seeds),
+                          len(self.seeds))
+            self.info("training %d members on %d processes",
+                      len(self.seeds), workers)
+            # spawn, not fork: the parent's jax runtime is multithreaded
+            # and fork()ed children can deadlock in its locks
+            with cf.ProcessPoolExecutor(
+                    workers, mp_context=mp.get_context("spawn"),
+                    initializer=_worker_platform_init) as pool:
+                futs = [pool.submit(self.factory, s) for s in self.seeds]
+                # seed order preserved regardless of completion order
+                self.members = [f.result() for f in futs]
+            return self
         for seed in self.seeds:
             self.info("training member seed=%d", seed)
             self.members.append(self.factory(seed))

@@ -108,6 +108,10 @@ class Launcher(Logger):
         """Build the workflow, or restore it from `--snapshot`.
         Returns (workflow, snapshot_was_loaded)."""
         if self.snapshot_path:
+            # restoring unpickles device Arrays, which can initialize the
+            # XLA backend — in distributed mode that must happen AFTER
+            # jax.distributed.initialize (idempotent; main() re-calls it)
+            self.boot_distributed()
             self.info("restoring snapshot %s", self.snapshot_path)
             self.workflow = Snapshotter.import_(self.snapshot_path)
             self.snapshot_loaded = True
@@ -142,6 +146,28 @@ class Launcher(Logger):
             jax.config.update("jax_debug_nans", True)
         if self.web_status_enabled:
             from veles_tpu.parallel.distributed import is_coordinator
+
+            # shared heartbeat token: VELES_WEB_TOKEN, or a random value
+            # minted by process 0 and agreed over the job control plane
+            token = None
+            if self.mode != "standalone":
+                import os as _os
+                token = _os.environ.get("VELES_WEB_TOKEN")
+                if not token:
+                    # a RANDOM token minted by process 0 and agreed over
+                    # the jax.distributed control plane (boot_distributed
+                    # already ran): workers learn it through the
+                    # authenticated job channel, network bystanders can't
+                    # derive it from public facts
+                    import secrets
+
+                    import numpy as _np
+                    from jax.experimental import multihost_utils
+                    local = _np.frombuffer(
+                        secrets.token_bytes(16) if self.process_id == 0
+                        else b"\x00" * 16, dtype=_np.uint8)
+                    token = bytes(_np.asarray(
+                        multihost_utils.broadcast_one_to_all(local))).hex()
             if self.mode == "standalone" or is_coordinator():
                 from veles_tpu.web_status import WebStatusServer
                 # distributed: bind all interfaces so worker heartbeats
@@ -151,7 +177,8 @@ class Launcher(Logger):
                 host = ("127.0.0.1" if self.mode == "standalone"
                         else "0.0.0.0")
                 self._web = WebStatusServer(self.workflow, host=host,
-                                            port=self.web_port)
+                                            port=self.web_port,
+                                            token=token)
                 self._web.start()
             else:
                 # workers report into the coordinator's cluster view
@@ -159,7 +186,8 @@ class Launcher(Logger):
                 from veles_tpu.web_status import HeartbeatReporter
                 host = (self.master or self.listen).rsplit(":", 1)[0]
                 self._web = HeartbeatReporter(
-                    host, self.web_port, self.process_id).start()
+                    host, self.web_port, self.process_id,
+                    token=token).start()
         if self.manhole_port is not None:
             from veles_tpu.manhole import ManholeServer
             self._manhole = ManholeServer(self.workflow,

@@ -86,18 +86,34 @@ class Loader(AcceleratedUnit, IDistributable):
 
     # -- lifecycle -----------------------------------------------------------
 
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        #: unpickled from a snapshot: the next initialize() preserves the
+        #: carried schedule/cursor/shuffle (explicit marker — a second
+        #: initialize() of a LIVE loader must still re-derive them)
+        self._restored = True
+
     def initialize(self, device=None, **kwargs: Any):
         self.load_data()
-        offset = 0
-        for cls in (TEST, VALIDATION, TRAIN):
-            n = self.class_lengths[cls]
-            self._indices_per_class[cls] = np.arange(offset, offset + n,
-                                                     dtype=np.int64)
-            offset += n
-        self.total_samples = offset
-        #: pristine train index list: balanced sampling redraws from it
-        self._train_base = self._indices_per_class[TRAIN].copy()
-        self._start_epoch()
+        # A restored (snapshot-unpickled) loader arrives with its shuffle
+        # order, schedule and cursor intact; re-deriving them here would
+        # fork the resumed trajectory from the uninterrupted one (an
+        # extra shuffle draw + a cursor reset to the epoch start). Keep
+        # the carried state and only rebuild the data-dependent pieces.
+        restored = getattr(self, "_restored", False) \
+            and bool(getattr(self, "_schedule", None))
+        self._restored = False
+        if not restored:
+            offset = 0
+            for cls in (TEST, VALIDATION, TRAIN):
+                n = self.class_lengths[cls]
+                self._indices_per_class[cls] = np.arange(
+                    offset, offset + n, dtype=np.int64)
+                offset += n
+            #: pristine train index list: balanced sampling redraws from it
+            self._train_base = self._indices_per_class[TRAIN].copy()
+            self._start_epoch()
+        self.total_samples = sum(self.class_lengths)
         # Shape-probe fill: downstream units size their buffers off
         # minibatch_data at initialize time (the reference allocated its
         # minibatch Arrays in Loader.initialize too). The first run() refills
@@ -189,15 +205,54 @@ class PrefetchingLoader(Loader):
     SURVEY.md §2.7)."""
 
     def __init__(self, workflow=None, n_workers: int = 2,
-                 prefetch: int = 2, **kwargs: Any) -> None:
+                 prefetch: int = 2, hflip: bool = False,
+                 **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.n_workers = n_workers
         self.prefetch = prefetch
+        #: seeded horizontal-flip augmentation on TRAIN samples only (the
+        #: AlexNet-era recipe's one standing augmentation). Host-side, on
+        #: the produce threads; eval/test batches are never flipped.
+        self.hflip = hflip
+        self._hflip_seed = 0
         self._pool = None
         self._pending: dict = {}
 
+    def initialize(self, device=None, **kwargs: Any):
+        # a restored loader keeps its pickled flip seed (and must NOT
+        # re-draw: the snapshotted "hflip" generator stream already
+        # reflects the original draw — same restored gate as the
+        # schedule/cursor preservation in Loader.initialize)
+        if self.hflip and not getattr(self, "_restored", False):
+            self._hflip_seed = int(prng.get("hflip").randint(0, 2 ** 31))
+        return super().initialize(device=device, **kwargs)
+
     def _produce_batch(self, indices: np.ndarray):
         raise NotImplementedError
+
+    def _augment(self, x: np.ndarray, indices: np.ndarray) -> np.ndarray:
+        """Seeded per-(sample, epoch) horizontal flip of TRAIN rows. A
+        stateless integer hash decides each coin so produce threads need
+        no shared RNG state and re-visits flip identically within an
+        epoch but differently across epochs."""
+        if not self.hflip or x.ndim < 3:
+            return x
+        train_lo = self.class_lengths[TEST] + self.class_lengths[VALIDATION]
+        h = (indices.astype(np.uint64) * np.uint64(2654435761)
+             + np.uint64(self.epoch_number + 1) * np.uint64(0x9E3779B9)
+             + np.uint64(self._hflip_seed))
+        h ^= h >> np.uint64(15)
+        h *= np.uint64(0x2545F4914F6CDD1D)
+        flip = ((h >> np.uint64(32)) & np.uint64(1)).astype(bool)
+        flip &= indices >= train_lo
+        if flip.any():
+            x = np.ascontiguousarray(x)
+            x[flip] = x[flip, :, ::-1]
+        return x
+
+    def _produce(self, indices: np.ndarray):
+        x, y = self._produce_batch(indices)
+        return self._augment(x, indices), y
 
     def _indices_at(self, cursor: int) -> Optional[np.ndarray]:
         if cursor >= len(self._schedule):
@@ -214,15 +269,23 @@ class PrefetchingLoader(Loader):
             self._pool = ThreadPoolExecutor(
                 max_workers=self.n_workers,
                 thread_name_prefix=f"{self.name}-produce")
-        fut = self._pending.pop(self._cursor, None)
+        pend = self._pending.pop(self._cursor, None)
+        # the lookahead future is only valid for the cursor-schedule
+        # indices; a caller feeding different indices (e.g. a master's
+        # apply_data_from_master) must get THOSE indices, not the
+        # prefetched batch
+        fut = (pend[1] if pend is not None
+               and np.array_equal(pend[0], indices) else None)
+        if pend is not None and fut is None:
+            pend[1].cancel()
         try:
             x, y = (fut.result() if fut is not None
-                    else self._produce_batch(indices))
+                    else self._produce(indices))
         except CancelledError:
             # stop() from another thread (manhole, Ctrl-C handler)
             # cancelled the lookahead mid-fill: produce synchronously so
             # the pump loop winds down cleanly instead of crashing
-            x, y = self._produce_batch(indices)
+            x, y = self._produce(indices)
         for ahead in range(1, self.prefetch + 1):
             pos = self._cursor + ahead
             if pos in self._pending:
@@ -231,8 +294,8 @@ class PrefetchingLoader(Loader):
             if nxt is None:
                 break
             try:
-                self._pending[pos] = self._pool.submit(
-                    self._produce_batch, nxt)
+                self._pending[pos] = (nxt, self._pool.submit(
+                    self._produce, nxt))
             except RuntimeError:     # pool shut down by concurrent stop()
                 break
         self.minibatch_data.reset(x)
@@ -242,7 +305,7 @@ class PrefetchingLoader(Loader):
         super().run()
         if bool(self.epoch_ended):
             # schedule was rebuilt (new shuffle): drop stale lookahead
-            for fut in self._pending.values():
+            for _, fut in self._pending.values():
                 fut.cancel()
             self._pending.clear()
 

@@ -220,7 +220,7 @@ class MemmapImageLoader(PrefetchingLoader):
         return d
 
     def __setstate__(self, d):
-        self.__dict__.update(d)
+        super().__setstate__(d)   # sets the _restored marker
         if self.data_path and os.path.exists(
                 os.path.join(self.data_path, MANIFEST)):
             self.load_data()   # re-establish memmaps after unpickle

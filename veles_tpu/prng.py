@@ -14,6 +14,14 @@ import jax
 import numpy as np
 
 
+def key_impl_name(key) -> str:
+    """Name of a typed key's PRNG impl ('threefry2x32', 'rbg', ...).
+    PRNGSpec has no public name accessor; its repr is the quoted name —
+    this is the ONE place that parses it (pickling + checkpoint both
+    import from here)."""
+    return repr(jax.random.key_impl(key)).strip("'\"")
+
+
 class RandomGenerator:
     """A named generator holding a numpy `Generator` (host-side shuffles,
     weight fills run on host then transferred) and a jax PRNG key (device-side
@@ -59,15 +67,24 @@ class RandomGenerator:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    # jax keys are device arrays; snapshot the seed + numpy state instead.
+    # jax keys are device arrays: pickle the raw key DATA (host numpy) +
+    # impl name so a restored generator resumes at the snapshot's split
+    # position, not at split #0 (exact-trajectory resume for dropout /
+    # stochastic-pool keys), with no device handle in the pickle.
     def __getstate__(self):
         return {"name": self.name, "_seed": self._seed,
-                "np_state": self.state.get_state()}
+                "np_state": self.state.get_state(),
+                "key_data": np.asarray(jax.random.key_data(self._key)),
+                "key_impl": key_impl_name(self._key)}
 
     def __setstate__(self, state):
         self.name = state["name"]
         self.seed(state["_seed"])
         self.state.set_state(state["np_state"])
+        if "key_data" in state:   # pre-r4 pickles: seed-derived key
+            import jax.numpy as jnp
+            self._key = jax.random.wrap_key_data(
+                jnp.asarray(state["key_data"]), impl=state["key_impl"])
 
 
 _generators: Dict[str, RandomGenerator] = {}
@@ -98,3 +115,21 @@ def seed_all(seed: int) -> None:
     _base_seed = int(seed)
     for i, gen in enumerate(_generators.values()):
         gen.seed(seed + i)
+
+
+def snapshot_registry() -> dict:
+    """Picklable copy of the GLOBAL generator registry (numpy states +
+    seeds). The Snapshotter embeds it in every snapshot: the registry is
+    module state, not part of the workflow object graph, yet per-epoch
+    shuffles draw from it — restoring it is what makes resume-from-
+    snapshot replay the exact trajectory of an uninterrupted run."""
+    return {"base_seed": _base_seed,
+            "generators": dict(_generators)}
+
+
+def restore_registry(snap: dict) -> None:
+    """Install a registry captured by `snapshot_registry` (resume path)."""
+    global _base_seed
+    _base_seed = snap["base_seed"]
+    _generators.clear()
+    _generators.update(snap["generators"])

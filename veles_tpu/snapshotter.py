@@ -130,9 +130,14 @@ class SnapshotterBase(Unit):
 
 
 class Snapshotter(SnapshotterBase):
-    """Pickle the whole owning workflow (compressed)."""
+    """Pickle the whole owning workflow (compressed), together with the
+    global PRNG registry — per-epoch shuffles draw from module-level
+    generators outside the workflow object graph, and restoring them is
+    what makes a resumed run replay the uninterrupted trajectory
+    (SURVEY.md §5.4: the reference pickled its global RNG too)."""
 
     def export(self) -> str:
+        from veles_tpu import prng
         opener, ext = _open_codec(self.compression)
         path = os.path.join(self.directory,
                             f"{self.prefix}_{self.stamp()}.pickle{ext}")
@@ -143,7 +148,9 @@ class Snapshotter(SnapshotterBase):
         # importable, hence write-to-temp + atomic rename.
         tmp = path + ".tmp"
         with opener(tmp, "wb") as f:
-            pickle.dump(wf, f, protocol=pickle.HIGHEST_PROTOCOL)
+            pickle.dump({"__veles_snapshot__": 2, "workflow": wf,
+                         "prng": prng.snapshot_registry()}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
         return path
 
@@ -180,4 +187,10 @@ class Snapshotter(SnapshotterBase):
         else:
             opener = open
         with opener(path, "rb") as f:
-            return pickle.load(f)
+            obj = pickle.load(f)
+        if isinstance(obj, dict) and "__veles_snapshot__" in obj:
+            if obj.get("prng") is not None:
+                from veles_tpu import prng
+                prng.restore_registry(obj["prng"])
+            return obj["workflow"]
+        return obj   # pre-v2 snapshot: bare workflow pickle

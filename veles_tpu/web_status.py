@@ -101,21 +101,51 @@ def workflow_status(workflow) -> Dict[str, Any]:
 
 
 class WebStatusServer:
-    """Serve `/` (dashboard page) and `/status.json` on a daemon thread."""
+    """Serve `/` (dashboard page) and `/status.json` on a daemon thread.
+
+    The heartbeat endpoint is hardened against untrusted network peers
+    (it binds non-loopback in distributed mode): beats are
+    field-whitelisted with size caps, the worker registry is bounded
+    (`max_workers`), and when `token` is set a beat must carry it in
+    `X-Veles-Token` (the Launcher derives a shared token from the
+    coordinator address so workers agree without a side channel)."""
+
+    #: accepted beat fields -> (type, max size when str)
+    _BEAT_FIELDS = {"host": (str, 256), "local_devices": (int, None)}
 
     def __init__(self, workflow, host: str = "127.0.0.1",
-                 port: int = 8090) -> None:
+                 port: int = 8090, token: Optional[str] = None,
+                 max_workers: int = 256) -> None:
         self.workflow = workflow
         self.host = host
         self.port = port
+        self.token = token
+        self.max_workers = max_workers
         #: worker heartbeats: process_id -> {host, local_devices, t}
         self.workers: Dict[str, Dict[str, Any]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    def _clean_beat(self, beat: Any) -> Optional[Dict[str, Any]]:
+        """Whitelisted, size-capped copy of an incoming beat, or None."""
+        if not isinstance(beat, dict):
+            return None
+        out = {}
+        for k, (typ, cap) in self._BEAT_FIELDS.items():
+            v = beat.get(k)
+            if not isinstance(v, typ) or isinstance(v, bool):
+                return None
+            if cap is not None and len(v) > cap:
+                v = v[:cap]
+            out[k] = v
+        return out
+
     def start(self) -> None:
         wf = self.workflow
         workers = self.workers
+        token = self.token
+        max_workers = self.max_workers
+        clean = self._clean_beat
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -142,14 +172,28 @@ class WebStatusServer:
                     self.send_response(404)
                     self.end_headers()
                     return
+                if token:
+                    import hmac
+                    got = self.headers.get("X-Veles-Token", "")
+                    if not hmac.compare_digest(got, token):
+                        self.send_response(403)
+                        self.end_headers()
+                        return
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    beat = json.loads(self.rfile.read(n) or b"{}")
-                    pid = str(beat.pop("process_id"))
-                    if not isinstance(beat, dict):
-                        raise ValueError(beat)
+                    n = max(0, min(
+                        int(self.headers.get("Content-Length", "0")),
+                        64 * 1024))
+                    raw = json.loads(self.rfile.read(n) or b"{}")
+                    pid = str(raw.pop("process_id"))[:64]
+                    beat = clean(raw)
+                    if beat is None:
+                        raise ValueError(raw)
                 except (ValueError, KeyError, AttributeError, TypeError):
                     self.send_response(400)   # malformed beat != crash
+                    self.end_headers()
+                    return
+                if pid not in workers and len(workers) >= max_workers:
+                    self.send_response(429)   # registry full: no growth
                     self.end_headers()
                     return
                 beat["t"] = time.time()
@@ -179,11 +223,13 @@ class HeartbeatReporter:
     per worker process when web status is enabled)."""
 
     def __init__(self, coordinator_host: str, port: int,
-                 process_id: int, interval: float = 5.0) -> None:
+                 process_id: int, interval: float = 5.0,
+                 token: Optional[str] = None) -> None:
         self.url_host = coordinator_host
         self.port = port
         self.process_id = process_id
         self.interval = interval
+        self.token = token
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -201,9 +247,11 @@ class HeartbeatReporter:
         })
         conn = http.client.HTTPConnection(self.url_host, self.port,
                                           timeout=3)
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Veles-Token"] = self.token
         try:
-            conn.request("POST", "/heartbeat.json", body,
-                         {"Content-Type": "application/json"})
+            conn.request("POST", "/heartbeat.json", body, headers)
             conn.getresponse().read()
         finally:
             conn.close()

@@ -339,6 +339,7 @@ class StandardWorkflow(Workflow):
             # so device execution pipelines across minibatches (the
             # evaluator docstring's fused-mode contract).
             acc_loss = acc_err = None
+            acc_w = 0.0
             while not bool(dec.complete):
                 loader.run()
                 x = loader.minibatch_data.mem
@@ -348,16 +349,25 @@ class StandardWorkflow(Workflow):
                     state, (loss, n_err) = step.train(state, x, y, w)
                 else:
                     loss, n_err = step.evaluate(state, x, y, w)
-                acc_loss = loss if acc_loss is None else acc_loss + loss
+                # step losses are weighted MEANS over the minibatch; scale
+                # by the batch's valid-row weight so the class-pass total
+                # is the EXACT weighted mean (a wrapped final minibatch
+                # with few valid rows must not count as a full one)
+                bw = float(w.sum())
+                wl = loss * bw
+                acc_loss = wl if acc_loss is None else acc_loss + wl
+                acc_w += bw
                 acc_err = n_err if acc_err is None else acc_err + n_err
                 if bool(loader.last_minibatch):
                     # Decision's improvement/stop logic only reads totals
                     # at the class-pass boundary; feeding the accumulated
-                    # sum here (zeros in between) preserves its semantics.
-                    ev.loss = float(acc_loss)
+                    # value here (zeros in between) preserves its
+                    # semantics.
+                    ev.loss = float(acc_loss) / max(acc_w, 1.0)
                     ev.n_err = (int(acc_err) if self.loss == "softmax"
                                 else float(acc_err))
                     acc_loss = acc_err = None
+                    acc_w = 0.0
                 else:
                     ev.loss = 0.0
                     ev.n_err = 0
