@@ -126,6 +126,9 @@ def child_main() -> None:
     if plat:
         jax.config.update("jax_platforms", plat)
 
+    # NOTE: deliberately NOT enabling the persistent compilation cache
+    # here — it hangs on the axon backend (r3 session notes, tools/README).
+
     from veles_tpu import prng
     from veles_tpu.samples.alexnet import create_workflow
 
@@ -192,6 +195,119 @@ def child_main() -> None:
     }))
 
 
+def e2e_child_main() -> None:
+    """BENCH_MODE=e2e: END-TO-END throughput — the north-star metric's
+    full definition (BASELINE.md:18 includes the host input pipeline).
+
+    Path measured: packed uint8 memmap dataset on disk -> MemmapImageLoader
+    (RAM-preloaded shards, background-thread gather, raw uint8 leaves the
+    host) -> async jax.device_put DOUBLE-BUFFER (batch k+1 transfers while
+    step k computes) -> fused AlexNet train step with a leading
+    input_normalize layer (float conversion + scaling on device, where it
+    fuses into conv1's HBM read).
+
+    Reports e2e samples/s plus the device-only rate measured in the same
+    process, so overlap efficiency = e2e / device_only is explicit."""
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from veles_tpu import prng
+    from veles_tpu.loader.memmap import MemmapImageLoader, pack_arrays
+    from veles_tpu.samples.alexnet import alexnet_layers
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    batch = BATCH
+    hw = 227
+    n = int(os.environ.get("BENCH_E2E_SAMPLES", str(4 * batch)))
+    n_workers = int(os.environ.get("BENCH_E2E_WORKERS", "4"))
+    width = float(os.environ.get("BENCH_E2E_WIDTH", "1.0"))  # CPU smoke
+    pack_dir = f"/tmp/veles_e2e_{hw}_{n}"
+    if not os.path.exists(os.path.join(pack_dir, "manifest.json")):
+        rng = np.random.RandomState(7)
+        data = rng.randint(0, 256, (n, hw, hw, 3), dtype=np.uint8)
+        pack_arrays(pack_dir, data, rng.randint(0, 64, n).astype(np.int64),
+                    [0, 0, n], shard_mb=256.0)
+
+    prng.seed_all(1234)
+    loader = MemmapImageLoader(
+        data_path=pack_dir, minibatch_size=batch, emit="uint8",
+        preload=True, mean_normalize=False, n_workers=n_workers,
+        prefetch=3)
+    wf = StandardWorkflow(
+        layers=[{"type": "input_normalize"}]
+        + alexnet_layers(64, width, int(4096 * width) or 64),
+        loader=loader, loss="softmax", n_classes=64,
+        decision_config={"max_epochs": 999, "fail_iterations": 999},
+        gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
+        name="AlexNetE2E")
+    wf.initialize(device=None)
+    loader.on_device = False   # the bench loop does its own device_put
+    step = wf.build_fused_step(compute_dtype="bfloat16")
+    state = step.init_state()
+
+    def sync(st):
+        np.asarray(st["params"][-1]["bias"][:1])
+
+    def fetch():
+        # device_put is ASYNC: the H2D transfer of this batch rides under
+        # the step currently executing on device (the double buffer)
+        loader.run()
+        return (jax.device_put(loader.minibatch_data.mem),
+                jax.device_put(loader.minibatch_labels.mem),
+                loader.minibatch_valid.mem)
+
+    # -- device-only rate, SAME per-step dispatch protocol on one
+    # resident batch (not train_repeat: lax.scan bodies lose intra-op
+    # parallelism on XLA:CPU, which would corrupt smoke-run ratios; on
+    # TPU the two protocols agree to a few %) --
+    xw, yw, ww = fetch()
+    state, _ = step.train(state, xw, yw, ww)   # compile + warm
+    sync(state)
+    dev_rates = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS_PER_WINDOW):
+            state, _ = step.train(state, xw, yw, ww)
+        sync(state)
+        dev_rates.append(batch * STEPS_PER_WINDOW
+                         / (time.perf_counter() - t0))
+    device_only = float(np.median(dev_rates))
+
+    # -- end-to-end: loader -> double-buffered put -> per-step dispatch --
+    nxt = fetch()
+    for _ in range(4):                                   # warm per-step path
+        cur, nxt = nxt, None
+        state, _ = step.train(state, cur[0], cur[1], cur[2])
+        nxt = fetch()
+    sync(state)
+    rates = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS_PER_WINDOW):
+            cur, nxt = nxt, None
+            state, _ = step.train(state, cur[0], cur[1], cur[2])
+            nxt = fetch()
+        sync(state)
+        rates.append(batch * STEPS_PER_WINDOW / (time.perf_counter() - t0))
+    value = float(np.median(rates))
+    loader.stop()
+    print(json.dumps({
+        "metric": "alexnet_e2e_samples_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": UNIT,
+        "vs_baseline": round(value / ROUND1_FLOOR, 3),
+        "device_only_same_protocol": round(device_only, 2),
+        "overlap_efficiency": round(value / device_only, 4),
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_per_chip": batch,
+        "n_samples_packed": n,
+        "loader_workers": n_workers,
+    }))
+
+
 #: stderr markers of transient backend trouble worth a retry; anything
 #: else (import error, bad config, ...) is deterministic — fail fast.
 TRANSIENT_MARKERS = ("unavailable", "deadline", "failed to connect",
@@ -200,7 +316,9 @@ TRANSIENT_MARKERS = ("unavailable", "deadline", "failed to connect",
 
 
 def _error_record(err: str, attempt: int, provisional: bool = False):
-    rec = {"metric": METRIC, "value": None, "unit": UNIT,
+    metric = ("alexnet_e2e_samples_per_sec_per_chip"
+              if os.environ.get("BENCH_MODE") == "e2e" else METRIC)
+    rec = {"metric": metric, "value": None, "unit": UNIT,
            "vs_baseline": None, "error": err[:500], "attempts": attempt}
     if provisional:
         rec["provisional"] = True
@@ -319,6 +437,9 @@ def supervise() -> int:
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
-        child_main()
+        if os.environ.get("BENCH_MODE") == "e2e":
+            e2e_child_main()
+        else:
+            child_main()
     else:
         sys.exit(supervise())

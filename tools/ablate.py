@@ -11,10 +11,13 @@ backend — see the r3 session notes)."""
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BATCH = 512
 K = 8
@@ -57,7 +60,12 @@ def measure(layers, name: str) -> float:
 
 def variant(name: str):
     from veles_tpu.samples.alexnet import alexnet_layers
-    full = alexnet_layers(64, 1.0, 4096)
+    # Conv's s2d default flipped to "auto" in r4 (it won the A/B below) —
+    # "full" pins s2d OFF so it stays the documented r3 baseline
+    # (MEASURED.json "full_r3_lowering") instead of silently equaling
+    # "s2d-stem"; the other variants inherit the current defaults.
+    full = [dict(l, s2d="off") if l["type"].startswith("conv") else l
+            for l in alexnet_layers(64, 1.0, 4096)]
     if name == "full":
         return full
     if name == "no-LRN":
@@ -65,8 +73,8 @@ def variant(name: str):
     if name == "no-dropout":
         return [l for l in full if l["type"] != "dropout"]
     if name == "s2d-stem":
-        # A/B the space-to-depth entry-conv rewrite (exact numerics;
-        # flip the Conv default if this wins on the chip)
+        # the space-to-depth entry-conv rewrite (exact numerics; WON its
+        # on-chip A/B 8,656 -> 9,377 in r4 -> now the Conv default)
         out = [dict(l) for l in full]
         for l in out:
             if l["type"].startswith("conv"):

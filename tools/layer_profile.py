@@ -7,9 +7,12 @@ Usage: python tools/layer_profile.py [batch] [steps]
 
 from __future__ import annotations
 
+import os
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(batch: int = 256, steps: int = 10) -> None:
