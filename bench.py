@@ -95,10 +95,16 @@ def analytic_flops_per_sample(step) -> tuple:
             tokens = out[0] // inp[0]
         else:
             tokens = 1
+        # EXACT bias/table names across the unit zoo ("bias", LSTM gate
+        # "b", MoE expert-stacked "b1"/"b2" (E,H), positional tables) —
+        # an exact set, not a startswith, so a future matmul param named
+        # e.g. "beta" is counted, not silently dropped
+        non_matmul = {"bias", "b", "b1", "b2"}
+        # MoE routing fan-out: each token visits top_k experts (today's
+        # units route top-1 and carry no attribute; derived, not assumed)
+        top_k = int(getattr(u, "top_k", 1))
         for pname, arr in u.param_arrays().items():
-            # 2-D params that are not matmul operands: expert-stacked
-            # biases (b1/b2: (E, H)) and positional-embedding tables
-            if not arr or pname.startswith("b") or "pos" in pname:
+            if not arr or pname in non_matmul or "pos" in pname:
                 continue
             ws = arr.shape
             if len(ws) == 4:        # conv HWIO: (kh, kw, cin, cout)
@@ -106,9 +112,8 @@ def analytic_flops_per_sample(step) -> tuple:
                                * ws[0] * ws[1] * ws[2] * ws[3])
             elif len(ws) == 2:      # any (in, out) matmul
                 layer_macs += tokens * ws[0] * ws[1]
-            elif len(ws) == 3:      # MoE expert stack (E, in, out):
-                # top-1 routing — each token visits ONE expert
-                layer_macs += tokens * ws[1] * ws[2]
+            elif len(ws) == 3:      # MoE expert stack (E, in, out)
+                layer_macs += top_k * tokens * ws[1] * ws[2]
         if layer_macs:
             fwd_flops += 2.0 * layer_macs
             per_layer[f"{i}:{type(u).__name__}"] = round(

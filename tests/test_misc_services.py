@@ -238,6 +238,32 @@ def test_inference_server_serves_trained_model():
             raise AssertionError("expected HTTP 400")
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+        # CONCURRENT requests coalesce into fewer forward dispatches
+        # (the micro-batching window) and every caller still gets its
+        # own correct rows back
+        import threading as _thr
+        srv.batch_window_ms = 50.0
+        base = srv.n_dispatches
+        results = {}
+
+        def post(i):
+            req_i = _json.dumps({"inputs": x[i:i + 2].tolist()}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    url + "/predict", data=req_i,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30) as r:
+                results[i] = _json.loads(r.read())
+
+        threads = [_thr.Thread(target=post, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert srv.n_dispatches - base < 4, (srv.n_dispatches, base)
+        for i in range(4):
+            got = np.asarray(results[i]["outputs"])
+            np.testing.assert_allclose(got, probs[i:i + 2], atol=1e-5)
     finally:
         srv.stop()
 

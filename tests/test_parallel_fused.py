@@ -118,6 +118,10 @@ def test_scaling_harness_virtual_mesh(eight_devices):
     assert res["chips"] == 4 and not res["trivial"]
     assert res["samples_per_sec_per_chip_1"] > 0
     assert res["scaling_efficiency"] > 0
+    # the compiled 4-chip step must actually carry the gradient
+    # all-reduce (r3 verdict weak #8: emit the collective counts so a
+    # pod run is verifiable with zero new code)
+    assert res["compiled_collectives_n_chips"]["all-reduce"] > 0
 
 
 def test_workflow_stop_releases_unit_resources():

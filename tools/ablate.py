@@ -60,10 +60,12 @@ def measure(layers, name: str) -> float:
 
 def variant(name: str):
     from veles_tpu.samples.alexnet import alexnet_layers
-    # Conv's s2d default flipped to "auto" in r4 (it won the A/B below) —
-    # "full" pins s2d OFF so it stays the documented r3 baseline
-    # (MEASURED.json "full_r3_lowering") instead of silently equaling
-    # "s2d-stem"; the other variants inherit the current defaults.
+    # Conv's s2d default flipped to "auto" in r4 (it won the A/B below).
+    # EVERY variant here pins s2d OFF (they all derive from `full`), so
+    # the table stays internally consistent against the documented r3
+    # baseline (MEASURED.json "full_r3_lowering") and a layer-family
+    # delta never conflates with the stem rewrite; "s2d-stem" is the one
+    # variant that turns the rewrite on.
     full = [dict(l, s2d="off") if l["type"].startswith("conv") else l
             for l in alexnet_layers(64, 1.0, 4096)]
     if name == "full":

@@ -64,7 +64,8 @@ def _block_accum(q, k, v, scale, mask, m, l, o):
 
 
 def ring_attention(q, k, v, axis_name: str,
-                   scale: Optional[float] = None, causal: bool = False):
+                   scale: Optional[float] = None, causal: bool = False,
+                   kv_block: Optional[int] = None):
     """Sequence-parallel attention over a ring. Call INSIDE shard_map with
     q/k/v sharded on the sequence dim: (B, S/n, H, D) per device.
 
@@ -73,13 +74,27 @@ def ring_attention(q, k, v, axis_name: str,
     neighbor (`ppermute`) — n steps see every KV shard exactly once. The
     online-softmax (m, l, o) carry makes the result bit-comparable to
     full attention regardless of arrival order.
-    """
+
+    `kv_block` tiles WITHIN each hop: the held KV shard is consumed in
+    blocks of that size by an inner `lax.scan` of the same flash
+    recurrence, so the materialized score block is (B,H,Sq_local,
+    kv_block) instead of (B,H,Sq_local,S_local) — the difference between
+    fitting and not fitting long-context meshes in HBM. Each block step
+    is `jax.checkpoint`-ed, so the backward recomputes scores/probs
+    per block instead of storing them (flash-attention memory profile,
+    differentiable end-to-end). None → min(S_local, 1024); a value that
+    does not divide S_local falls back to one block per hop."""
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, _ = q.shape
+    if kv_block is None:
+        kv_block = min(s_loc, 1024)
+    if s_loc % kv_block:
+        kv_block = s_loc
+    nb = s_loc // kv_block
 
     q_idx = my * s_loc + jnp.arange(s_loc)      # global Q positions
 
@@ -97,12 +112,35 @@ def ring_attention(q, k, v, axis_name: str,
         m, l, o, k_t, v_t = carry
         # after t rotations we hold the shard originally on (my - t) mod n
         src = (my - t) % n
-        if causal:
-            k_idx = src * s_loc + jnp.arange(s_loc)
-            mask = (k_idx[None, :] <= q_idx[:, None])[None, None]
+        k0 = src * s_loc                      # global base of held shard
+        if nb == 1:
+            if causal:
+                k_idx = k0 + jnp.arange(s_loc)
+                mask = (k_idx[None, :] <= q_idx[:, None])[None, None]
+            else:
+                mask = None
+            m, l, o = _block_accum(q, k_t, v_t, scale, mask, m, l, o)
         else:
-            mask = None
-        m, l, o = _block_accum(q, k_t, v_t, scale, mask, m, l, o)
+            kr = jnp.moveaxis(
+                k_t.reshape(b, nb, kv_block, h, d), 1, 0)
+            vr = jnp.moveaxis(
+                v_t.reshape(b, nb, kv_block, h, d), 1, 0)
+
+            @jax.checkpoint
+            def blk(c, xs):
+                mc, lc, oc = c
+                kb, vb, j = xs
+                if causal:
+                    k_idx = k0 + j * kv_block + jnp.arange(kv_block)
+                    mask = (k_idx[None, :]
+                            <= q_idx[:, None])[None, None]
+                else:
+                    mask = None
+                return _block_accum(q, kb, vb, scale, mask,
+                                    mc, lc, oc), None
+
+            (m, l, o), _ = lax.scan(blk, (m, l, o),
+                                    (kr, vr, jnp.arange(nb)))
         k_t = lax.ppermute(k_t, axis_name, perm)
         v_t = lax.ppermute(v_t, axis_name, perm)
         return m, l, o, k_t, v_t

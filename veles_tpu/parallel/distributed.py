@@ -94,9 +94,11 @@ def scaling_efficiency(workflow, *, mesh_devices=None, batch_per_chip: int,
     devices = mesh_devices if mesh_devices is not None else jax.devices()
     n = len(devices)
 
-    def bench_on(n_chips: int) -> float:
+    def build_step(n_chips: int):
         mesh = make_mesh(devices[:n_chips], data=n_chips)
-        step = workflow.build_fused_step(mesh=mesh)
+        return workflow.build_fused_step(mesh=mesh)
+
+    def bench_on(step, n_chips: int) -> float:
         state = step.init_state()
         batch = n_chips * batch_per_chip
         shape = workflow.loader.minibatch_data.shape[1:]
@@ -110,8 +112,37 @@ def scaling_efficiency(workflow, *, mesh_devices=None, batch_per_chip: int,
         return measure_throughput(step.train, state, batch_fn,
                                   warmup=warmup, steps=steps)
 
-    per_chip_1 = bench_on(1)
-    per_chip_n = bench_on(n) / n if n > 1 else per_chip_1
+    def collective_counts(step, n_chips: int) -> Dict[str, int]:
+        """all-reduce/all-gather/… OP counts in the COMPILED n-chip train
+        step (reusing the already-built/benched step — no second
+        compile). Emitted even on a 1-chip run (where the efficiency
+        number is trivial) so a future pod run needs zero new code to
+        verify the gradient all-reduce actually rides the mesh: the n>1
+        HLO must show all-reduces, the 1-chip HLO must not.
+
+        Counts opcode positions (` name(` / ` name-start(`), not raw
+        substring hits — instruction-name references like %all-reduce.1
+        at operand sites would inflate a plain count several-fold."""
+        import re
+
+        if step._train_fn is None:
+            step._build()
+        state = step.init_state()
+        batch = n_chips * batch_per_chip
+        shape = workflow.loader.minibatch_data.shape[1:]
+        x = np.zeros((batch,) + tuple(shape), np.float32)
+        y = np.zeros(batch, np.int64)
+        w = np.ones(batch, np.float32)
+        txt = step._train_fn.lower(state, x, y, w).compile().as_text()
+        return {name: len(re.findall(
+            rf"\s{re.escape(name)}(?:-start)?\(", txt))
+            for name in ("all-reduce", "all-gather", "reduce-scatter",
+                         "collective-permute", "all-to-all")}
+
+    step1 = build_step(1)
+    per_chip_1 = bench_on(step1, 1)
+    step_n = build_step(n) if n > 1 else step1
+    per_chip_n = bench_on(step_n, n) / n if n > 1 else per_chip_1
     eff = per_chip_n / per_chip_1 if per_chip_1 > 0 else 0.0
     return {
         "chips": n,
@@ -120,4 +151,5 @@ def scaling_efficiency(workflow, *, mesh_devices=None, batch_per_chip: int,
         "samples_per_sec_per_chip_n": per_chip_n,
         "scaling_efficiency": eff,
         "trivial": n == 1,
+        "compiled_collectives_n_chips": collective_counts(step_n, n),
     }

@@ -11,8 +11,17 @@ Endpoints:
   (softmax heads also return "classes": argmax per row)
 - GET  /info       model metadata (model_info()) (input shape, layer types, n_classes)
 
-The forward is compiled ONCE for a fixed max batch; requests are padded
-to it (static shapes — the jit contract) and unpadded on the way out.
+Throughput design (static shapes — the jit contract — without paying
+max_batch compute per tiny request):
+- **Shape buckets**: requests are padded to the next power of two ≤
+  max_batch, one compiled program per bucket (jit's shape cache; only
+  the max_batch bucket is pre-warmed — a bucket's first request pays its
+  compile, subsequent ones hit the cache).
+- **Micro-batching window** (`batch_window_ms` > 0): concurrent requests
+  landing within the window are concatenated and served by ONE forward
+  dispatch, then split — the classic serving-throughput lever; each
+  HTTP handler thread blocks only on its own rows. Window 0 = strict
+  per-request dispatch.
 Localhost by default; same trust model as the manhole.
 """
 
@@ -21,7 +30,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -32,16 +41,24 @@ class InferenceServer(Logger):
     """Serve a trained workflow's forward pass over HTTP."""
 
     def __init__(self, workflow, host: str = "127.0.0.1", port: int = 0,
-                 max_batch: int = 64) -> None:
+                 max_batch: int = 64,
+                 batch_window_ms: float = 2.0) -> None:
         super().__init__()
         self.workflow = workflow
         self.host = host
         self.port = port
         self.max_batch = max_batch
+        self.batch_window_ms = batch_window_ms
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()   # jit dispatch is thread-safe but
         # serialized anyway: one device, no benefit to interleaving
+        self._cv = threading.Condition()
+        self._pending: List[dict] = []      # micro-batch accumulation
+        self._batcher: Optional[threading.Thread] = None
+        self._stopping = False
+        #: forward dispatches actually issued (tests assert coalescing)
+        self.n_dispatches = 0
         self._build()
 
     def _build(self) -> None:
@@ -68,6 +85,25 @@ class InferenceServer(Logger):
 
     # -- request handling -----------------------------------------------------
 
+    def _bucket(self, n: int) -> int:
+        """Smallest power of two ≥ n, capped at max_batch — one compiled
+        program per bucket instead of max_batch compute per request."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
+    def _forward_rows(self, x: np.ndarray) -> np.ndarray:
+        """Pad rows to their bucket, run ONE dispatch, unpad."""
+        n = len(x)
+        pad = self._bucket(n) - n
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + self._sample_shape,
+                                            np.float32)])
+        with self._lock:
+            self.n_dispatches += 1
+            return np.asarray(self._fn(self._state["params"], x))[:n]
+
     def predict(self, inputs: np.ndarray) -> Dict[str, Any]:
         x = np.asarray(inputs, np.float32)
         if x.shape[1:] != self._sample_shape:
@@ -78,17 +114,75 @@ class InferenceServer(Logger):
             raise ValueError(f"batch {len(x)} exceeds max_batch "
                              f"{self.max_batch}")
         n = len(x)
-        pad = self.max_batch - n
-        if pad:
-            x = np.concatenate([x, np.zeros((pad,) + self._sample_shape,
-                                            np.float32)])
-        with self._lock:
-            out = np.asarray(self._fn(self._state["params"], x))[:n]
+        if self.batch_window_ms > 0 and self._batcher is not None:
+            out = self._predict_batched(x)
+        else:
+            out = self._forward_rows(x)
         out = out.reshape(n, -1)
         resp: Dict[str, Any] = {"outputs": out.tolist()}
         if self._softmax:
             resp["classes"] = out.argmax(axis=-1).tolist()
         return resp
+
+    # -- micro-batching --------------------------------------------------------
+
+    def _predict_batched(self, x: np.ndarray) -> np.ndarray:
+        item = {"x": x, "out": None, "err": None,
+                "done": threading.Event()}
+        with self._cv:
+            self._pending.append(item)
+            self._cv.notify()
+        item["done"].wait()
+        if item["err"] is not None:
+            raise item["err"]
+        return item["out"]
+
+    def _batch_loop(self) -> None:
+        """Drain concurrent requests into one forward per window. Takes
+        whole requests only (each ≤ max_batch by validation); a request
+        that would overflow the merged batch waits for the next round."""
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopping:
+                    self._cv.wait()
+                if self._stopping:
+                    # fail queued waiters instead of abandoning them:
+                    # their handler threads block on done.wait() forever
+                    # otherwise
+                    for it in self._pending:
+                        it["err"] = RuntimeError("server stopping")
+                        it["done"].set()
+                    self._pending = []
+                    return
+            # collect for one window (more requests may still land);
+            # read the knob each round so it is tunable on a live server
+            threading.Event().wait(self.batch_window_ms / 1000.0)
+            with self._cv:
+                take, rows = [], 0
+                rest = []
+                for it in self._pending:
+                    if rows + len(it["x"]) <= self.max_batch:
+                        take.append(it)
+                        rows += len(it["x"])
+                    else:
+                        rest.append(it)
+                self._pending = rest
+            if not take:
+                continue
+            try:
+                merged = (take[0]["x"] if len(take) == 1 else
+                          np.concatenate([it["x"] for it in take]))
+                out = self._forward_rows(merged)
+                lo = 0
+                for it in take:
+                    hi = lo + len(it["x"])
+                    it["out"] = out[lo:hi]
+                    lo = hi
+            except Exception as e:      # surface to every waiter
+                for it in take:
+                    it["err"] = e
+            for it in take:
+                it["done"].set()
 
     def model_info(self) -> Dict[str, Any]:
         wf = self.workflow
@@ -96,6 +190,7 @@ class InferenceServer(Logger):
             "workflow": getattr(wf, "name", type(wf).__name__),
             "input_shape": list(self._sample_shape),
             "max_batch": self.max_batch,
+            "batch_window_ms": self.batch_window_ms,
             "n_classes": getattr(wf, "n_classes", None),
             "layers": [type(u).__name__ for u in wf.forwards],
         }
@@ -138,6 +233,10 @@ class InferenceServer(Logger):
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        if self.batch_window_ms > 0 and self._batcher is None:
+            self._batcher = threading.Thread(
+                target=self._batch_loop, daemon=True, name="batcher")
+            self._batcher.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="inference")
         self._thread.start()
@@ -150,3 +249,10 @@ class InferenceServer(Logger):
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._batcher is not None:
+            with self._cv:
+                self._stopping = True
+                self._cv.notify_all()
+            self._batcher.join(timeout=2)
+            self._batcher = None
+            self._stopping = False
