@@ -240,29 +240,37 @@ def test_inference_server_serves_trained_model():
             assert e.code == 400
 
         # CONCURRENT requests coalesce into fewer forward dispatches
-        # (the micro-batching window) and every caller still gets its
-        # own correct rows back
+        # (demand-driven micro-batching) and every caller still gets its
+        # own correct rows back. Deterministic: hold the dispatch lock so
+        # the batcher blocks in its first forward while the rest queue —
+        # they MUST merge into at most one more dispatch.
         import threading as _thr
-        srv.batch_window_ms = 50.0
         base = srv.n_dispatches
         results = {}
 
-        def post(i):
-            req_i = _json.dumps({"inputs": x[i:i + 2].tolist()}).encode()
-            with urllib.request.urlopen(urllib.request.Request(
-                    url + "/predict", data=req_i,
-                    headers={"Content-Type": "application/json"}),
-                    timeout=30) as r:
-                results[i] = _json.loads(r.read())
+        def submit(i):
+            results[i] = srv._predict_batched(
+                np.asarray(x[i:i + 2], np.float32))
 
-        threads = [_thr.Thread(target=post, args=(i,)) for i in range(4)]
+        with srv._lock:
+            threads = [_thr.Thread(target=submit, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            deadline = __import__("time").time() + 2.0
+            # wait until every request is enqueued (or already taken by
+            # the blocked batcher round)
+            while __import__("time").time() < deadline:
+                with srv._cv:
+                    n_queued = sum(len(it["x"]) for it in srv._pending)
+                if n_queued + 2 >= 8:   # first round took >= 1 request
+                    break
+                __import__("time").sleep(0.01)
         for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        assert srv.n_dispatches - base < 4, (srv.n_dispatches, base)
+            t.join(timeout=30)
+        assert srv.n_dispatches - base <= 2, (srv.n_dispatches, base)
         for i in range(4):
-            got = np.asarray(results[i]["outputs"])
+            got = np.asarray(results[i]).reshape(2, -1)
             np.testing.assert_allclose(got, probs[i:i + 2], atol=1e-5)
     finally:
         srv.stop()

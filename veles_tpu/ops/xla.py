@@ -475,8 +475,33 @@ _lrn_cvjp.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
 # ---------------------------------------------------------------------------
 
 
-def make_dropout_mask(key, shape, drop_prob: float, dtype=jnp.float32):
+def make_dropout_mask(key, shape, drop_prob: float, dtype=jnp.float32,
+                      impl: str = "auto"):
+    """Pre-scaled dropout mask (values 0 or 1/keep).
+
+    impl="auto": on accelerators the bits come from the hardware
+    `rng_bit_generator` (XLA RBG) instead of threefry — measured 4× less
+    wall-clock per (512, 4096) mask on v5e (r4; dropout was ~7% of the
+    AlexNet step under threefry, whose per-word rotate chains are VPU
+    serial work). Still counter-based and deterministic per key on a
+    given backend, but the mask STREAM differs from threefry's —
+    trajectories are reproducible per backend, not bit-identical across
+    impls (the reference had the same split between its xorshift device
+    kernel and numpy host RNG). "threefry"/"rbg" force an impl; CPU
+    defaults to threefry so golden tests are impl-stable."""
     keep = 1.0 - drop_prob
+    use_rbg = (impl == "rbg"
+               or (impl == "auto" and jax.default_backend() != "cpu"))
+    if use_rbg and keep < 1.0:
+        try:
+            kd = jax.random.key_data(key)
+        except TypeError:            # raw uint32 key array
+            kd = jnp.asarray(key)
+        kd = kd.astype(jnp.uint32).reshape(-1)
+        rk = jnp.concatenate([kd, kd, kd, kd])[:4]   # RBG wants u32[4]
+        _, bits = lax.rng_bit_generator(rk, shape, dtype=jnp.uint32)
+        thr = np.uint32(min(keep * 2.0 ** 32, 2.0 ** 32 - 1))
+        return (bits < thr).astype(dtype) / np.asarray(keep, dtype)[()]
     return ((jax.random.uniform(key, shape) < keep).astype(dtype)
             / np.asarray(keep, dtype)[()])
 

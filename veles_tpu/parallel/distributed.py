@@ -114,11 +114,13 @@ def scaling_efficiency(workflow, *, mesh_devices=None, batch_per_chip: int,
 
     def collective_counts(step, n_chips: int) -> Dict[str, int]:
         """all-reduce/all-gather/… OP counts in the COMPILED n-chip train
-        step (reusing the already-built/benched step — no second
-        compile). Emitted even on a 1-chip run (where the efficiency
-        number is trivial) so a future pod run needs zero new code to
-        verify the gradient all-reduce actually rides the mesh: the n>1
-        HLO must show all-reduces, the 1-chip HLO must not.
+        step. Reuses the benched step object, but obtaining post-SPMD HLO
+        text requires an AOT lower().compile() — one extra compile of the
+        same program (the jit dispatch cache is not shared with the AOT
+        path). Emitted even on a 1-chip run (where the efficiency number
+        is trivial) so a future pod run needs zero new code to verify the
+        gradient all-reduce actually rides the mesh: the n>1 HLO must
+        show all-reduces, the 1-chip HLO must not.
 
         Counts opcode positions (` name(` / ` name-start(`), not raw
         substring hits — instruction-name references like %all-reduce.1

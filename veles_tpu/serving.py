@@ -17,11 +17,12 @@ max_batch compute per tiny request):
   max_batch, one compiled program per bucket (jit's shape cache; only
   the max_batch bucket is pre-warmed — a bucket's first request pays its
   compile, subsequent ones hit the cache).
-- **Micro-batching window** (`batch_window_ms` > 0): concurrent requests
-  landing within the window are concatenated and served by ONE forward
-  dispatch, then split — the classic serving-throughput lever; each
-  HTTP handler thread blocks only on its own rows. Window 0 = strict
-  per-request dispatch.
+- **Demand-driven micro-batching** (`batch_window_ms` > 0): requests
+  that arrive while a forward is in flight queue up and are concatenated
+  into ONE dispatch on the next round (natural batching — a solo
+  sequential client pays NO added latency); when several requests are
+  already queued, the batcher additionally waits up to the window for
+  stragglers before dispatching. Window 0 = strict per-request dispatch.
 Localhost by default; same trust model as the manhole.
 """
 
@@ -130,6 +131,10 @@ class InferenceServer(Logger):
         item = {"x": x, "out": None, "err": None,
                 "done": threading.Event()}
         with self._cv:
+            # re-check under the lock: a batcher that already drained and
+            # exited would leave this item waiting forever
+            if self._stopping or self._batcher is None:
+                raise RuntimeError("server stopping")
             self._pending.append(item)
             self._cv.notify()
         item["done"].wait()
@@ -138,9 +143,14 @@ class InferenceServer(Logger):
         return item["out"]
 
     def _batch_loop(self) -> None:
-        """Drain concurrent requests into one forward per window. Takes
-        whole requests only (each ≤ max_batch by validation); a request
-        that would overflow the merged batch waits for the next round."""
+        """Coalesce queued requests into one forward per round. Demand-
+        driven: requests piling up while the previous forward runs are
+        taken together on the next round; a lone request dispatches
+        immediately (no idle window — the pre-batching latency). Only
+        when SEVERAL requests are already queued does the loop wait up
+        to batch_window_ms for stragglers. Takes whole requests only
+        (each ≤ max_batch by validation); one that would overflow the
+        merged batch waits for the next round."""
         while True:
             with self._cv:
                 while not self._pending and not self._stopping:
@@ -154,10 +164,10 @@ class InferenceServer(Logger):
                         it["done"].set()
                     self._pending = []
                     return
-            # collect for one window (more requests may still land);
-            # read the knob each round so it is tunable on a live server
-            threading.Event().wait(self.batch_window_ms / 1000.0)
-            with self._cv:
+                if len(self._pending) > 1 and self.batch_window_ms > 0:
+                    # concurrent writers active: brief straggler window
+                    # (knob read per round — tunable on a live server)
+                    self._cv.wait(self.batch_window_ms / 1000.0)
                 take, rows = [], 0
                 rest = []
                 for it in self._pending:
@@ -226,6 +236,11 @@ class InferenceServer(Logger):
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(400, {"error": str(e)[:300]})
                     return
+                except RuntimeError as e:
+                    # batcher failing in-flight waiters at stop(): a
+                    # clean 503, not a dropped connection
+                    self._send(503, {"error": str(e)[:300]})
+                    return
                 self._send(200, resp)
 
             def log_message(self, *args: Any) -> None:
@@ -253,6 +268,13 @@ class InferenceServer(Logger):
             with self._cv:
                 self._stopping = True
                 self._cv.notify_all()
-            self._batcher.join(timeout=2)
-            self._batcher = None
-            self._stopping = False
+            self._batcher.join(timeout=5)
+            if self._batcher.is_alive():
+                # join timed out (e.g. a huge live-tuned window mid-
+                # sleep): leave _stopping set so the thread exits at its
+                # next wake and keep the reference so a later start()
+                # cannot spawn a racing duplicate
+                self.warning("batcher still draining at stop()")
+            else:
+                self._batcher = None
+                self._stopping = False
