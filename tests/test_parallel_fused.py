@@ -106,6 +106,72 @@ def test_sharded_matches_local(mesh_kw, mode, eight_devices):
                                        rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_train_accum_matches_full_batch(optimizer):
+    """K-microbatch gradient accumulation == one full-batch step (same
+    normalization, pad mask included), for SGD+momentum and Adam."""
+    wf_a = build(minibatch_size=48)
+    x, y = first_batch(wf_a)
+    for gd in wf_a.gds:
+        gd.optimizer = optimizer
+    step_a = wf_a.build_fused_step()
+    # a wrapped final microbatch: zero-weight pad rows in the mask
+    w = np.ones(48, np.float32)
+    w[-5:] = 0.0
+    sa = step_a.init_state()
+    sa, (loss_a, err_a) = step_a.train(sa, x, y, w)
+
+    wf_b = build(minibatch_size=48)
+    xb, yb = first_batch(wf_b)
+    np.testing.assert_array_equal(x, xb)
+    for gd in wf_b.gds:
+        gd.optimizer = optimizer
+    step_b = wf_b.build_fused_step()
+    sb = step_b.init_state()
+    sb, (loss_b, err_b) = step_b.train_accum(sb, xb, yb, 4, w)
+
+    assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
+    assert int(err_a) == int(err_b)
+    for pa, pb in zip(sa["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]),
+                                       np.asarray(pb[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_train_accum_dp_matches_local(eight_devices):
+    """Accumulated step under shard_map DP == local accumulated step:
+    the per-microbatch gradient psum composes with accumulation."""
+    wf_a = build(minibatch_size=48)
+    x, y = first_batch(wf_a)
+    step_a = wf_a.build_fused_step()
+    sa = step_a.init_state()
+    sa, (loss_a, _) = step_a.train_accum(sa, x, y, 2)
+
+    wf_b = build(minibatch_size=48)
+    xb, yb = first_batch(wf_b)
+    mesh = make_mesh(eight_devices[:4], data=4)
+    step_b = wf_b.build_fused_step(mesh=mesh, mode="dp")
+    sb = step_b.init_state()
+    sb, (loss_b, _) = step_b.train_accum(sb, xb, yb, 2)
+
+    assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
+    for pa, pb in zip(sa["params"], sb["params"]):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]),
+                                       np.asarray(pb[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_run_fused_accum_steps_trains():
+    """Workflow-level plumbing: run_fused(accum_steps=K) drives training
+    through train_accum with the Decision bookkeeping intact."""
+    wf = build(minibatch_size=48, max_epochs=3)
+    wf.run_fused(accum_steps=4)
+    assert wf.decision.best_validation_err < 96   # learns something
+    assert wf.decision.epoch_number >= 1
+
+
 def test_scaling_harness_virtual_mesh(eight_devices):
     """Smoke the scaling_efficiency harness itself on a >1-device mesh
     (round-2 verdict weak #7: the harness was only ever exercised at

@@ -86,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused", action="store_true",
                    help="train via the fused one-dispatch-per-minibatch "
                         "XLA step instead of the granular unit graph")
+    p.add_argument("--accum", type=int, default=None, metavar="K",
+                   help="gradient accumulation: compute each minibatch's "
+                        "gradient as K scanned microbatches before the "
+                        "single update (fused/distributed modes; "
+                        "activation memory /K, numerics unchanged)")
     p.add_argument("--optimize", type=int, default=0, metavar="GENERATIONS",
                    help="genetic hyperparameter search instead of a single "
                         "run: the workflow/config module must define "
@@ -138,7 +143,7 @@ def main(argv=None) -> int:
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, manhole=args.manhole, pp=args.pp,
-        serve=args.serve)
+        serve=args.serve, accum=args.accum)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

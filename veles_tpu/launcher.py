@@ -35,6 +35,7 @@ class Launcher(Logger):
                  profile_dir: str = "", debug_nans: bool = False,
                  fused: bool = False, manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
+                 accum: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -65,6 +66,14 @@ class Launcher(Logger):
                              "local stage mesh); distributed runs use "
                              "the fused dp step")
         self.pp = pp
+        #: gradient accumulation microbatch count for fused/distributed
+        #: training (run_fused accum_steps; SURVEY.md §2.8 slot)
+        if accum is not None and accum < 1:
+            raise SystemExit(f"--accum needs K >= 1 (got {accum})")
+        if accum and accum > 1 and not (fused or listen or master):
+            raise SystemExit("--accum applies to the fused step: combine "
+                             "with --fused or a distributed -l/-m run")
+        self.accum = accum
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -245,7 +254,8 @@ class Launcher(Logger):
                     # can publish a truncated file
                     self.workflow.snapshotter = None
                 self.workflow.run_fused(device=self.device, mesh=mesh,
-                                        mode="dp", **kwargs)
+                                        mode="dp",
+                                        accum_steps=self.accum, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):
                     raise SystemExit(
@@ -258,7 +268,8 @@ class Launcher(Logger):
                     raise SystemExit(
                         f"--fused: {type(self.workflow).__name__} has no "
                         "fused step (StandardWorkflow-family only)")
-                self.workflow.run_fused(device=self.device, **kwargs)
+                self.workflow.run_fused(device=self.device,
+                                        accum_steps=self.accum, **kwargs)
             else:
                 self.workflow.initialize(device=self.device, **kwargs)
                 self.workflow.run()

@@ -340,7 +340,8 @@ class FusedTrainStep:
         return lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, spec))
 
-    def _loss_metrics(self, params, x, y, key, train: bool, w, axes):
+    def _loss_metrics(self, params, x, y, key, train: bool, w, axes,
+                      wsum=None):
         """PARTIAL (loss, n_err): the loss is normalized by the GLOBAL
         weight sum (psum over `axes` when sharded), so per-shard partials
         SUM to the exact global weighted mean — and because each shard's
@@ -348,7 +349,12 @@ class FusedTrainStep:
         of the replicated params psums to the exact global gradient with
         no per-shard renormalization. `w` is the Loader's (N,) pad mask
         (all-ones when absent): zero rows drop out of loss, n_err AND
-        gradients, so wrapped final minibatches are exact."""
+        gradients, so wrapped final minibatches are exact.
+
+        `wsum` overrides the normalizing per-SAMPLE weight total (already
+        globally reduced): gradient accumulation passes the FULL batch's
+        weight sum so microbatch partials sum to the exact full-batch
+        mean (and its gradient)."""
         out = self._forward(params, x, key, train)
         if self.loss_kind == "softmax":
             # broadcast per-sample weights over token dims: (N,) classifier
@@ -363,14 +369,17 @@ class FusedTrainStep:
                     w.reshape(w.shape + (1,) * (y.ndim - w.ndim)),
                     y.shape)
             wt = wt.astype(jnp.float32)
-            denom = self._global_wsum(w, wt.size // w.size, axes)
+            tokens = wt.size // w.size
+            denom = (wsum * tokens if wsum is not None
+                     else self._global_wsum(w, tokens, axes))
             loss = ox.ce_loss_from_logits(out, y, self.n_classes,
                                           weights=wt, denom=denom)
             wrong = (out.reshape(-1, out.shape[-1]).argmax(axis=-1)
                      != y.reshape(-1))
             n_err = (wrong & (wt.reshape(-1) > 0)).sum()
         else:
-            denom = self._global_wsum(w, 1, axes)
+            denom = (wsum if wsum is not None
+                     else self._global_wsum(w, 1, axes))
             loss, _ = ox.mse(out, y, weights=w, denom=denom)
             n_err = loss
         return loss, n_err
@@ -391,18 +400,23 @@ class FusedTrainStep:
 
     # -- step bodies ---------------------------------------------------------
 
-    def _train_body(self, state, x, y, w, *, axis):
-        """axis: None (local/gspmd), a mesh axis name, or a tuple of axis
-        names (the "seq" mode reduces over ("data", "seq"))."""
-        axes = (axis,) if isinstance(axis, str) else axis
+    def _shard_step_key(self, state, axes):
+        """Per-shard step key: decorrelate dropout/stochastic-pool across
+        shards via the global linear shard index (shared by the plain and
+        accumulated train bodies so their key streams stay in lockstep)."""
         step_key = state["key"]
         if axes:
-            # decorrelate dropout/stochastic-pool per shard via the global
-            # linear shard index
             idx = lax.axis_index(axes[0])
             for a in axes[1:]:
                 idx = idx * self.mesh.shape[a] + lax.axis_index(a)
             step_key = jax.random.fold_in(step_key, idx)
+        return step_key
+
+    def _train_body(self, state, x, y, w, *, axis):
+        """axis: None (local/gspmd), a mesh axis name, or a tuple of axis
+        names (the "seq" mode reduces over ("data", "seq"))."""
+        axes = (axis,) if isinstance(axis, str) else axis
+        step_key = self._shard_step_key(state, axes)
 
         def lf(p):
             # Under shard_map the params are unvarying (replicated), so the
@@ -423,6 +437,12 @@ class FusedTrainStep:
             # partials with a global denominator: SUM to the global metric
             loss = lax.psum(loss, axes)
             n_err = lax.psum(n_err, axes)
+        return self._apply_update(state, grads), loss, n_err
+
+    def _apply_update(self, state, grads):
+        """One optimizer step from already-reduced grads; advances the
+        carried key identically on every shard (fold_in of the *unfolded*
+        state key keeps it replicated)."""
         new_params, new_vel = [], []
         for p, g, v, cfg in zip(state["params"], grads, state["vel"],
                                 self.cfgs):
@@ -436,12 +456,53 @@ class FusedTrainStep:
                 np_, nv_ = p, v
             new_params.append(np_)
             new_vel.append(nv_)
-        # advance the carried key identically on every shard (fold_in of
-        # the *unfolded* state key keeps it replicated)
         new_key = jax.random.fold_in(state["key"], 1)
-        new_state = {"params": tuple(new_params), "vel": tuple(new_vel),
-                     "key": new_key, "lr_scale": state["lr_scale"]}
-        return new_state, loss, n_err
+        return {"params": tuple(new_params), "vel": tuple(new_vel),
+                "key": new_key, "lr_scale": state["lr_scale"]}
+
+    def _accum_body(self, state, xs, ys, ws, *, axis):
+        """Gradient accumulation: grads of the FULL (K·m)-sample batch
+        computed by scanning K microbatches (activation memory O(m)),
+        then ONE optimizer update — the TPU-first form of the reference's
+        `gradient_accumulation`/`apply_gradients` gate (SURVEY.md §2.8
+        GradientDescentBase row). Each microbatch is normalized by the
+        full batch's global weight sum, so the scanned grad SUM equals
+        the full-batch mean gradient exactly (pad masks included); under
+        sharding the per-shard gradient psum fires once per microbatch
+        inside the scan, exactly as the per-step path."""
+        axes = (axis,) if isinstance(axis, str) else axis
+        step_key = self._shard_step_key(state, axes)
+        wsum = self._global_wsum(ws.reshape(-1), 1, axes)
+
+        def micro(carry, xyw):
+            acc, loss_a, err_a, i = carry
+            x, y, w = xyw
+
+            def lf(p):
+                loss, n_err = self._loss_metrics(
+                    p, x, y, jax.random.fold_in(step_key, i), True, w,
+                    axes, wsum=wsum)
+                return loss, (loss, n_err)
+
+            (_, (loss, n_err)), grads = jax.value_and_grad(
+                lf, has_aux=True)(state["params"])
+            acc = jax.tree.map(lambda a, g: a + g, acc, grads)
+            return (acc, loss_a + loss,
+                    err_a + n_err.astype(jnp.float32), i + 1), None
+
+        zero = jax.tree.map(jnp.zeros_like, state["params"])
+        # the metric carries must be device-varying from step 0 under
+        # shard_map (they mix with varying per-shard partials); deriving
+        # them from ws inherits its varying axes (cf. ring_attention)
+        zero_s = ws.reshape(-1)[0].astype(jnp.float32) * 0.0
+        (grads, loss, n_err, _), _ = lax.scan(
+            micro, (zero, zero_s, zero_s, jnp.int32(0)), (xs, ys, ws))
+        if axes:
+            loss = lax.psum(loss, axes)
+            n_err = lax.psum(n_err, axes)
+        if self.loss_kind == "softmax":
+            n_err = n_err.astype(jnp.int32)
+        return self._apply_update(state, grads), loss, n_err
 
     def _eval_body(self, params, x, y, w, *, axis):
         axes = (axis,) if isinstance(axis, str) else axis
@@ -712,7 +773,7 @@ class FusedTrainStep:
                 spec = (P(DATA_AXIS, SEQ_AXIS) if self.mode == "seq"
                         else P(DATA_AXIS))
                 ssp = (self._smap_state_spec() if self.mode == "dp"
-                       else P())
+                       else self._seq_state_spec())
                 sm = jax.shard_map(
                     rep, mesh=self.mesh,
                     in_specs=(ssp, spec, spec, P(DATA_AXIS)),
@@ -727,6 +788,60 @@ class FusedTrainStep:
             else:
                 raise ValueError(f"unknown mode {self.mode!r}")
         return cache[k](state, x, y, w)
+
+    def train_accum(self, state, x, y, k: int, w=None):
+        """ONE optimizer update from the full (N,)-batch gradient,
+        computed as K scanned microbatches of N/K samples — activation
+        memory O(N/K), numerics equal to `train()` on the full batch
+        (same global weight normalization; dropout draws per-microbatch
+        keys). The TPU-first form of the reference's gradient
+        accumulation (`apply_gradients` gate, SURVEY.md §2.8): use it to
+        train at effective batch sizes whose activations do not fit HBM.
+        Returns (state, (loss, n_err)) for the whole batch."""
+        n = np.shape(x)[0]
+        if n % k:
+            raise ValueError(f"batch {n} not divisible by k={k}")
+        m = n // k
+        self._check_batch(m)   # each MICROBATCH must divide the data axis
+        x, y = self._seq_xy(x, y)
+        w = self._weights_or_ones(w, n)
+        xs = jnp.reshape(x, (k, m) + tuple(np.shape(x)[1:]))
+        ys = jnp.reshape(y, (k, m) + tuple(np.shape(y)[1:]))
+        ws = jnp.reshape(w, (k, m))
+        cache = getattr(self, "_train_accum_fns", None)
+        if cache is None:
+            cache = self._train_accum_fns = {}
+        if k not in cache:
+            axis = {"dp": DATA_AXIS, "seq": (DATA_AXIS, SEQ_AXIS)}.get(
+                self.mode)
+
+            def acc(state, xs, ys, ws):
+                st2, loss, n_err = self._accum_body(state, xs, ys, ws,
+                                                    axis=axis)
+                return st2, (loss, n_err)
+
+            donate = (0,) if self.donate else ()
+            if self.mode == "local":
+                cache[k] = jax.jit(acc, donate_argnums=donate)
+            elif self.mode in ("dp", "seq"):
+                spec = (P(None, DATA_AXIS, SEQ_AXIS)
+                        if self.mode == "seq" else P(None, DATA_AXIS))
+                ssp = (self._smap_state_spec() if self.mode == "dp"
+                       else self._seq_state_spec())
+                sm = jax.shard_map(
+                    acc, mesh=self.mesh,
+                    in_specs=(ssp, spec, spec, P(None, DATA_AXIS)),
+                    out_specs=(ssp, (P(), P())))
+                cache[k] = jax.jit(sm, donate_argnums=donate)
+            elif self.mode == "gspmd":
+                xsh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+                cache[k] = jax.jit(
+                    acc, in_shardings=(self._state_shardings(),
+                                       xsh, xsh, xsh),
+                    donate_argnums=donate)
+            else:
+                raise ValueError(f"unknown mode {self.mode!r}")
+        return cache[k](state, xs, ys, ws)
 
     def train_many(self, state, xs, ys, ws=None):
         """K training steps in ONE dispatch: xs (K, batch, ...), ys
@@ -762,7 +877,7 @@ class FusedTrainStep:
                         if self.mode == "seq" else P(None, DATA_AXIS))
                 wspec = P(None, DATA_AXIS)
                 ssp = (self._smap_state_spec() if self.mode == "dp"
-                       else P())
+                       else self._seq_state_spec())
                 sm = jax.shard_map(
                     many, mesh=self.mesh,
                     in_specs=(ssp, spec, spec, wspec),

@@ -248,10 +248,16 @@ class InferenceServer(Logger):
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        if self.batch_window_ms > 0 and self._batcher is None:
-            self._batcher = threading.Thread(
-                target=self._batch_loop, daemon=True, name="batcher")
-            self._batcher.start()
+        if self.batch_window_ms > 0:
+            if self._batcher is not None and not self._batcher.is_alive():
+                # a previous stop() timed out its join but the thread has
+                # since exited: clear the tombstone so restart works
+                self._batcher = None
+                self._stopping = False
+            if self._batcher is None:
+                self._batcher = threading.Thread(
+                    target=self._batch_loop, daemon=True, name="batcher")
+                self._batcher.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="inference")
         self._thread.start()

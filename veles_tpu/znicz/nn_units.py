@@ -139,7 +139,10 @@ class GradientDescentBase(XLAUnit):
     parameters in place. Hyperparameters follow the reference:
     `learning_rate`, `gradient_moment` (momentum), `weights_decay` (L2),
     `l1_decay`, `learning_rate_bias` multiplier (reference used 2× lr on
-    biases), `gradient_accumulation` via `apply_gradients` gate.
+    biases). The reference's `gradient_accumulation`/`apply_gradients`
+    gate maps to the fused step's `train_accum` (parallel/fused.py): K
+    scanned microbatches accumulate the exact full-batch gradient before
+    ONE update — same capability, jit-native form.
     """
 
     def __init__(self, workflow=None,

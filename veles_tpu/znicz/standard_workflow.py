@@ -287,18 +287,24 @@ class StandardWorkflow(Workflow):
 
     def run_fused(self, epochs: Optional[int] = None, device=None,
                   mesh=None, mode: str = "auto", compute_dtype=None,
-                  ep: bool = False) -> None:
+                  ep: bool = False,
+                  accum_steps: Optional[int] = None) -> None:
         """Train with the fused step while keeping the graph semantics:
         the real Loader drives minibatches and the real Decision unit does
         the epoch/stop bookkeeping (so snapshot gating, best-error tracking
-        and the `complete` Bool behave exactly as in granular mode)."""
+        and the `complete` Bool behave exactly as in granular mode).
+
+        `accum_steps=K` computes each minibatch's gradient as K scanned
+        microbatches before the single update (train_accum) — activation
+        memory O(minibatch/K), numerics equal to the plain step (the
+        reference's gradient_accumulation slot, SURVEY.md §2.8)."""
         if epochs is not None:
             self.decision.max_epochs = epochs
         if not self.is_initialized:
             self.initialize(device=device)
         step = self.build_fused_step(mesh=mesh, mode=mode,
                                      compute_dtype=compute_dtype, ep=ep)
-        self._run_with_step(step)
+        self._run_with_step(step, accum_steps=accum_steps)
 
     def run_pipelined(self, mesh=None, n_microbatches: int = 4,
                       epochs: Optional[int] = None, device=None,
@@ -323,10 +329,18 @@ class StandardWorkflow(Workflow):
                                         compute_dtype=compute_dtype)
         self._run_with_step(step)
 
-    def _run_with_step(self, step) -> None:
+    def _run_with_step(self, step, accum_steps: Optional[int] = None) -> None:
         """Drive any train/evaluate/write_back step object through the
         Loader + Decision bookkeeping (shared by run_fused /
         run_pipelined)."""
+        if accum_steps and accum_steps > 1:
+            import types
+            base = step
+            step = types.SimpleNamespace(
+                train=lambda s, x, y, w=None: base.train_accum(
+                    s, x, y, accum_steps, w),
+                evaluate=base.evaluate, init_state=base.init_state,
+                write_back=base.write_back)
         from veles_tpu.loader.base import TRAIN
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
