@@ -6,9 +6,10 @@
 // forward chain on CPU, for serving without a Python or JAX runtime.
 //
 // Scope matches the reference's: the classic znicz forward ops
-// (fully-connected, conv, max/avg pooling, LRN, activations, softmax) in
-// NHWC float32. Recurrent/attention layers are served through the
-// StableHLO/PJRT export instead (veles_tpu/export.py:export_stablehlo).
+// (fully-connected, conv, max/avg pooling, LRN, activations, softmax,
+// LSTM) in NHWC float32 — every reference-era model family serves
+// natively. Attention/transformer stacks (TPU-era additions) are served
+// through the StableHLO/PJRT export (veles_tpu/export.py:export_stablehlo).
 //
 // C API (ctypes-consumed by veles_tpu/native_engine.py):
 //   void* znicz_load(const char* package_dir);
@@ -261,6 +262,54 @@ void pool2d(const Tensor& x, int ky, int kx, int sy, int sx, bool is_max,
         }
 }
 
+// LSTM over time. x: (N, T, D); wx: (D, 4H), wh: (H, 4H), b: (4H).
+// Gate order [i, f, g, o] (ops/reference.py:lstm_step); plain tanh for
+// the candidate/cell (NOT the scaled all2all tanh). Output rows are the
+// per-timestep hidden states flattened to (N*T, H) — exactly the Python
+// LSTM unit's layout (znicz/lstm.py), so a following all2all/softmax
+// projection consumes per-timestep predictions unchanged.
+void lstm(const Tensor& x, const std::vector<float>& wx,
+          const std::vector<float>& wh, const std::vector<float>& b,
+          int hsz, Tensor* y) {
+  if (x.shape.size() != 3)
+    throw std::runtime_error("lstm expects (N, T, D) input");
+  int n = x.shape[0], T = x.shape[1], d = x.shape[2];
+  y->shape = {n * T, hsz};
+  y->data.assign((size_t)n * T * hsz, 0.f);
+  std::vector<float> h(hsz), c(hsz), z(4 * hsz);
+  auto sig = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+  for (int s = 0; s < n; ++s) {
+    std::fill(h.begin(), h.end(), 0.f);
+    std::fill(c.begin(), c.end(), 0.f);
+    for (int t = 0; t < T; ++t) {
+      const float* xt = x.data.data() + ((size_t)s * T + t) * d;
+      std::copy(b.begin(), b.end(), z.begin());
+      for (int i = 0; i < d; ++i) {
+        float xv = xt[i];
+        if (xv == 0.f) continue;  // one-hot char inputs are mostly zero
+        const float* wr = wx.data() + (size_t)i * 4 * hsz;
+        for (int g = 0; g < 4 * hsz; ++g) z[g] += xv * wr[g];
+      }
+      for (int i = 0; i < hsz; ++i) {
+        float hv = h[i];
+        if (hv == 0.f) continue;
+        const float* wr = wh.data() + (size_t)i * 4 * hsz;
+        for (int g = 0; g < 4 * hsz; ++g) z[g] += hv * wr[g];
+      }
+      float* out = y->data.data() + ((size_t)s * T + t) * hsz;
+      for (int i = 0; i < hsz; ++i) {
+        float ig = sig(z[i]);
+        float fg = sig(z[hsz + i]);
+        float gg = std::tanh(z[2 * hsz + i]);
+        float og = sig(z[3 * hsz + i]);
+        c[i] = fg * c[i] + ig * gg;
+        h[i] = og * std::tanh(c[i]);
+        out[i] = h[i];
+      }
+    }
+  }
+}
+
 // AlexNet-style across-channel LRN.
 void lrn(const Tensor& x, float k, float alpha, float beta, int nwin,
          Tensor* y) {
@@ -299,6 +348,9 @@ struct Layer {
   float scale = 1.f, offset = 0.f;  // "affine" (input_normalize export)
   std::vector<int> w_shape;
   std::vector<float> weights, bias;
+  // third packed array for ops with >2 params (lstm: [wx, wh, b] ->
+  // weights, w2, bias)
+  std::vector<float> w2;
 };
 
 struct Engine {
@@ -383,7 +435,13 @@ Engine* load_package(const std::string& dir) {
       l.weights = read_blob(pool, arrays[0]);
       for (const auto& d : arrays[0].at("shape").arr)
         l.w_shape.push_back((int)d.num);
-      if (arrays.size() > 1) l.bias = read_blob(pool, arrays[1]);
+      // 2 arrays: [weights, bias]; 3 arrays: [weights, w2, bias]
+      if (arrays.size() == 2) {
+        l.bias = read_blob(pool, arrays[1]);
+      } else if (arrays.size() == 3) {
+        l.w2 = read_blob(pool, arrays[1]);
+        l.bias = read_blob(pool, arrays[2]);
+      }
     }
     eng->layers.push_back(std::move(l));
   }
@@ -411,6 +469,16 @@ void run_forward(Engine* eng, Tensor* t) {
       pool2d(*t, l.ky, l.kx, l.sy, l.sx, true, l.use_abs, &out);
     } else if (l.type == "avg_pooling") {
       pool2d(*t, l.ky, l.kx, l.sy, l.sx, false, false, &out);
+    } else if (l.type == "lstm") {
+      // arrays = [wx (D,4H), wh (H,4H), b (4H)] (export.py:_export_lstm)
+      int hsz = l.w_shape[1] / 4;
+      if (t->shape.size() != 3 ||
+          l.weights.size() != (size_t)t->shape[2] * 4 * hsz)
+        throw std::runtime_error("lstm wx size does not match input");
+      if (l.w2.size() != (size_t)hsz * 4 * hsz ||
+          l.bias.size() != 4 * (size_t)hsz)
+        throw std::runtime_error("lstm wh/b blob size mismatch");
+      lstm(*t, l.weights, l.w2, l.bias, hsz, &out);
     } else if (l.type == "lrn") {
       lrn(*t, l.k, l.alpha, l.beta, l.nwin, &out);
     } else if (l.type == "activation") {

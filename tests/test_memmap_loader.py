@@ -268,3 +268,39 @@ def test_prefetch_master_indices_override(tmp_path):
     np.testing.assert_array_equal(loader.minibatch_labels.mem,
                                   labels[master_idx])
     loader.stop()
+
+
+def test_native_gather_matches_numpy(tmp_path):
+    """The C++ multithreaded gather (native/host_gather.cpp) is an exact
+    twin of the numpy path: float32 + mean path, uint8 path, and the
+    seeded hflip augmentation all agree bit-for-bit."""
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    from veles_tpu import native_gather
+    if not native_gather.available():
+        pytest.skip("native gather did not build")
+    out, data, labels = make_packed(tmp_path, n=96, hw=8, n_valid=24)
+
+    def run_loader(native, emit, hflip):
+        prng.seed_all(11)
+        loader = mm.MemmapImageLoader(
+            data_path=out, minibatch_size=16, shuffle_train=False,
+            native=native, emit=emit, hflip=hflip)
+        loader.initialize(device=None)
+        got = []
+        for _ in range(6):                 # a full epoch of 96/16
+            loader.run()
+            got.append((loader.minibatch_data.mem.copy(),
+                        loader.minibatch_labels.mem.copy()))
+        loader.stop()
+        return got
+
+    for emit in ("float32", "uint8"):
+        for hflip in (False, True):
+            a = run_loader("auto", emit, hflip)
+            b = run_loader("off", emit, hflip)
+            for (xa, ya), (xb, yb) in zip(a, b):
+                np.testing.assert_array_equal(
+                    xa, xb, err_msg=f"emit={emit} hflip={hflip}")
+                np.testing.assert_array_equal(ya, yb)

@@ -171,3 +171,33 @@ def test_input_normalize_package_matches_golden(tmp_path):
     with NativeEngine(pkg) as eng:
         got = eng.infer(x)
     np.testing.assert_allclose(got, gold, rtol=2e-5, atol=2e-6)
+
+
+def test_lstm_package_matches_golden(tmp_path):
+    """The char-LSTM family serves natively: a trained CharLSTM workflow
+    exports and the C++ scan reproduces the numpy golden BPTT twin's
+    forward (per-timestep hiddens + softmax projection)."""
+    from veles_tpu.config import root
+    from veles_tpu.samples.char_lstm import create_workflow
+    prng.seed_all(1234)
+    root.char_lstm.loader.minibatch_size = 8
+    root.char_lstm.loader.seq_len = 12
+    root.char_lstm.n_units = 16
+    root.char_lstm.decision.max_epochs = 1
+    wf = create_workflow()
+    wf.initialize(device=NumpyDevice())
+    wf.run()  # one epoch so exported weights are trained, not init noise
+
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = wf.loader.data.mem[:5]          # (5, T, V) one-hot frames
+    gold = python_forward(wf, x)        # (5*T, V) per-timestep softmax
+    with NativeEngine(pkg) as eng:
+        assert eng.input_size == x.shape[1] * x.shape[2]
+        got = eng.infer(x)              # (5, T*V)
+    T, V = x.shape[1], gold.shape[1]
+    assert eng.output_size == T * V
+    np.testing.assert_allclose(got.reshape(5 * T, V), gold,
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got.reshape(5 * T, V).sum(1), 1.0,
+                               rtol=1e-5)

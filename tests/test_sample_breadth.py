@@ -1,0 +1,57 @@
+"""Functional tests for the breadth samples MnistSimple and VideoAE
+(SURVEY.md §2.8 samples row) — the reference's seeded few-epoch pattern
+(SURVEY.md §4): pinned seeds, train a few epochs, assert the metric
+trajectory beats chance / the untrained loss."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice, XLADevice
+from veles_tpu.config import root
+
+
+def test_mnist_simple_trains():
+    from veles_tpu.samples.mnist_simple import create_workflow
+    prng.seed_all(1234)
+    root.mnist_simple.loader.n_train = 500
+    root.mnist_simple.loader.n_validation = 100
+    root.mnist_simple.decision.max_epochs = 3
+    wf = create_workflow()
+    wf.initialize(device=XLADevice())
+    wf.run()
+    # one softmax layer on separable prototypes: far below the 90-error
+    # chance line after 3 epochs
+    assert wf.decision.epoch_number == 3
+    assert wf.decision.best_validation_err <= 25, \
+        wf.decision.best_validation_err
+    assert len(wf.forwards) == 1  # it really is the one-matmul sample
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_video_ae_reconstructs(device_cls):
+    from veles_tpu.samples.video_ae import create_workflow
+    prng.seed_all(1234)
+    root.video_ae.loader.n_train = 300
+    root.video_ae.loader.n_validation = 60
+    wf = create_workflow()
+    wf.initialize(device=device_cls())
+    wf.run()
+    # predicting the mean frame scores the per-sample summed squared
+    # error below (EvaluatorMSE's loss unit); the code bottleneck must
+    # reconstruct far better than that
+    flat = wf.loader.data.mem
+    mean_pred = float(((flat - flat.mean(0)) ** 2).sum(1).mean())
+    best = wf.decision.best_validation_err  # EvaluatorMSE: n_err == MSE
+    assert best < 0.5 * mean_pred, (best, mean_pred)
+
+
+def test_video_frames_are_temporally_coherent():
+    """The synthetic video is a video, not shuffled noise: consecutive
+    frames within a sequence are much closer than frames across
+    sequences."""
+    from veles_tpu.samples.video_ae import make_video
+    f = make_video(40, 12, seq_len=10, noise=0.05)
+    within = np.mean((f[1:10] - f[0:9]) ** 2)
+    across = np.mean((f[10] - f[9]) ** 2)
+    assert within < 0.5 * across, (within, across)

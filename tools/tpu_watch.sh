@@ -11,8 +11,11 @@ END=$((SECONDS + ${TPU_WATCH_BUDGET_S:-39600}))
 log() { echo "$(date -u +%H:%M:%S) $*" >> tpu_watch/log.txt; }
 log "watcher start"
 while [ $SECONDS -lt $END ]; do
-  if timeout 50 python -c "import jax; print(jax.devices())" \
-       > tpu_watch/probe.txt 2>&1; then
+  if timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+print(jax.jit(lambda a: (a @ a).sum())(x))
+" > tpu_watch/probe.txt 2>&1; then
     log "tunnel UP: $(cat tpu_watch/probe.txt | tail -1)"
     timeout 600 python bench.py \
       > tpu_watch/bench_out.txt 2> tpu_watch/bench_err.txt

@@ -83,6 +83,13 @@ def _export_activation(u):
     return ({"type": "activation", "activation": u.activation}, [])
 
 
+@_exporter("LSTM")
+def _export_lstm(u):
+    # engine convention for 3-array layers: [main, secondary, bias]
+    return ({"type": "lstm", "n_units": int(u.n_units)},
+            [u.wx.mem, u.wh.mem, u.b.mem])
+
+
 @_exporter("InputNormalize")
 def _export_input_normalize(u):
     # serving twin of the on-device normalize: the C++ engine applies
@@ -97,8 +104,9 @@ def _export_input_normalize(u):
 def export_workflow(workflow, directory: str) -> str:
     """Write topology.json + weights.bin for the workflow's forward chain.
     Returns the package directory. Raises on layers with no native twin
-    (LSTM/attention are jit/StableHLO-served, not C++-served — documented
-    non-goal matching the reference's CPU-forward-only libZnicz)."""
+    (attention/transformer stacks are jit/StableHLO-served, not
+    C++-served — the TPU-era additions; every reference-era family incl.
+    LSTM has a native twin in native/znicz_engine.cpp)."""
     os.makedirs(directory, exist_ok=True)
     blobs: List[np.ndarray] = []
     layers: List[Dict[str, Any]] = []

@@ -230,13 +230,16 @@ class PrefetchingLoader(Loader):
     def _produce_batch(self, indices: np.ndarray):
         raise NotImplementedError
 
-    def _augment(self, x: np.ndarray, indices: np.ndarray) -> np.ndarray:
-        """Seeded per-(sample, epoch) horizontal flip of TRAIN rows. A
-        stateless integer hash decides each coin so produce threads need
-        no shared RNG state and re-visits flip identically within an
-        epoch but differently across epochs."""
-        if not self.hflip or x.ndim < 3:
-            return x
+    def _flip_mask(self, indices: np.ndarray) -> Optional[np.ndarray]:
+        """Per-(sample, epoch) horizontal-flip coins for TRAIN rows, or
+        None when augmentation is off. A stateless integer hash decides
+        each coin so produce threads need no shared RNG state and
+        re-visits flip identically within an epoch but differently
+        across epochs. Shared by the numpy `_augment` path and the
+        native gather (loader/memmap.py), which folds the flip into its
+        row copy."""
+        if not self.hflip:
+            return None
         train_lo = self.class_lengths[TEST] + self.class_lengths[VALIDATION]
         h = (indices.astype(np.uint64) * np.uint64(2654435761)
              + np.uint64(self.epoch_number + 1) * np.uint64(0x9E3779B9)
@@ -245,7 +248,14 @@ class PrefetchingLoader(Loader):
         h *= np.uint64(0x2545F4914F6CDD1D)
         flip = ((h >> np.uint64(32)) & np.uint64(1)).astype(bool)
         flip &= indices >= train_lo
-        if flip.any():
+        return flip
+
+    def _augment(self, x: np.ndarray, indices: np.ndarray) -> np.ndarray:
+        """Seeded horizontal flip of TRAIN rows (see _flip_mask)."""
+        if x.ndim < 3:
+            return x
+        flip = self._flip_mask(indices)
+        if flip is not None and flip.any():
             x = np.ascontiguousarray(x)
             x[flip] = x[flip, :, ::-1]
         return x

@@ -133,13 +133,18 @@ class MemmapImageLoader(PrefetchingLoader):
 
     def __init__(self, workflow=None, data_path: str = "",
                  mean_normalize: bool = True, emit: str = "float32",
-                 preload="auto",
+                 preload="auto", native: str = "auto",
                  n_workers: int = 2, prefetch: int = 2,
                  **kwargs: Any) -> None:
         super().__init__(workflow, n_workers=n_workers, prefetch=prefetch,
                          **kwargs)
         self.data_path = data_path
         self.mean_normalize = mean_normalize
+        #: "auto": use the C++ multithreaded gather (native/host_gather
+        #: .cpp) when the toolchain builds it — row copies + flip +
+        #: normalize fan out over threads instead of numpy's single-
+        #: threaded fancy-index path; "off" forces numpy (golden twin)
+        self.native = native
         #: "float32" — normalized floats leave the host (golden path);
         #: "uint8"  — RAW bytes leave the host and normalization runs ON
         #: DEVICE (pair with a leading {"type": "input_normalize"}
@@ -194,25 +199,67 @@ class MemmapImageLoader(PrefetchingLoader):
 
     # -- gather ----------------------------------------------------------------
 
-    def _produce_batch(self, indices: np.ndarray):
-        return self._gather(indices)
+    def _use_native(self) -> bool:
+        if self.native == "off":
+            return False
+        from veles_tpu import native_gather
+        return native_gather.available()
 
-    def _gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        shard = np.searchsorted(self._shard_lo, indices, "right") - 1
-        rows = indices - self._shard_lo[shard]
-        # vectorized per-shard fancy-index gather (C-level row copies that
-        # release the GIL, so prefetch workers truly parallelize), then
-        # scatter back to minibatch order
-        u8 = np.empty((len(indices),) + self._maps[0].shape[1:], np.uint8)
-        for s in np.unique(shard):
-            sel = shard == s
-            u8[sel] = self._maps[s][rows[sel]]
-        if self.emit == "uint8":
-            return u8, self._labels[indices]
+    def _produce(self, indices: np.ndarray):
+        """Gather + seeded hflip + normalize, with augmentation applied
+        to the RAW BYTES before normalization (a flipped training image
+        must be normalized exactly like any other image — the mean image
+        is not flipped with it; both emit modes and both gather paths
+        agree on this order). The generic `_augment` post-hook is
+        superseded, so it must not run again."""
+        x, y = self._gather(indices, self._flip_mask(indices))
+        return x, y
+
+    def _produce_batch(self, indices: np.ndarray):
+        return self._gather(indices, None)
+
+    def _normalize(self, u8: np.ndarray) -> np.ndarray:
         x = u8.astype(np.float32) / 127.5 - 1.0
         if self.mean_image is not None:
             x -= self.mean_image
-        return x, self._labels[indices]
+        return x
+
+    def _gather(self, indices: np.ndarray,
+                flip: Optional[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+        shape = self._maps[0].shape[1:]
+        if len(shape) < 2:   # flips are for image-shaped samples only
+            flip = None
+        shard = np.searchsorted(self._shard_lo, indices, "right") - 1
+        rows = indices - self._shard_lo[shard]
+        if self._use_native():
+            from veles_tpu import native_gather
+            row_bytes = int(np.prod(shape))
+            bases = np.asarray([m.ctypes.data for m in self._maps],
+                               np.int64)
+            src = bases[shard] + rows.astype(np.int64) * row_bytes
+            w, c = ((shape[1], shape[2]) if len(shape) == 3
+                    else (shape[-1], 1))
+            if self.emit == "uint8":
+                out = np.empty((len(indices),) + shape, np.uint8)
+                native_gather.gather_u8(src, row_bytes, out, flip, w, c)
+            else:
+                out = np.empty((len(indices),) + shape, np.float32)
+                native_gather.gather_f32(src, row_bytes, out,
+                                         self.mean_image, 127.5, -1.0,
+                                         flip, w, c)
+            return out, self._labels[indices]
+        # numpy twin: vectorized per-shard fancy-index gather (C-level row
+        # copies that release the GIL, so prefetch workers truly
+        # parallelize), then scatter back to minibatch order
+        u8 = np.empty((len(indices),) + shape, np.uint8)
+        for s in np.unique(shard):
+            sel = shard == s
+            u8[sel] = self._maps[s][rows[sel]]
+        if flip is not None and flip.any():
+            u8[flip] = u8[flip, :, ::-1]
+        if self.emit == "uint8":
+            return u8, self._labels[indices]
+        return self._normalize(u8), self._labels[indices]
 
     def __getstate__(self):
         d = super().__getstate__()

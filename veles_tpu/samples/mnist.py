@@ -53,8 +53,11 @@ def _load_idx(path: str):
         return np.frombuffer(f.read(), np.uint8).reshape(dims)
 
 
-def make_loader() -> FullBatchLoader:
-    cfg = root.mnist.loader
+def make_loader(cfg=None) -> FullBatchLoader:
+    """Build the MNIST loader from a config node (default
+    `root.mnist.loader`; `samples/mnist_simple.py` passes its own)."""
+    if cfg is None:
+        cfg = root.mnist.loader
     if cfg.data_path:
         data = _load_idx(f"{cfg.data_path}/train-images-idx3-ubyte.gz")
         labels = _load_idx(f"{cfg.data_path}/train-labels-idx1-ubyte.gz")

@@ -1,0 +1,54 @@
+"""MnistSimple — the reference's minimal one-matmul MNIST sample
+(`veles/znicz/samples/MnistSimple`, SURVEY.md §2.8 samples row): a single
+All2AllSoftmax layer straight from pixels to class logits. It exists as
+the smallest possible StandardWorkflow — the "hello world" a reference
+user reaches for before the two-layer `samples/mnist.py`.
+
+Data note: zero-egress environment — trains on the deterministic
+synthetic MNIST-shaped dataset unless `root.mnist_simple.loader.data_path`
+points at on-disk IDX files (same contract as `samples/mnist.py`).
+
+Exposes the reference's `run(load, main)` module convention.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.mnist_simple.loader.minibatch_size = 100
+root.mnist_simple.loader.n_validation = 200
+root.mnist_simple.loader.n_train = 1000
+root.mnist_simple.loader.data_path = ""
+root.mnist_simple.layers = [
+    {"type": "softmax", "output_sample_shape": 10, "weights_stddev": 0.05},
+]
+root.mnist_simple.decision.max_epochs = 5
+root.mnist_simple.decision.fail_iterations = 25
+root.mnist_simple.gd.learning_rate = 0.1
+root.mnist_simple.gd.gradient_moment = 0.9
+
+
+class MnistSimpleWorkflow(StandardWorkflow):
+    """All2AllSoftmax(10) — logistic regression on pixels."""
+
+
+def create_workflow() -> MnistSimpleWorkflow:
+    # share samples/mnist.py's loader factory (incl. the on-disk IDX
+    # path) but read this sample's config subtree
+    from veles_tpu.samples import mnist
+
+    cfg = root.mnist_simple
+    loader = mnist.make_loader(cfg.loader)
+    return MnistSimpleWorkflow(
+        layers=cfg.layers,
+        loader=loader,
+        loss="softmax", n_classes=10,
+        decision_config=cfg.decision.to_dict(),
+        gd_config=cfg.gd.to_dict(),
+        name="MnistSimpleWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()

@@ -1,0 +1,114 @@
+"""VideoAE — fully-connected autoencoder over video frames, the
+reference's `veles/znicz/samples/VideoAE` slot (SURVEY.md §2.8 samples
+row). The upstream sample learned a compact code for frames of a video
+stream with an All2All encoder/decoder trained on per-frame MSE; this
+build keeps that shape: frames are samples, the workflow is
+All2AllTanh(code) → All2All(frame) with `loss="mse"` against the input
+frame (StandardWorkflow's MSE path), so it exercises the FC-autoencoder
+path that the conv autoencoder sample (`samples/autoencoder.py`) does
+not.
+
+Data note: zero-egress environment — frames come from a deterministic
+synthetic "video": a 2-D Gaussian blob translating with constant
+per-sequence velocity plus pixel noise (temporally coherent, learnable).
+Point `root.video_ae.loader.data_path` at a `.npy` of shape (N, H, W)
+to train on real frames instead.
+
+Exposes the reference's `run(load, main)` module convention.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.video_ae.loader.minibatch_size = 50
+root.video_ae.loader.n_validation = 100
+root.video_ae.loader.n_train = 500
+root.video_ae.loader.frame_hw = 12
+root.video_ae.loader.seq_len = 10
+root.video_ae.loader.noise = 0.05
+root.video_ae.loader.data_path = ""
+root.video_ae.code_size = 32
+root.video_ae.decision.max_epochs = 12
+root.video_ae.decision.fail_iterations = 40
+root.video_ae.gd.learning_rate = 0.03
+root.video_ae.gd.gradient_moment = 0.9
+
+
+def make_video(n_frames: int, hw: int, seq_len: int, noise: float,
+               seed: int = 515) -> np.ndarray:
+    """(n_frames, hw, hw) float32 frames: per-sequence random start +
+    velocity, blob drifts across the frame (wrapping), gaussian pixel
+    noise. Deterministic for a given seed."""
+    rng = np.random.RandomState(seed)
+    n_seq = -(-n_frames // seq_len)
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32)
+    frames = []
+    for _ in range(n_seq):
+        pos = rng.uniform(0, hw, 2)
+        vel = rng.uniform(-1.5, 1.5, 2)
+        for _t in range(seq_len):
+            cy, cx = pos % hw
+            blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2)
+                            / (2 * (hw / 6.0) ** 2)))
+            frames.append(blob + noise * rng.randn(hw, hw))
+            pos = pos + vel
+    return np.asarray(frames[:n_frames], np.float32)
+
+
+class SyntheticVideoLoader(FullBatchLoader):
+    """FullBatchLoader over synthetic video frames; targets = inputs
+    (flattened) so StandardWorkflow's MSE path reconstructs the frame."""
+
+    def __init__(self, workflow=None, frame_hw: int = 12, seq_len: int = 10,
+                 n_validation: int = 100, n_train: int = 500,
+                 noise: float = 0.05, data_path: str = "",
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.frame_hw = frame_hw
+        self.seq_len = seq_len
+        self.split: Tuple[int, int, int] = (0, n_validation, n_train)
+        self.noise = noise
+        self.data_path = data_path
+
+    def load_data(self) -> None:
+        n = sum(self.split)
+        if self.data_path:
+            frames = np.load(self.data_path).astype(np.float32)[:n]
+            assert frames.ndim == 3, "expected (N, H, W) frames"
+        else:
+            frames = make_video(n, self.frame_hw, self.seq_len, self.noise)
+        flat = frames.reshape(len(frames), -1)
+        self.bind_arrays(flat, flat.copy(), *self.split)
+
+
+def create_workflow() -> StandardWorkflow:
+    cfg = root.video_ae
+    loader = SyntheticVideoLoader(
+        frame_hw=cfg.loader.frame_hw, seq_len=cfg.loader.seq_len,
+        n_validation=cfg.loader.n_validation, n_train=cfg.loader.n_train,
+        noise=cfg.loader.noise, data_path=cfg.loader.data_path,
+        minibatch_size=cfg.loader.minibatch_size)
+    d = int(cfg.loader.frame_hw) ** 2
+    return StandardWorkflow(
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": cfg.code_size,
+             "weights_stddev": 0.1},
+            {"type": "all2all", "output_sample_shape": d,
+             "weights_stddev": 0.1},
+        ],
+        loader=loader, loss="mse",
+        decision_config=cfg.decision.to_dict(),
+        gd_config=cfg.gd.to_dict(),
+        name="VideoAEWorkflow")
+
+
+def run(load, main):
+    load(create_workflow)
+    main()
