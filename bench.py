@@ -155,9 +155,17 @@ def child_main() -> None:
     state = step.init_state()
     train_flops, layer_gflops = analytic_flops_per_sample(step)
 
-    rng = np.random.RandomState(0)
-    x = jax.device_put(rng.randn(batch, 227, 227, 3).astype(np.float32))
-    y = jax.device_put(rng.randint(0, 64, batch))
+    # Synthesize the batch ON DEVICE: device_put of a batch-1024 f32
+    # image tensor is ~630 MB of H2D through the remote tunnel, and the
+    # tunnel's post-execution transfer throttling (BASELINE.md e2e
+    # section) can stall exactly that put for minutes if anything ran
+    # before us in the driver's capture window. A jitted PRNG program
+    # transfers nothing and leaves the batch resident.
+    import jax.numpy as jnp
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.jit(lambda k: jax.random.normal(
+        k, (batch, 227, 227, 3), jnp.float32))(k1)
+    y = jax.jit(lambda k: jax.random.randint(k, (batch,), 0, 64))(k2)
 
     def sync(st):
         # block_until_ready is not a reliable barrier through the remote
@@ -378,9 +386,14 @@ def supervise() -> int:
     signal.signal(signal.SIGINT, on_signal)
 
     env = dict(os.environ, BENCH_CHILD="1")
+    # keep enough deadline for the degraded batch-128 fallback below; a
+    # same-config retry has never rescued a hung tunnel (r3, r4), the
+    # smaller program sometimes can
+    degraded_reserve = (120.0 if os.environ.get("BENCH_MODE") != "e2e"
+                        and BATCH > 128 else 0.0)
     for attempt in range(1, ATTEMPTS + 1):
         state["attempt"] = attempt
-        budget = min(CHILD_TIMEOUT_S, remaining() - 10.0)
+        budget = min(CHILD_TIMEOUT_S, remaining() - 10.0 - degraded_reserve)
         if budget < MIN_ATTEMPT_S:
             state["last_err"] += " | deadline exhausted before retry"
             break
@@ -436,6 +449,44 @@ def supervise() -> int:
                 f"{state['last_err']}; retrying in {BACKOFF_S:.0f}s "
                 f"({remaining():.0f}s of budget left)\n")
             time.sleep(BACKOFF_S)
+
+    # DEGRADED last resort: the default-batch program hung/failed, but a
+    # marginal tunnel often still runs smaller programs (r4 session: a
+    # 256x256 probe matmul succeeded minutes before the batch-1024 bench
+    # hung). One attempt at batch 128 / shorter windows leaves a REAL
+    # measured value — honestly labeled — instead of value:null.
+    if (os.environ.get("BENCH_MODE") != "e2e" and BATCH > 128
+            and remaining() > MIN_ATTEMPT_S + 5.0):
+        sys.stderr.write(
+            f"bench: degraded batch-128 attempt "
+            f"({remaining():.0f}s of budget left)\n")
+        denv = dict(env, BENCH_BATCH="128", BENCH_STEPS="10")
+        try:
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=denv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            state["child"] = child
+            out, _err = child.communicate(timeout=remaining() - 5.0)
+            state["child"] = None
+            lines = [ln for ln in (out or "").splitlines() if ln.strip()]
+            if child.returncode == 0 and lines:
+                rec = json.loads(lines[-1])
+                if isinstance(rec, dict) and rec.get("value") is not None:
+                    rec["degraded"] = (
+                        "default-batch attempts failed "
+                        f"({state['last_err'][:200]}); value is "
+                        "a real batch-128 measurement")
+                    _emit(rec)
+                    return 0
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            try:
+                child.kill()
+            except Exception:
+                pass
+            state["child"] = None
+            state["last_err"] += " | degraded batch-128 attempt also failed"
+
     _emit(_error_record(state["last_err"], state["attempt"]))
     return 0
 

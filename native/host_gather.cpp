@@ -43,10 +43,12 @@ inline void copy_row_u8(const unsigned char* s, unsigned char* d,
   }
 }
 
+// Fan row ranges [lo, hi) over up to n_threads threads; range-based so
+// workers can keep per-thread scratch (the f32 path's staging row).
 template <typename Fn>
-void parallel_rows(int n, int n_threads, Fn fn) {
+void parallel_ranges(int n, int n_threads, Fn fn) {
   if (n_threads <= 1 || n < 2) {
-    for (int i = 0; i < n; ++i) fn(i);
+    fn(0, n);
     return;
   }
   if (n_threads > n) n_threads = n;
@@ -56,9 +58,7 @@ void parallel_rows(int n, int n_threads, Fn fn) {
   for (int t = 0; t < n_threads; ++t) {
     int lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
     if (lo >= hi) break;
-    ts.emplace_back([lo, hi, &fn] {
-      for (int i = lo; i < hi; ++i) fn(i);
-    });
+    ts.emplace_back([lo, hi, &fn] { fn(lo, hi); });
   }
   for (auto& t : ts) t.join();
 }
@@ -70,20 +70,18 @@ extern "C" {
 void hg_gather_u8(const long long* src, int n, long long row_bytes,
                   unsigned char* out, const unsigned char* flip, int w,
                   int c, int n_threads) {
-  parallel_rows(n, n_threads, [&](int i) {
-    copy_row_u8(reinterpret_cast<const unsigned char*>((intptr_t)src[i]),
-                out + (size_t)i * row_bytes, row_bytes,
-                flip != nullptr && flip[i] != 0, w, c);
+  parallel_ranges(n, n_threads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i)
+      copy_row_u8(reinterpret_cast<const unsigned char*>((intptr_t)src[i]),
+                  out + (size_t)i * row_bytes, row_bytes,
+                  flip != nullptr && flip[i] != 0, w, c);
   });
 }
 
 void hg_gather_f32(const long long* src, int n, long long row_bytes,
                    float* out, const float* mean, float scale, float offset,
                    const unsigned char* flip, int w, int c, int n_threads) {
-  if (n_threads <= 1) n_threads = 1;
-  std::vector<std::thread> ts;
-  int chunk = (n + n_threads - 1) / n_threads;
-  auto work = [&](int lo, int hi) {
+  parallel_ranges(n, n_threads, [&](int lo, int hi) {
     // thread-local staging row: flips land here as raw bytes so the
     // u8 -> f32 convert below stays a straight vectorizable loop
     std::vector<unsigned char> staged((size_t)row_bytes);
@@ -105,17 +103,7 @@ void hg_gather_f32(const long long* src, int n, long long row_bytes,
           d[j] = (float)s[j] / scale + offset;
       }
     }
-  };
-  if (n_threads == 1 || n < 2) {
-    work(0, n);
-    return;
-  }
-  for (int t = 0; t < n_threads; ++t) {
-    int lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
-    if (lo >= hi) break;
-    ts.emplace_back(work, lo, hi);
-  }
-  for (auto& t : ts) t.join();
+  });
 }
 
 }  // extern "C"

@@ -227,7 +227,10 @@ class MemmapImageLoader(PrefetchingLoader):
     def _gather(self, indices: np.ndarray,
                 flip: Optional[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
         shape = self._maps[0].shape[1:]
-        if len(shape) < 2:   # flips are for image-shaped samples only
+        # flips are defined for (H, W) / (H, W, C) samples only — on any
+        # other rank the native w/c derivation below would disagree with
+        # the numpy twin's axis-1-of-sample flip, so turn them off
+        if len(shape) not in (2, 3):
             flip = None
         shard = np.searchsorted(self._shard_lo, indices, "right") - 1
         rows = indices - self._shard_lo[shard]

@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
@@ -24,6 +25,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libhostgather.so")
 
 _lib = None
 _lib_failed = False
+_lib_lock = threading.Lock()   # one make/dlopen even under concurrent use
 
 #: thread count for row fan-out; gather is memcpy-bound so a handful of
 #: threads saturates memory bandwidth — more just adds join overhead
@@ -33,6 +35,14 @@ DEFAULT_THREADS = min(8, os.cpu_count() or 1)
 def _load_lib() -> Optional[ctypes.CDLL]:
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
+        return _lib
+    with _lib_lock:
+        return _load_lib_locked()
+
+
+def _load_lib_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:   # built while we waited
         return _lib
     try:
         src = os.path.join(_NATIVE_DIR, "host_gather.cpp")
