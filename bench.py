@@ -477,6 +477,10 @@ def supervise() -> int:
                         "default-batch attempts failed "
                         f"({state['last_err'][:200]}); value is "
                         "a real batch-128 measurement")
+                    # vs_baseline compares same-batch protocols; a
+                    # batch-128 value over the batch-1024 floor would
+                    # read as a regression
+                    rec["vs_baseline"] = None
                     _emit(rec)
                     return 0
         except (subprocess.TimeoutExpired, ValueError, OSError):

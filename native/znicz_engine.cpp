@@ -5,11 +5,13 @@
 // (topology.json + weights.bin, see veles_tpu/export.py) and runs the
 // forward chain on CPU, for serving without a Python or JAX runtime.
 //
-// Scope matches the reference's: the classic znicz forward ops
-// (fully-connected, conv, max/avg pooling, LRN, activations, softmax,
-// LSTM) in NHWC float32 — every reference-era model family serves
-// natively. Attention/transformer stacks (TPU-era additions) are served
-// through the StableHLO/PJRT export (veles_tpu/export.py:export_stablehlo).
+// Scope: the classic znicz forward ops (fully-connected, conv, max/avg
+// pooling, LRN, activations, softmax, LSTM) in NHWC float32 — every
+// reference-era model family serves natively — plus the TPU-era
+// transformer units (seq_linear/attention/seq_ffn/seq_softmax,
+// znicz/transformer.py + znicz/attention.py) so the char-transformer
+// family serves too. MoE routing stays on the StableHLO/PJRT export
+// (veles_tpu/export.py:export_stablehlo).
 //
 // C API (ctypes-consumed by veles_tpu/native_engine.py):
 //   void* znicz_load(const char* package_dir);
@@ -166,6 +168,30 @@ float activate(const std::string& act, float x) {
   throw std::runtime_error("unknown activation: " + act);
 }
 
+// y (M, N_out) += x (M, K) @ w (K, N_out); y must be pre-initialized.
+// Skips zero inputs (one-hot token rows are mostly zero).
+void matmul_acc(const float* x, const float* w, float* y, int M, int K,
+                int N_out) {
+  for (int m = 0; m < M; ++m) {
+    const float* xr = x + (size_t)m * K;
+    float* yr = y + (size_t)m * N_out;
+    for (int k = 0; k < K; ++k) {
+      float xv = xr[k];
+      if (xv == 0.f) continue;
+      const float* wr = w + (size_t)k * N_out;
+      for (int o = 0; o < N_out; ++o) yr[o] += xv * wr[o];
+    }
+  }
+}
+
+void softmax_row(float* r, int n) {
+  float m = r[0];
+  for (int i = 1; i < n; ++i) m = std::max(m, r[i]);
+  float tot = 0.f;
+  for (int i = 0; i < n; ++i) { r[i] = std::exp(r[i] - m); tot += r[i]; }
+  for (int i = 0; i < n; ++i) r[i] /= tot;
+}
+
 // y[n, o] = act(sum_i x[n, i] w[i, o] + b[o]); x flattened per sample.
 void all2all(const Tensor& x, const std::vector<float>& w,
              const std::vector<float>& b, int in_dim, int out_dim,
@@ -173,24 +199,11 @@ void all2all(const Tensor& x, const std::vector<float>& w,
   int n = x.shape[0];
   y->shape = {n, out_dim};
   y->data.assign((size_t)n * out_dim, 0.f);
+  matmul_acc(x.data.data(), w.data(), y->data.data(), n, in_dim, out_dim);
   for (int s = 0; s < n; ++s) {
-    const float* xs = x.data.data() + (size_t)s * in_dim;
     float* ys = y->data.data() + (size_t)s * out_dim;
-    // blocked over input for cache friendliness
-    for (int i = 0; i < in_dim; ++i) {
-      float xv = xs[i];
-      if (xv == 0.f) continue;
-      const float* wr = w.data() + (size_t)i * out_dim;
-      for (int o = 0; o < out_dim; ++o) ys[o] += xv * wr[o];
-    }
     for (int o = 0; o < out_dim; ++o) ys[o] = activate(act, ys[o] + b[o]);
-    if (softmax) {
-      float m = ys[0];
-      for (int o = 1; o < out_dim; ++o) m = std::max(m, ys[o]);
-      float tot = 0.f;
-      for (int o = 0; o < out_dim; ++o) { ys[o] = std::exp(ys[o] - m); tot += ys[o]; }
-      for (int o = 0; o < out_dim; ++o) ys[o] /= tot;
-    }
+    if (softmax) softmax_row(ys, out_dim);
   }
 }
 
@@ -260,6 +273,103 @@ void pool2d(const Tensor& x, int ky, int kx, int sy, int sx, bool is_max,
           y->data[(((size_t)s * oh + i) * ow + j) * c + ci] =
               is_max ? best : sum / cnt;
         }
+}
+
+// Position-wise linear over (N, S, Din): y = act(x @ W + b [+ pos]).
+// softmax=true additionally applies a per-position softmax and flattens
+// to (N*S, V) — the SeqSoftmax layout (znicz/transformer.py).
+void seq_linear(const Tensor& x, const std::vector<float>& w,
+                const std::vector<float>& b, const std::vector<float>& pos,
+                int dout, const std::string& act, bool softmax, Tensor* y) {
+  if (x.shape.size() != 3)
+    throw std::runtime_error("seq_linear expects (N, S, D) input");
+  int n = x.shape[0], s = x.shape[1], din = x.shape[2];
+  if (softmax) y->shape = {n * s, dout};
+  else y->shape = {n, s, dout};
+  y->data.assign((size_t)n * s * dout, 0.f);
+  matmul_acc(x.data.data(), w.data(), y->data.data(), n * s, din, dout);
+  for (int r = 0; r < n * s; ++r) {
+    float* yr = y->data.data() + (size_t)r * dout;
+    const float* pr =
+        pos.empty() ? nullptr : pos.data() + (size_t)(r % s) * dout;
+    for (int o = 0; o < dout; ++o) {
+      float v = yr[o] + b[o] + (pr ? pr[o] : 0.f);
+      yr[o] = activate(act, v);
+    }
+    if (softmax) softmax_row(yr, dout);
+  }
+}
+
+// Transformer FFN block with residual: y = x + act(x@W1 + b1)@W2 + b2.
+void seq_ffn(const Tensor& x, const std::vector<float>& w1,
+             const std::vector<float>& b1, const std::vector<float>& w2,
+             const std::vector<float>& b2, int hidden,
+             const std::string& act, Tensor* y) {
+  if (x.shape.size() != 3)
+    throw std::runtime_error("seq_ffn expects (N, S, E) input");
+  int rows = x.shape[0] * x.shape[1], e = x.shape[2];
+  std::vector<float> mid((size_t)rows * hidden, 0.f);
+  matmul_acc(x.data.data(), w1.data(), mid.data(), rows, e, hidden);
+  for (int r = 0; r < rows; ++r)
+    for (int h = 0; h < hidden; ++h) {
+      float& v = mid[(size_t)r * hidden + h];
+      v = activate(act, v + b1[h]);
+    }
+  y->shape = x.shape;
+  y->data = x.data;  // residual base
+  matmul_acc(mid.data(), w2.data(), y->data.data(), rows, hidden, e);
+  for (int r = 0; r < rows; ++r)
+    for (int o = 0; o < e; ++o) y->data[(size_t)r * e + o] += b2[o];
+}
+
+// Multi-head self-attention (ops/attention.py:mha_forward semantics):
+// scale 1/sqrt(D), optional causal mask, softmax over keys; params
+// wq/wk/wv (E, H*D), wo (H*D, E); optional residual add.
+void attention(const Tensor& x, const std::vector<float>& wq,
+               const std::vector<float>& wk, const std::vector<float>& wv,
+               const std::vector<float>& wo, int head_dim, bool causal,
+               bool residual, Tensor* y) {
+  if (x.shape.size() != 3)
+    throw std::runtime_error("attention expects (N, S, E) input");
+  int n = x.shape[0], s = x.shape[1], e = x.shape[2];
+  int hd = (int)(wq.size() / e);           // H*D
+  int heads = hd / head_dim;
+  if (heads * head_dim != hd || (size_t)e * hd != wq.size())
+    throw std::runtime_error("attention wq shape mismatch");
+  float scale = 1.0f / std::sqrt((float)head_dim);
+  int rows = n * s;
+  std::vector<float> q((size_t)rows * hd, 0.f), k(q), v(q), o(q);
+  matmul_acc(x.data.data(), wq.data(), q.data(), rows, e, hd);
+  matmul_acc(x.data.data(), wk.data(), k.data(), rows, e, hd);
+  matmul_acc(x.data.data(), wv.data(), v.data(), rows, e, hd);
+  std::vector<float> sc(s);
+  for (int b = 0; b < n; ++b)
+    for (int h = 0; h < heads; ++h)
+      for (int qi = 0; qi < s; ++qi) {
+        const float* qr =
+            q.data() + ((size_t)b * s + qi) * hd + (size_t)h * head_dim;
+        int kmax = causal ? qi + 1 : s;
+        for (int ki = 0; ki < kmax; ++ki) {
+          const float* kr =
+              k.data() + ((size_t)b * s + ki) * hd + (size_t)h * head_dim;
+          float dot = 0.f;
+          for (int d = 0; d < head_dim; ++d) dot += qr[d] * kr[d];
+          sc[ki] = dot * scale;
+        }
+        softmax_row(sc.data(), kmax);
+        float* orow =
+            o.data() + ((size_t)b * s + qi) * hd + (size_t)h * head_dim;
+        for (int ki = 0; ki < kmax; ++ki) {
+          const float* vr =
+              v.data() + ((size_t)b * s + ki) * hd + (size_t)h * head_dim;
+          float p = sc[ki];
+          for (int d = 0; d < head_dim; ++d) orow[d] += p * vr[d];
+        }
+      }
+  y->shape = x.shape;
+  if (residual) y->data = x.data;
+  else y->data.assign((size_t)rows * e, 0.f);
+  matmul_acc(o.data(), wo.data(), y->data.data(), rows, hd, e);
 }
 
 // LSTM over time. x: (N, T, D); wx: (D, 4H), wh: (H, 4H), b: (4H).
@@ -346,11 +456,16 @@ struct Layer {
   float k = 2.f, alpha = 1e-4f, beta = 0.75f;
   int nwin = 5;
   float scale = 1.f, offset = 0.f;  // "affine" (input_normalize export)
+  int head_dim = 0;
+  bool causal = false, residual = false, pos_embed = false;
   std::vector<int> w_shape;
   std::vector<float> weights, bias;
   // third packed array for ops with >2 params (lstm: [wx, wh, b] ->
   // weights, w2, bias)
   std::vector<float> w2;
+  // full blob list for ops with >3 params (attention [wq,wk,wv,wo],
+  // seq_ffn [w1,b1,w2,b2]); weights/w2/bias stay empty for those
+  std::vector<std::vector<float>> arrs;
 };
 
 struct Engine {
@@ -430,17 +545,28 @@ Engine* load_package(const std::string& dir) {
     l.nwin = (int)lj.numval("n", 5);
     l.scale = (float)lj.numval("scale", 1.0);
     l.offset = (float)lj.numval("offset", 0.0);
+    l.head_dim = (int)lj.numval("head_dim", 0);
+    if (lj.has("causal")) l.causal = lj.at("causal").b;
+    if (lj.has("residual")) l.residual = lj.at("residual").b;
+    if (lj.has("pos_embed")) l.pos_embed = lj.at("pos_embed").b;
     const auto& arrays = lj.at("arrays").arr;
     if (!arrays.empty()) {
       l.weights = read_blob(pool, arrays[0]);
       for (const auto& d : arrays[0].at("shape").arr)
         l.w_shape.push_back((int)d.num);
-      // 2 arrays: [weights, bias]; 3 arrays: [weights, w2, bias]
+      // 2 arrays: [weights, bias]; 3 arrays: [weights, w2, bias];
+      // 4+ arrays: the full list goes to l.arrs instead (attention
+      // [wq,wk,wv,wo], seq_ffn [w1,b1,w2,b2]) — no double-read
       if (arrays.size() == 2) {
         l.bias = read_blob(pool, arrays[1]);
       } else if (arrays.size() == 3) {
         l.w2 = read_blob(pool, arrays[1]);
         l.bias = read_blob(pool, arrays[2]);
+      } else if (arrays.size() > 3) {
+        l.arrs.push_back(std::move(l.weights));
+        l.weights.clear();
+        for (size_t ai = 1; ai < arrays.size(); ++ai)
+          l.arrs.push_back(read_blob(pool, arrays[ai]));
       }
     }
     eng->layers.push_back(std::move(l));
@@ -469,6 +595,30 @@ void run_forward(Engine* eng, Tensor* t) {
       pool2d(*t, l.ky, l.kx, l.sy, l.sx, true, l.use_abs, &out);
     } else if (l.type == "avg_pooling") {
       pool2d(*t, l.ky, l.kx, l.sy, l.sx, false, false, &out);
+    } else if (l.type == "seq_linear" || l.type == "seq_softmax") {
+      // arrays: [weights, bias] or [weights, pos, bias] (pos_embed)
+      int dout = l.w_shape[1];
+      static const std::vector<float> kNoPos;
+      const std::vector<float>& pos = l.pos_embed ? l.w2 : kNoPos;
+      if (l.pos_embed && l.w2.empty())
+        throw std::runtime_error("seq_linear pos_embed without pos blob");
+      if (l.bias.size() != (size_t)dout)
+        throw std::runtime_error("seq_linear bias size mismatch");
+      seq_linear(*t, l.weights, l.bias, pos, dout, l.activation,
+                 l.type == "seq_softmax", &out);
+    } else if (l.type == "seq_ffn") {
+      // arrays: [w1 (E,H), b1 (H), w2 (H,E), b2 (E)]
+      if (l.arrs.size() != 4)
+        throw std::runtime_error("seq_ffn expects 4 arrays");
+      int hidden = l.w_shape[1];
+      seq_ffn(*t, l.arrs[0], l.arrs[1], l.arrs[2], l.arrs[3], hidden,
+              l.activation, &out);
+    } else if (l.type == "attention") {
+      // arrays: [wq, wk, wv, wo] each (E, H*D) / (H*D, E)
+      if (l.arrs.size() != 4 || l.head_dim <= 0)
+        throw std::runtime_error("attention expects 4 arrays + head_dim");
+      attention(*t, l.arrs[0], l.arrs[1], l.arrs[2], l.arrs[3],
+                l.head_dim, l.causal, l.residual, &out);
     } else if (l.type == "lstm") {
       // arrays = [wx (D,4H), wh (H,4H), b (4H)] (export.py:_export_lstm)
       int hsz = l.w_shape[1] / 4;

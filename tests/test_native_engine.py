@@ -201,3 +201,43 @@ def test_lstm_package_matches_golden(tmp_path):
                                rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(got.reshape(5 * T, V).sum(1), 1.0,
                                rtol=1e-5)
+
+
+def test_transformer_package_matches_golden(tmp_path):
+    """The dense char-transformer family serves natively: embedding
+    (seq_linear + learned positions), causal multi-head attention with
+    residual, FFN block, per-position softmax head — the C++ forward
+    reproduces the Python golden chain."""
+    import copy
+
+    from veles_tpu.config import root
+    from veles_tpu.samples.char_transformer import create_workflow
+    prng.seed_all(1234)
+    saved = copy.deepcopy(root.char_transformer)   # root is global state
+    root.char_transformer.loader.minibatch_size = 8
+    root.char_transformer.loader.seq_len = 12
+    root.char_transformer.embed = 16
+    root.char_transformer.n_heads = 2
+    root.char_transformer.ffn = 24
+    root.char_transformer.moe_experts = 0
+    root.char_transformer.decision.max_epochs = 1
+    root.char_transformer.parallel_mode = "local"
+    try:
+        wf = create_workflow()
+        wf.initialize(device=NumpyDevice())
+        wf.run()
+    finally:
+        root.char_transformer = saved
+
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = wf.loader.data.mem[:4]          # (4, S, V) one-hot
+    gold = python_forward(wf, x)        # (4*S, V) per-position probs
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)              # (4, S*V)
+    S, V = x.shape[1], gold.shape[1]
+    assert eng.output_size == S * V
+    np.testing.assert_allclose(got.reshape(4 * S, V), gold,
+                               rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(got.reshape(4 * S, V).sum(1), 1.0,
+                               rtol=1e-5)

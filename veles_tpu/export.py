@@ -90,6 +90,34 @@ def _export_lstm(u):
             [u.wx.mem, u.wh.mem, u.b.mem])
 
 
+@_exporter("SeqLinear", "SeqSoftmax")
+def _export_seq_linear(u):
+    # SeqSoftmax flattens to (N*S, V) with a per-position softmax — the
+    # engine mirrors that layout (native/znicz_engine.cpp:seq_linear)
+    spec = {"type": ("seq_softmax" if type(u).__name__ == "SeqSoftmax"
+                     else "seq_linear"),
+            "activation": u.activation}
+    arrays = [u.weights.mem]
+    if u.pos_embed:
+        spec["pos_embed"] = True
+        arrays.append(u.pos.mem)
+    arrays.append(u.bias.mem)
+    return spec, arrays
+
+
+@_exporter("SeqFFN")
+def _export_seq_ffn(u):
+    return ({"type": "seq_ffn", "activation": u.activation},
+            [u.weights.mem, u.bias.mem, u.w2.mem, u.b2.mem])
+
+
+@_exporter("MultiHeadAttention")
+def _export_attention(u):
+    return ({"type": "attention", "head_dim": int(u.head_dim),
+             "causal": bool(u.causal), "residual": bool(u.residual)},
+            [u.wq.mem, u.wk.mem, u.wv.mem, u.wo.mem])
+
+
 @_exporter("InputNormalize")
 def _export_input_normalize(u):
     # serving twin of the on-device normalize: the C++ engine applies
@@ -104,9 +132,9 @@ def _export_input_normalize(u):
 def export_workflow(workflow, directory: str) -> str:
     """Write topology.json + weights.bin for the workflow's forward chain.
     Returns the package directory. Raises on layers with no native twin
-    (attention/transformer stacks are jit/StableHLO-served, not
-    C++-served — the TPU-era additions; every reference-era family incl.
-    LSTM has a native twin in native/znicz_engine.cpp)."""
+    (only MoE routing remains jit/StableHLO-served; every reference-era
+    family incl. LSTM plus the dense transformer stack has a native twin
+    in native/znicz_engine.cpp)."""
     os.makedirs(directory, exist_ok=True)
     blobs: List[np.ndarray] = []
     layers: List[Dict[str, Any]] = []

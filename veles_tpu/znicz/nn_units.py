@@ -257,6 +257,13 @@ class GradientDescentVJP(GradientDescentBase):
         self._fn = self.jit(step, donate_argnums=(3,))
         return None
 
+    def numpy_init(self):
+        # the vjp is the only backward model on EVERY backend (there is
+        # no hand-derived numpy twin for these TPU-era families); build
+        # the same jitted step — Array.devmem falls back to default jax
+        # placement under NumpyDevice
+        return self.xla_init()
+
     def numpy_run(self) -> None:
         self.xla_run()  # vjp is the only backward model
 
