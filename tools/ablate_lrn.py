@@ -1,0 +1,65 @@
+"""On-chip A/B: fused AlexNet step with the XLA banded-matmul LRN vs the
+Pallas one-pass LRN (ops.pallas_kernels.lrn_pallas after the r4 rewrite:
+native-dtype HBM I/O, sqrt/rsqrt pow, static scalars).
+
+Usage: python tools/ablate_lrn.py [batch]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+K = 8
+
+
+def measure(name: str, prefer_pallas: bool) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.samples.alexnet import alexnet_layers
+    from veles_tpu.znicz.normalization import LRNormalizerForward
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    LRNormalizerForward.prefer_pallas = prefer_pallas
+    prng.seed_all(1)
+    loader = SyntheticClassifierLoader(
+        n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
+        n_train=128, minibatch_size=BATCH, noise=0.5)
+    wf = StandardWorkflow(
+        layers=alexnet_layers(64, 1.0, 4096), loader=loader, loss="softmax",
+        n_classes=64,
+        decision_config={"max_epochs": 1, "fail_iterations": 9},
+        gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
+        name=name)
+    wf.initialize(device=None)
+    step = wf.build_fused_step(compute_dtype="bfloat16")
+    state = step.init_state()
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.jit(lambda k: jax.random.normal(
+        k, (BATCH, 227, 227, 3), jnp.float32))(k1)
+    y = jax.jit(lambda k: jax.random.randint(k, (BATCH,), 0, 64))(k2)
+    state, _ = step.train_repeat(state, x, y, K)
+    np.asarray(state["params"][-1]["bias"][:1])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, _ = step.train_repeat(state, x, y, K)
+        np.asarray(state["params"][-1]["bias"][:1])
+        best = min(best, time.perf_counter() - t0)
+    rate = BATCH * K / best
+    print(f"ABLATE {name}: {rate:.0f} samples/s", flush=True)
+    return rate
+
+
+if __name__ == "__main__":
+    a = measure("xla-lrn", False)
+    b = measure("pallas-lrn", True)
+    print(f"pallas/xla = {b / a:.3f}", flush=True)

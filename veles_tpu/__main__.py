@@ -37,7 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="veles_tpu",
         description="Run a workflow: veles_tpu workflow.py [config.py] "
-                    "[root.path.key=value ...]")
+                    "[root.path.key=value ...]",
+        # --daemon re-execs the original argv minus the exact "--daemon"
+        # tokens; an abbreviated "--daemo" would survive that filter and
+        # respawn forever, so abbreviations are off
+        allow_abbrev=False)
     p.add_argument("workflow", help="workflow module (.py) with run(load, main)")
     p.add_argument("config", nargs="?", default="",
                    help="config module (.py) mutating the global root")
@@ -91,12 +95,53 @@ def build_parser() -> argparse.ArgumentParser:
                         "gradient as K scanned microbatches before the "
                         "single update (fused/distributed modes; "
                         "activation memory /K, numerics unchanged)")
+    p.add_argument("--daemon", default="", metavar="LOGFILE",
+                   help="run detached in the background (reference "
+                        "background/daemon mode): re-exec this command "
+                        "line in a new session with stdio redirected to "
+                        "LOGFILE, print the background pid on stdout and "
+                        "return immediately")
     p.add_argument("--optimize", type=int, default=0, metavar="GENERATIONS",
                    help="genetic hyperparameter search instead of a single "
                         "run: the workflow/config module must define "
                         "TUNABLES = [genetics.Tune(...)]; fitness is the "
                         "best validation error of each spawned run")
     return p
+
+
+def _daemonize(log_path: str, argv) -> int:
+    """Detach by RE-EXEC, not fork: spawn a fresh interpreter on the same
+    command line minus `--daemon`, in a new session, stdio → `log_path`,
+    and return its pid. A bare fork would inherit this process's runtime
+    threads (jax/absl start them at import) with whatever locks they
+    hold — re-exec gives the background run a clean process exactly like
+    the foreground one."""
+    import subprocess
+
+    log_path = os.path.abspath(log_path)
+    cmd = [sys.executable, "-m", "veles_tpu"]
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--daemon":
+            skip = True                       # drop the flag + its value
+            continue
+        if a.startswith("--daemon="):
+            continue
+        cmd.append(a)
+    logfd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    nullfd = os.open(os.devnull, os.O_RDONLY)
+    try:
+        child = subprocess.Popen(
+            cmd, stdin=nullfd, stdout=logfd, stderr=logfd,
+            start_new_session=True,           # own session: survives ctty
+            cwd=os.getcwd())
+    finally:
+        os.close(logfd)
+        os.close(nullfd)
+    return child.pid
 
 
 def main(argv=None) -> int:
@@ -106,6 +151,11 @@ def main(argv=None) -> int:
         # the first override to the config positional — reroute it
         args.overrides.insert(0, args.config)
         args.config = ""
+    if args.daemon:
+        daemon_pid = _daemonize(
+            args.daemon, argv if argv is not None else sys.argv[1:])
+        print(daemon_pid, flush=True)
+        return 0
     set_verbosity(args.verbose)
     if args.log_file:
         add_log_file(args.log_file)

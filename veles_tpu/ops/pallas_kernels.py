@@ -102,72 +102,73 @@ def sgd_update_pallas(p, g, v, lr: float, momentum: float = 0.0,
 # ---------------------------------------------------------------------------
 
 
-def _lrn_fwd_kernel(x_ref, scal_ref, y_ref, *, half: int):
-    k = scal_ref[0]
-    alpha = scal_ref[1]
-    beta = scal_ref[2]
-    x = x_ref[:]
-    sq = x * x
-    ssum = sq
+def _window_sum(a, half: int):
+    """±half across-channel window sum on a (rows, C) VMEM block."""
+    out = a
     for d in range(1, half + 1):
-        # shift along channels (last axis) with zero fill
-        ssum = ssum + jnp.pad(sq[:, d:], ((0, 0), (0, d))) \
-            + jnp.pad(sq[:, :-d], ((0, 0), (d, 0)))
-    y_ref[:] = x * jnp.exp(-beta * jnp.log(k + alpha * ssum))
+        out = out + jnp.pad(a[:, d:], ((0, 0), (0, d))) \
+            + jnp.pad(a[:, :-d], ((0, 0), (d, 0)))
+    return out
 
 
-def _lrn_bwd_kernel(x_ref, e_ref, scal_ref, out_ref, *, half: int):
-    k = scal_ref[0]
-    alpha = scal_ref[1]
-    beta = scal_ref[2]
-    x = x_ref[:]
-    err = e_ref[:]
-    sq = x * x
-    ssum = sq
-    for d in range(1, half + 1):
-        ssum = ssum + jnp.pad(sq[:, d:], ((0, 0), (0, d))) \
-            + jnp.pad(sq[:, :-d], ((0, 0), (d, 0)))
-    scale = k + alpha * ssum
-    t = err * x * jnp.exp((-beta - 1.0) * jnp.log(scale))
-    tsum = t
-    for d in range(1, half + 1):
-        tsum = tsum + jnp.pad(t[:, d:], ((0, 0), (0, d))) \
-            + jnp.pad(t[:, :-d], ((0, 0), (d, 0)))
-    out_ref[:] = err * jnp.exp(-beta * jnp.log(scale)) \
-        - 2.0 * alpha * beta * x * tsum
+# s^(−β) via sqrt/rsqrt products instead of exp/log — the SAME routine
+# the XLA lowering uses, imported so both lowerings share numerics
+from veles_tpu.ops.xla import _pow_neg_quarters as _pow_neg  # noqa: E402
+
+
+def _lrn_fwd_kernel(x_ref, y_ref, *, half: int, k: float, alpha: float,
+                    beta: float):
+    x = x_ref[:].astype(jnp.float32)
+    ssum = _window_sum(x * x, half)
+    y_ref[:] = (x * _pow_neg(k + alpha * ssum, beta)).astype(y_ref.dtype)
+
+
+def _lrn_bwd_kernel(x_ref, e_ref, out_ref, *, half: int, k: float,
+                    alpha: float, beta: float):
+    x = x_ref[:].astype(jnp.float32)
+    err = e_ref[:].astype(jnp.float32)
+    s = k + alpha * _window_sum(x * x, half)
+    d = _pow_neg(s, beta)                     # s^(−β)
+    tsum = _window_sum(err * x * d / s, half)  # W(g·x·s^(−β−1))
+    out_ref[:] = (err * d
+                  - 2.0 * alpha * beta * x * tsum).astype(out_ref.dtype)
 
 
 def _lrn_call(kernel, args, c: int, k, alpha, beta, n: int):
     """Common wrapper: flatten leading dims to rows, one row-block per
     program, full channel width per block (windows stay in-block).
 
-    Row tile sized for ~512KB VMEM blocks: conv-activation LRN inputs have
-    a few HUNDRED THOUSAND rows (AlexNet L1: 128·55·55), so an 8-row tile
-    dies of grid overhead (measured 3.5× slower than XLA); large tiles
-    amortize it."""
+    HBM traffic is the whole game (LRN is bandwidth-bound): blocks move
+    in the caller's dtype (bf16 under the fused step — HALF the bytes of
+    the old force-f32 wrapper) and are promoted to f32 only inside VMEM.
+    Scalars are compile-time constants (lets the pow decompose into
+    sqrt/rsqrt — see _pow_neg). Row tile sized for ~1MB VMEM blocks:
+    conv-activation LRN inputs have a few hundred thousand rows (AlexNet
+    L1: 1024·55·55), so an 8-row tile dies of grid overhead (measured
+    3.5× slower than XLA); large tiles amortize it."""
     x = args[0]
     rows_shape = x.shape[:-1]
-    x2s = [a.reshape(-1, c).astype(jnp.float32) for a in args]
+    x2s = [a.reshape(-1, c) for a in args]
     n_rows = x2s[0].shape[0]
+    itemsize = max(jnp.dtype(x.dtype).itemsize, 2)
     row_tile = 8
-    while row_tile < 1024 and row_tile * 2 <= max(n_rows, 8) \
-            and row_tile * 2 * c * 4 <= 512 * 1024:
+    while row_tile < 4096 and row_tile * 2 <= max(n_rows, 8) \
+            and row_tile * 2 * c * itemsize <= 1024 * 1024:
         row_tile *= 2
     x2s_p, rows = zip(*(_pad_rows(a, row_tile) for a in x2s))
     padded = x2s_p[0].shape[0]
-    scal = jnp.asarray([k, alpha, beta], jnp.float32)
     spec = pl.BlockSpec((row_tile, c), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     out = pl.pallas_call(
-        functools.partial(kernel, half=n // 2),
-        out_shape=jax.ShapeDtypeStruct((padded, c), jnp.float32),
+        functools.partial(kernel, half=n // 2, k=float(k),
+                          alpha=float(alpha), beta=float(beta)),
+        out_shape=jax.ShapeDtypeStruct((padded, c), x.dtype),
         grid=(padded // row_tile,),
-        in_specs=[spec] * len(x2s_p)
-        + [pl.BlockSpec(memory_space=pltpu.SMEM)],
+        in_specs=[spec] * len(x2s_p),
         out_specs=spec,
         interpret=_interpret(),
-    )(*x2s_p, scal)
-    return out[:rows[0]].reshape(rows_shape + (c,)).astype(x.dtype)
+    )(*x2s_p)
+    return out[:rows[0]].reshape(rows_shape + (c,))
 
 
 def lrn_forward_pallas(x, k: float = 2.0, alpha: float = 1e-4,
