@@ -129,3 +129,27 @@ def test_standard_workflow_plot_config_granular_and_fused(tmp_path):
     wf2.run_fused()
     curves2 = [p for p in wf2.plotters if hasattr(p, "values")]
     assert all(len(p.values) == 3 for p in curves2)
+
+
+def test_renderer_process_mode(tmp_path):
+    """Reference graphics_client isolation: a renderer SUBPROCESS consumes
+    pickled specs over a pipe and leaves the artifacts on disk; merged
+    line series and clear_series ride the same queue."""
+    r = GraphicsRenderer(str(tmp_path), process=True)
+    r.start()
+    r.publish({"name": "pcurve", "kind": "lines",
+               "series": {"train": [3.0, 2.0, 1.0]}})
+    r.publish({"name": "pcurve", "kind": "lines",
+               "series": {"validation": [4.0, 3.0, 2.0]}})
+    r.publish({"name": "pmat", "kind": "matrix",
+               "data": np.eye(4)})
+    r.stop()
+    names = {p.name for p in tmp_path.iterdir()}
+    assert any(n.startswith("pcurve.") for n in names), names
+    assert any(n.startswith("pmat.") for n in names), names
+    # headless path (no matplotlib) writes the MERGED series json; with
+    # matplotlib the contract is just the png's existence
+    curve = tmp_path / "pcurve.json"
+    if curve.exists():
+        spec = json.loads(curve.read_text())
+        assert set(spec["series"]) == {"train", "validation"}

@@ -82,6 +82,16 @@ def variant(name: str):
             if l["type"].startswith("conv"):
                 l["s2d"] = "auto"
         return out
+    if name == "avgpool":
+        # same geometry, max→avg: bounds the cost of maxpool's backward
+        # (XLA lowers it to select-and-scatter; avg is reduce+broadcast).
+        # The delta is an upper bound on what a Pallas argmax-offset
+        # pooling pair could recover.
+        out = [dict(l, type="avg_pooling")
+               if l["type"] == "max_pooling" else l for l in full]
+        assert any(l["type"] == "avg_pooling" for l in out), \
+            "no max_pooling layers found to substitute"
+        return out
     if name == "no-bigFC":
         return [l for l in full
                 if not l["type"].startswith("all2all")

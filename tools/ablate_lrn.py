@@ -60,6 +60,10 @@ def measure(name: str, prefer_pallas: bool) -> float:
 
 
 if __name__ == "__main__":
+    from veles_tpu.ops import pallas_kernels as pk
+    assert pk.available(), (
+        "no TPU visible: prefer_pallas would silently fall back to the "
+        "XLA path and the A/B would compare XLA against itself")
     a = measure("xla-lrn", False)
     b = measure("pallas-lrn", True)
     print(f"pallas/xla = {b / a:.3f}", flush=True)

@@ -34,13 +34,30 @@ def _have_matplotlib() -> bool:
 
 
 class GraphicsRenderer(Logger):
-    """Daemon-thread consumer of plot specs; renders PNGs (or JSON when
-    matplotlib is unavailable) into `directory`."""
+    """Consumer of plot specs; renders PNGs (or JSON when matplotlib is
+    unavailable) into `directory`.
 
-    def __init__(self, directory: str = "plots") -> None:
+    Two isolation levels, mirroring the reference's graphics_server →
+    graphics_client split:
+    - default: a daemon THREAD (rendering off the training thread; the
+      transport hop of the reference collapses to an in-process queue)
+    - `process=True`: a detached renderer PROCESS — the full reference
+      design, for runs where matplotlib work is heavy enough that even
+      GIL contention with the training thread matters. The child is a
+      plain `python -m veles_tpu.plotter --render-worker DIR` subprocess
+      fed length-delimited pickled specs over stdin by the feeder thread
+      — NOT multiprocessing, whose spawn bootstrap re-imports the user's
+      `__main__` (a workflow script without an import guard would
+      re-train inside the renderer). `rendered` is not tracked in the
+      parent in this mode; the artifact contract is the files on disk."""
+
+    def __init__(self, directory: str = "plots",
+                 process: bool = False) -> None:
         self.directory = directory
+        self.process = process
         self._q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
+        self._proc = None
         self.rendered: List[str] = []
         #: per-plot-name merged line series: several AccumulatingPlotters
         #: publishing under one name (train/validation error) draw on ONE
@@ -49,6 +66,15 @@ class GraphicsRenderer(Logger):
 
     def start(self) -> None:
         os.makedirs(self.directory, exist_ok=True)
+        if self.process:
+            import subprocess
+            import sys
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "veles_tpu.plotter",
+                 "--render-worker", self.directory],
+                stdin=subprocess.PIPE)
+        # in process mode the same daemon thread becomes the pipe FEEDER,
+        # so a slow child never blocks a publishing (training) thread
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="graphics-renderer")
         self._thread.start()
@@ -61,23 +87,53 @@ class GraphicsRenderer(Logger):
         so it is ordered with in-flight publishes): a NEW workflow
         plotting under a name an earlier run used starts clean instead
         of inheriting the old curves."""
-        self._q.put({"name": name, "kind": "__clear__"})
+        self.publish({"name": name, "kind": "__clear__"})
 
     def stop(self) -> None:
         if self._thread is None:
             return
         self._q.put(None)
         self._thread.join(timeout=30)
+        feeder_done = not self._thread.is_alive()
         self._thread = None
+        if self._proc is not None:
+            if feeder_done:
+                # EOF tells the worker to finish its queue and exit
+                try:
+                    self._proc.stdin.close()
+                except OSError:
+                    pass
+                try:
+                    self._proc.wait(timeout=30)
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._proc.poll() is None:
+                # feeder stuck on a full pipe or the child is hung:
+                # kill AND reap (an unreaped child stays a zombie for
+                # the rest of the training process)
+                self._proc.kill()
+                try:
+                    self._proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._proc = None
 
     # -- rendering -----------------------------------------------------------
 
     def _loop(self) -> None:
+        import pickle
+        import struct
         while True:
             spec = self._q.get()
             if spec is None:
                 return
             try:
+                if self._proc is not None:
+                    blob = pickle.dumps(spec, protocol=4)
+                    self._proc.stdin.write(struct.pack("<Q", len(blob)))
+                    self._proc.stdin.write(blob)
+                    self._proc.stdin.flush()
+                    continue
                 path = self._render(spec)
                 if path:
                     self.rendered.append(path)
@@ -151,7 +207,11 @@ _default_renderer: Optional[GraphicsRenderer] = None
 def get_renderer(directory: str = "plots") -> GraphicsRenderer:
     global _default_renderer
     if _default_renderer is None:
-        _default_renderer = GraphicsRenderer(directory)
+        # root.common.graphics_process=1 selects the detached renderer
+        # PROCESS (full reference graphics_client isolation)
+        from veles_tpu.config import root
+        process = bool(root.common.get("graphics_process", False))
+        _default_renderer = GraphicsRenderer(directory, process=process)
         _default_renderer.start()
     return _default_renderer
 
@@ -185,3 +245,38 @@ class Plotter(Unit):
         d = super().__getstate__()
         d["_renderer"] = None  # daemon thread: recreated on demand
         return d
+
+
+def _render_worker(directory: str) -> int:
+    """`python -m veles_tpu.plotter --render-worker DIR` — the detached
+    renderer process: length-delimited pickled specs on stdin until EOF.
+    Plain subprocess instead of multiprocessing so the user's `__main__`
+    (their workflow script) is never re-imported here."""
+    import pickle
+    import struct
+    import sys
+
+    r = GraphicsRenderer(directory)
+    os.makedirs(directory, exist_ok=True)
+    stdin = sys.stdin.buffer
+    while True:
+        header = stdin.read(8)
+        if len(header) < 8:
+            return 0
+        (size,) = struct.unpack("<Q", header)
+        blob = stdin.read(size)
+        if len(blob) < size:
+            return 0
+        try:
+            r._render(pickle.loads(blob))
+        except Exception:  # noqa: BLE001 — rendering must never crash
+            import traceback
+            traceback.print_exc()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    _p = argparse.ArgumentParser(prog="veles_tpu.plotter")
+    _p.add_argument("--render-worker", required=True, metavar="DIR")
+    raise SystemExit(_render_worker(_p.parse_args().render_worker))
