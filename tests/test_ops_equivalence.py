@@ -133,6 +133,30 @@ def test_maxabs_pooling():
     assert_close(y_xla, y_ref)
 
 
+@pytest.mark.parametrize("shape,ksize,stride,use_abs", [
+    ((2, 8, 8, 3), (2, 2), (2, 2), False),
+    ((2, 7, 9, 4), (3, 3), (2, 2), False),   # truncated edges (ceil mode)
+    ((1, 5, 5, 2), (2, 2), (1, 1), False),   # overlapping windows
+    ((2, 7, 7, 3), (3, 3), (2, 2), True),    # maxabs flavor
+    ((1, 8, 8, 1), (3, 3), (2, 2), True),    # maxabs WITH edge padding:
+    # the fill must be 0, not -inf (|−inf| would win every edge window)
+])
+def test_maxpool_slices_lowering_matches_golden(shape, ksize, stride,
+                                                use_abs):
+    """The shifted-strided-slices lowering (backward = selects + pads,
+    the select_and_scatter-free candidate) matches the golden model in
+    BOTH passes on tie-free random floats."""
+    x = rng.randn(*shape).astype(np.float32)
+    y_ref, idx = ref.maxpool_forward(x, ksize, stride, use_abs)
+    f = lambda v: ox.maxpool_forward_slices(v, ksize, stride, use_abs)
+    assert_close(jax.jit(f)(x), y_ref)
+    err_y = rng.randn(*y_ref.shape).astype(np.float32)
+    ex_ref = ref.maxpool_backward(err_y, idx, x.shape)
+    _, vjp = jax.vjp(f, x)
+    (ex,) = vjp(jnp.asarray(err_y))
+    assert_close(ex, ex_ref)
+
+
 @pytest.mark.parametrize("shape,ksize,stride", [
     ((2, 8, 8, 3), (2, 2), (2, 2)),
     ((2, 7, 7, 2), (3, 3), (2, 2)),

@@ -92,6 +92,13 @@ def variant(name: str):
         assert any(l["type"] == "avg_pooling" for l in out), \
             "no max_pooling layers found to substitute"
         return out
+    if name == "slicepool":
+        # maxpool lowered as a max-fold over shifted strided slices:
+        # backward = selects + pads instead of select_and_scatter
+        out = [dict(l, lowering="slices")
+               if l["type"] == "max_pooling" else l for l in full]
+        assert any(l.get("lowering") == "slices" for l in out)
+        return out
     if name == "no-bigFC":
         return [l for l in full
                 if not l["type"].startswith("all2all")

@@ -295,6 +295,42 @@ def maxpool_forward_with_idx(x, ksize: Tuple[int, int],
     return y, _flat_offsets(choice, n, h, w, c, oh, ow, stride, kx)
 
 
+def maxpool_forward_slices(x, ksize: Tuple[int, int],
+                           stride: Tuple[int, int], use_abs: bool = False):
+    """Max pooling as a max-fold over the ky·kx SHIFTED STRIDED SLICES of
+    the (−inf-padded) input — numerically identical to the reduce_window
+    flavor, but reverse-mode differentiates into selects + zero-pads
+    (elementwise, fusion-friendly) instead of XLA's select_and_scatter.
+    Candidate lowering for the fused step's backward; A/B'd on chip via
+    tools/ablate.py "slicepool" before becoming a default. Each window
+    always covers ≥1 real pixel (ceil-mode pads only trailing edges), so
+    the fill never wins a window: −inf for plain max; 0 for the abs
+    flavor (|−inf| = +inf would win every edge window; |0| only ties an
+    all-zero window, where keeping 0 is correct — same fill
+    maxpool_forward uses)."""
+    ky, kx = ksize
+    sy, sx = stride
+    n, h, w, c = x.shape
+    oh, ow, eh, ew = _ceil_pads(h, w, ky, kx, sy, sx)
+    dt = np.dtype(x.dtype)
+    fill = (np.zeros((), dt) if use_abs else np.asarray(-np.inf, dt))[()]
+    xp = lax.pad(x, fill, [(0, 0, 0), (0, eh, 0), (0, ew, 0), (0, 0, 0)])
+    out = None
+    for dy in range(ky):
+        for dx in range(kx):
+            s = lax.slice(xp, (0, dy, dx, 0),
+                          (n, dy + (oh - 1) * sy + 1,
+                           dx + (ow - 1) * sx + 1, c),
+                          (1, sy, sx, 1))
+            if out is None:
+                out = s
+            elif use_abs:
+                out = jnp.where(jnp.abs(out) >= jnp.abs(s), out, s)
+            else:
+                out = jnp.maximum(out, s)
+    return out
+
+
 def pool_scatter(err_y, idx, x_shape):
     """Backward scatter shared by max/maxabs/stochastic pooling: route err
     to the recorded winners; out-of-range sentinel offsets drop."""

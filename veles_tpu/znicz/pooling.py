@@ -57,8 +57,19 @@ class Pooling(Forward):
 class MaxPooling(Pooling):
     use_abs = False
 
-    def __init__(self, workflow=None, **kwargs: Any) -> None:
+    #: fused-step lowering: "reduce_window" (backward = select_and_scatter)
+    #: or "slices" (max-fold over shifted strided slices; backward =
+    #: selects + pads). Layer dict key "lowering" overrides per layer;
+    #: measured on chip via tools/ablate.py "slicepool" variant.
+    lowering = "reduce_window"
+
+    def __init__(self, workflow=None,
+                 lowering: Optional[str] = None, **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
+        if lowering is not None:
+            if lowering not in ("reduce_window", "slices"):
+                raise ValueError(f"unknown maxpool lowering {lowering!r}")
+            self.lowering = lowering
         #: flat winner offsets into input (numpy path; backward scatter)
         self.input_offset = Array()
 
@@ -69,6 +80,10 @@ class MaxPooling(Pooling):
         return None
 
     def fused_apply(self, params, x, *, key=None, train=True):
+        if self.lowering == "slices":
+            # differentiable for max AND maxabs (selects + pads backward)
+            return ox.maxpool_forward_slices(x, self.ksize, self.stride,
+                                             self.use_abs)
         if self.use_abs:
             # the custom-comparator reduce_window has no reverse-mode rule;
             # the patches/argmax formulation differentiates (gather vjp)
