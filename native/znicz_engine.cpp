@@ -10,8 +10,8 @@
 // reference-era model family serves natively — plus the TPU-era
 // transformer units (seq_linear/attention/seq_ffn/seq_softmax,
 // znicz/transformer.py + znicz/attention.py) so the char-transformer
-// family serves too. MoE routing stays on the StableHLO/PJRT export
-// (veles_tpu/export.py:export_stablehlo).
+// family serves too, and switch-MoE routing (znicz/moe.py) — every
+// model family in the framework serves natively.
 //
 // C API (ctypes-consumed by veles_tpu/native_engine.py):
 //   void* znicz_load(const char* package_dir);
@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstring>
 #include <fstream>
+#include <limits>
 #include <map>
 #include <memory>
 #include <sstream>
@@ -458,6 +459,11 @@ struct Layer {
   float scale = 1.f, offset = 0.f;  // "affine" (input_normalize export)
   int head_dim = 0;
   bool causal = false, residual = false, pos_embed = false;
+  int n_experts = 0, hidden = 0;          // moe
+  // double, matching the Python side's arithmetic exactly: a float32
+  // round here could truncate the capacity one below the golden's
+  double capacity_factor = 2.0;           // moe
+  std::string route;                      // moe: "token" | "sample"
   std::vector<int> w_shape;
   std::vector<float> weights, bias;
   // third packed array for ops with >2 params (lstm: [wx, wh, b] ->
@@ -473,6 +479,74 @@ struct Engine {
   std::vector<int> input_shape;  // per-sample
   std::string error;
 };
+
+// Switch MoE twin of ops/moe.py:moe_forward (export.py:_export_moe):
+// per token — softmax router over E experts, FIRST-argmax expert with
+// in-order per-expert capacity (prefix count over ALL tokens routed to
+// that expert, kept or not, matching top1_dispatch's cumsum), dropped
+// tokens emit 0 (the caller's residual add keeps them alive, like the
+// python layer); kept tokens emit gate · (relu(x@w1_e+b1_e)@w2_e+b2_e).
+// Blobs: [wr (D,E), w1 (E,D,H), b1 (E,H), w2 (E,H,D), b2 (E,D)].
+void moe_tokens(const std::vector<float>& x, int tcount, int d,
+                const Layer& l, std::vector<float>* y) {
+  const std::vector<float>& wr = l.arrs[0];
+  const std::vector<float>& w1 = l.arrs[1];
+  const std::vector<float>& b1 = l.arrs[2];
+  const std::vector<float>& w2 = l.arrs[3];
+  const std::vector<float>& b2 = l.arrs[4];
+  const int e_n = l.n_experts, hid = l.hidden;
+  if ((long long)wr.size() != (long long)d * e_n ||
+      (long long)w1.size() != (long long)e_n * d * hid ||
+      (long long)b1.size() != (long long)e_n * hid ||
+      (long long)w2.size() != (long long)e_n * hid * d ||
+      (long long)b2.size() != (long long)e_n * d)
+    throw std::runtime_error("moe blob size mismatch");
+  // python: int(capacity_factor * n_tokens / n_experts) — same double
+  // arithmetic + truncation, clamped to >= 1 (cf <= 1e9 is enforced at
+  // load, so the product stays far below the long long range)
+  long long cap = (long long)(l.capacity_factor * tcount / e_n);
+  if (cap < 1) cap = 1;
+  std::vector<long long> count(e_n, 0);
+  std::vector<float> logits(e_n), h(hid);
+  y->assign((size_t)tcount * d, 0.f);
+  for (int t = 0; t < tcount; ++t) {
+    const float* xt = x.data() + (size_t)t * d;
+    float mx = -std::numeric_limits<float>::infinity();
+    for (int e = 0; e < e_n; ++e) {
+      double acc = 0.0;
+      for (int i = 0; i < d; ++i)
+        acc += (double)xt[i] * wr[(size_t)i * e_n + e];
+      logits[e] = (float)acc;
+      if (logits[e] > mx) mx = logits[e];
+    }
+    double denom = 0.0;
+    for (int e = 0; e < e_n; ++e)
+      denom += std::exp((double)logits[e] - mx);
+    int best = 0;                    // strict > keeps the FIRST max,
+    for (int e = 1; e < e_n; ++e)    // matching jnp.argmax tie-break
+      if (logits[e] > logits[best]) best = e;
+    long long pos = count[best]++;
+    if (pos >= cap) continue;        // over capacity: dropped, stays 0
+    float gate = (float)(std::exp((double)logits[best] - mx) / denom);
+    const float* w1e = w1.data() + (size_t)best * d * hid;
+    const float* b1e = b1.data() + (size_t)best * hid;
+    const float* w2e = w2.data() + (size_t)best * hid * d;
+    const float* b2e = b2.data() + (size_t)best * d;
+    for (int j = 0; j < hid; ++j) {
+      double acc = b1e[j];
+      for (int i = 0; i < d; ++i)
+        acc += (double)xt[i] * w1e[(size_t)i * hid + j];
+      h[j] = acc > 0.0 ? (float)acc : 0.f;
+    }
+    float* yt = y->data() + (size_t)t * d;
+    for (int i = 0; i < d; ++i) {
+      double acc = b2e[i];
+      for (int j = 0; j < hid; ++j)
+        acc += (double)h[j] * w2e[(size_t)j * d + i];
+      yt[i] = gate * (float)acc;
+    }
+  }
+}
 
 std::vector<float> read_blob(const std::vector<float>& pool, const Json& spec) {
   // Packages travel through the forge/zoo exchange, so treat the manifest
@@ -549,6 +623,20 @@ Engine* load_package(const std::string& dir) {
     if (lj.has("causal")) l.causal = lj.at("causal").b;
     if (lj.has("residual")) l.residual = lj.at("residual").b;
     if (lj.has("pos_embed")) l.pos_embed = lj.at("pos_embed").b;
+    // untrusted manifest (see read_blob): validate BEFORE casting —
+    // double->int conversion of an out-of-range/NaN value is UB
+    auto dim_int = [](double v, const char* what) -> int {
+      if (!(v >= 0 && v <= 1e9) || v != std::floor(v))
+        throw std::runtime_error(std::string("bad ") + what +
+                                 " in manifest");
+      return (int)v;
+    };
+    l.n_experts = dim_int(lj.numval("n_experts", 0), "n_experts");
+    l.hidden = dim_int(lj.numval("hidden", 0), "hidden");
+    l.capacity_factor = lj.numval("capacity_factor", 2.0);
+    if (!(l.capacity_factor >= 0 && l.capacity_factor <= 1e9))
+      throw std::runtime_error("bad capacity_factor in manifest");
+    if (lj.has("route")) l.route = lj.at("route").str;
     const auto& arrays = lj.at("arrays").arr;
     if (!arrays.empty()) {
       l.weights = read_blob(pool, arrays[0]);
@@ -629,6 +717,26 @@ void run_forward(Engine* eng, Tensor* t) {
           l.bias.size() != 4 * (size_t)hsz)
         throw std::runtime_error("lstm wh/b blob size mismatch");
       lstm(*t, l.weights, l.w2, l.bias, hsz, &out);
+    } else if (l.type == "moe") {
+      // arrays: [wr, w1, b1, w2, b2] (export.py:_export_moe)
+      if (l.arrs.size() != 5 || l.n_experts <= 0 || l.hidden <= 0)
+        throw std::runtime_error("moe expects 5 arrays + n_experts/hidden");
+      bool token = l.route == "token";
+      int tcount, d;
+      if (token) {
+        if (t->shape.size() != 3)
+          throw std::runtime_error("moe token route expects (N,S,D)");
+        tcount = t->shape[0] * t->shape[1];
+        d = t->shape[2];
+      } else {
+        tcount = t->shape[0];
+        d = (int)(t->size() / t->shape[0]);
+      }
+      moe_tokens(t->data, tcount, d, l, &out.data);
+      out.shape = token ? t->shape : std::vector<int>{t->shape[0], d};
+      if (l.residual)
+        for (size_t i = 0; i < out.data.size(); ++i)
+          out.data[i] += t->data[i];
     } else if (l.type == "lrn") {
       lrn(*t, l.k, l.alpha, l.beta, l.nwin, &out);
     } else if (l.type == "activation") {

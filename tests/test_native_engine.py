@@ -241,3 +241,60 @@ def test_transformer_package_matches_golden(tmp_path):
                                rtol=3e-4, atol=3e-5)
     np.testing.assert_allclose(got.reshape(4 * S, V).sum(1), 1.0,
                                rtol=1e-5)
+
+
+def test_moe_package_matches_golden(tmp_path):
+    """Switch-MoE serves natively (sample route): router softmax,
+    first-argmax expert, prefix-count capacity with in-order drops and
+    the residual keeping dropped tokens alive — the C++ twin reproduces
+    the Python golden forward including any capacity-dropped rows."""
+    wf = build_wf(
+        [{"type": "all2all_tanh", "output_sample_shape": 24,
+          "weights_stddev": 0.1},
+         {"type": "moe", "n_experts": 4, "hidden": 16, "residual": True,
+          "weights_stddev": 0.2},
+         {"type": "softmax", "output_sample_shape": 5,
+          "weights_stddev": 0.05}],
+        sample_shape=(8,))
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(3).randn(25, 8).astype(np.float32)
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    np.testing.assert_allclose(got, gold, rtol=3e-4, atol=3e-5)
+
+
+def test_transformer_moe_package_matches_golden(tmp_path):
+    """Token-route MoE inside the transformer stack (the moe_experts
+    config of the char-transformer sample) serves natively end to end."""
+    import copy
+
+    from veles_tpu.config import root
+    from veles_tpu.samples.char_transformer import create_workflow
+    prng.seed_all(1234)
+    saved = copy.deepcopy(root.char_transformer)
+    root.char_transformer.loader.minibatch_size = 8
+    root.char_transformer.loader.seq_len = 10
+    root.char_transformer.embed = 16
+    root.char_transformer.n_heads = 2
+    root.char_transformer.ffn = 24
+    root.char_transformer.moe_experts = 2
+    root.char_transformer.decision.max_epochs = 1
+    root.char_transformer.parallel_mode = "local"
+    try:
+        wf = create_workflow()
+        wf.initialize(device=NumpyDevice())
+        wf.run()
+    finally:
+        root.char_transformer = saved
+
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = wf.loader.data.mem[:4]
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    S, V = x.shape[1], gold.shape[1]
+    np.testing.assert_allclose(got.reshape(4 * S, V), gold,
+                               rtol=3e-4, atol=3e-5)

@@ -118,6 +118,18 @@ def _export_attention(u):
             [u.wq.mem, u.wk.mem, u.wv.mem, u.wo.mem])
 
 
+@_exporter("MoELayer")
+def _export_moe(u):
+    # resolved route rides in the spec (the engine cannot re-run "auto"
+    # against training-time shapes); arrays in router-then-expert order
+    route = "token" if u._token_wise(len(u.input.shape)) else "sample"
+    return ({"type": "moe", "n_experts": int(u.n_experts),
+             "hidden": int(u.hidden),
+             "capacity_factor": float(u.capacity_factor),
+             "residual": bool(u.residual), "route": route},
+            [u.wr.mem, u.w1.mem, u.b1.mem, u.w2.mem, u.b2.mem])
+
+
 @_exporter("InputNormalize")
 def _export_input_normalize(u):
     # serving twin of the on-device normalize: the C++ engine applies
