@@ -153,3 +153,32 @@ def test_renderer_process_mode(tmp_path):
     if curve.exists():
         spec = json.loads(curve.read_text())
         assert set(spec["series"]) == {"train", "validation"}
+
+
+def test_write_report_html(tmp_path):
+    """--report publisher: the HTML report embeds headline metrics, the
+    per-unit table, the config snapshot, and rendered plot images."""
+    from veles_tpu.plotter import GraphicsRenderer
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    from veles_tpu.publishing import write_report
+
+    wf = build(tmp_path)
+    r = GraphicsRenderer(str(tmp_path / "plots"))
+    r.start()
+    p = AccumulatingPlotter(wf, plot_name="epoch_err", label="validation",
+                            renderer=r)
+    p.link_attrs(wf.decision, ("input", "best_validation_err"))
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    p.run()
+    r.stop()
+    out = write_report(wf, str(tmp_path / "report.html"),
+                       plots_dir=str(tmp_path / "plots"))
+    text = open(out).read()
+    assert "best_validation_err" in text
+    assert "root config snapshot" in text
+    assert "PlotTest" in text
+    # with matplotlib present a png was rendered and embedded
+    import importlib.util
+    if importlib.util.find_spec("matplotlib"):
+        assert "data:image/png;base64," in text

@@ -95,6 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "gradient as K scanned microbatches before the "
                         "single update (fused/distributed modes; "
                         "activation memory /K, numerics unchanged)")
+    p.add_argument("--report", default="", metavar="PATH",
+                   help="write an end-of-run report: PATH.html = "
+                        "self-contained HTML (metrics, config snapshot, "
+                        "unit times, embedded plots) plus the .json "
+                        "summary; PATH.json = machine summary only")
     p.add_argument("--daemon", default="", metavar="LOGFILE",
                    help="run detached in the background (reference "
                         "background/daemon mode): re-exec this command "
@@ -193,7 +198,7 @@ def main(argv=None) -> int:
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, manhole=args.manhole, pp=args.pp,
-        serve=args.serve, accum=args.accum)
+        serve=args.serve, accum=args.accum, report=args.report)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

@@ -16,6 +16,7 @@ pickling (reference §3.2) to orchestrate.
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Any, Callable, Optional
 
@@ -35,7 +36,7 @@ class Launcher(Logger):
                  profile_dir: str = "", debug_nans: bool = False,
                  fused: bool = False, manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
-                 accum: Optional[int] = None,
+                 accum: Optional[int] = None, report: str = "",
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -85,6 +86,9 @@ class Launcher(Logger):
         #: None = disabled; int = port to listen on (0 auto-picks).
         #: External live-attach REPL (reference manhole, SURVEY.md §2.5)
         self.manhole_port = manhole
+        #: end-of-run publishing: "x.html" writes the self-contained HTML
+        #: report (+ x.json machine summary); "x.json" the summary only
+        self.report_path = report
         self.workflow = None
         self.snapshot_loaded = False
         self._web = None
@@ -288,6 +292,25 @@ class Launcher(Logger):
                 self._manhole.stop()
             if self.show_stats and hasattr(self.workflow, "print_stats"):
                 self.workflow.print_stats()
+            if self.report_path:
+                # guarded like _stop_units: a bad report path must not
+                # mask the run's real exception or fail a finished run
+                try:
+                    # flush queued plot specs to files first so the HTML
+                    # embeds the final epoch's curves, not a stale state
+                    from veles_tpu.plotter import stop_default_renderer
+                    stop_default_renderer()
+                    from veles_tpu.publishing import (write_report,
+                                                      write_results)
+                    base, ext = os.path.splitext(self.report_path)
+                    if ext.lower() in (".html", ".htm"):
+                        write_report(self.workflow, self.report_path)
+                        write_results(self.workflow, base + ".json")
+                    else:
+                        write_results(self.workflow, self.report_path)
+                    self.info("run report -> %s", self.report_path)
+                except Exception as e:  # noqa: BLE001
+                    self.warning("report writing failed: %s", e)
         return 0
 
     def run_module(self, module) -> int:

@@ -204,6 +204,17 @@ class GraphicsRenderer(Logger):
 _default_renderer: Optional[GraphicsRenderer] = None
 
 
+def stop_default_renderer() -> None:
+    """Drain + stop the process-wide renderer (no-op when never started).
+    End-of-run publishers call this BEFORE reading the plots directory so
+    queued specs are flushed to files; a later get_renderer() starts a
+    fresh one."""
+    global _default_renderer
+    if _default_renderer is not None:
+        _default_renderer.stop()
+        _default_renderer = None
+
+
 def get_renderer(directory: str = "plots") -> GraphicsRenderer:
     global _default_renderer
     if _default_renderer is None:

@@ -41,3 +41,58 @@ def write_results(workflow, path: str = "results.json") -> str:
     with open(path, "w") as f:
         json.dump(workflow_results(workflow), f, indent=2)
     return path
+
+
+def write_report(workflow, path: str = "report.html",
+                 plots_dir: str = "plots") -> str:
+    """Self-contained HTML run report (the reference's richer-publisher
+    slot, SURVEY.md §2.5): headline metrics, the root config snapshot the
+    run used, the per-unit timing table, and every rendered plot from
+    `plots_dir` embedded as base64 — one file that travels anywhere."""
+    import base64
+    import html
+    import os
+
+    res = workflow_results(workflow)
+    rows = "".join(
+        f"<tr><td>{html.escape(u['name'])}</td>"
+        f"<td style='text-align:right'>{u['runs']}</td>"
+        f"<td style='text-align:right'>{u['time_s']:.4f}</td></tr>"
+        for u in sorted(res["units"], key=lambda u: -u["time_s"]))
+    metrics = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(json.dumps(v))}</td></tr>"
+        for k, v in res.items() if k not in ("units",))
+    imgs = ""
+    if os.path.isdir(plots_dir):
+        for name in sorted(os.listdir(plots_dir)):
+            if not name.endswith(".png"):
+                continue
+            with open(os.path.join(plots_dir, name), "rb") as f:
+                b64 = base64.b64encode(f.read()).decode()
+            imgs += (f"<figure><img src='data:image/png;base64,{b64}' "
+                     f"alt='{html.escape(name)}'>"
+                     f"<figcaption>{html.escape(name)}</figcaption>"
+                     "</figure>\n")
+    try:
+        from veles_tpu.config import root
+        cfg = html.escape(json.dumps(root.to_dict(), indent=1,
+                                     default=str)[:20000])
+    except Exception:  # noqa: BLE001 — config snapshot is best-effort
+        cfg = "(unavailable)"
+    doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>{html.escape(res['workflow'])} — run report</title>
+<style>body{{font-family:sans-serif;max-width:60em;margin:2em auto}}
+table{{border-collapse:collapse}}td,th{{border:1px solid #999;
+padding:.2em .6em}}figure{{display:inline-block;margin:.5em}}
+img{{max-width:28em}}details{{margin:1em 0}}</style></head><body>
+<h1>{html.escape(res['workflow'])}</h1>
+<table>{metrics}</table>
+<h2>Plots</h2>{imgs or "<p>(none rendered)</p>"}
+<h2>Per-unit time</h2>
+<table><tr><th>unit</th><th>runs</th><th>time&nbsp;s</th></tr>{rows}</table>
+<details><summary>root config snapshot</summary><pre>{cfg}</pre></details>
+</body></html>"""
+    with open(path, "w") as f:
+        f.write(doc)
+    return path
