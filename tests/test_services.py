@@ -234,3 +234,50 @@ def test_snapshotter_latest_ignores_inflight_tmp(tmp_path):
     _time.sleep(0.01)
     (tmp_path / "wf_0.05.pickle.gz.tmp").write_bytes(b"trunc")
     assert Snapshotter.latest(str(tmp_path)) == str(good)
+
+
+def test_snapshotter_mirrors_to_upload_url(tmp_path):
+    """Remote-destination slot (reference shipped snapshots to remote
+    backends): with upload_url set, every snapshot file is HTTP PUT to
+    the blob endpoint, byte-identical to the local authoritative copy;
+    an unreachable endpoint only warns and training continues."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    received = {}
+
+    class PutHandler(BaseHTTPRequestHandler):
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received[self.path] = self.rfile.read(n)
+            self.send_response(201)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), PutHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        prng.seed_all(1234)
+        wf = build(tmp_path, max_epochs=2, snapshot=True)
+        wf.snapshotter.upload_url = \
+            f"http://127.0.0.1:{srv.server_port}/snaps"
+        wf.initialize(device=NumpyDevice())
+        wf.run()
+        assert received, "no snapshot was mirrored"
+        name = os.path.basename(wf.snapshotter.destination)
+        assert f"/snaps/{name}" in received
+        local = open(wf.snapshotter.destination, "rb").read()
+        assert received[f"/snaps/{name}"] == local
+    finally:
+        srv.shutdown()
+
+    # unreachable endpoint: warn-only, the run still completes
+    prng.seed_all(1234)
+    wf2 = build(tmp_path, max_epochs=2, snapshot=True)
+    wf2.snapshotter.upload_url = "http://127.0.0.1:1/nope"
+    wf2.initialize(device=NumpyDevice())
+    wf2.run()
+    assert wf2.decision.epoch_number == 2
+    assert os.path.exists(wf2.snapshotter.destination)

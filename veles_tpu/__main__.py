@@ -202,6 +202,12 @@ def main(argv=None) -> int:
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")
+        if args.report:
+            # per-run reports don't exist in GA mode (each individual is
+            # its own stats-off run); reject rather than silently ignore
+            raise SystemExit("--report applies to a single run; in "
+                             "--optimize mode the GA summary JSON is "
+                             "printed on stdout")
         return run_optimize(module, args, device)
     return launcher.run_module(module)
 

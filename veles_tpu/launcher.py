@@ -297,14 +297,18 @@ class Launcher(Logger):
                 # mask the run's real exception or fail a finished run
                 try:
                     # flush queued plot specs to files first so the HTML
-                    # embeds the final epoch's curves, not a stale state
-                    from veles_tpu.plotter import stop_default_renderer
-                    stop_default_renderer()
+                    # embeds the final epoch's curves, not a stale state —
+                    # and remember where that renderer actually wrote
+                    from veles_tpu import plotter as _plotter
+                    plots_dir = getattr(_plotter._default_renderer,
+                                        "directory", "plots")
+                    _plotter.stop_default_renderer()
                     from veles_tpu.publishing import (write_report,
                                                       write_results)
                     base, ext = os.path.splitext(self.report_path)
                     if ext.lower() in (".html", ".htm"):
-                        write_report(self.workflow, self.report_path)
+                        write_report(self.workflow, self.report_path,
+                                     plots_dir=plots_dir)
                         write_results(self.workflow, base + ".json")
                     else:
                         write_results(self.workflow, self.report_path)
