@@ -318,3 +318,38 @@ def test_forge_roundtrip_moe_transformer_family(tmp_path):
         return np.asarray(out)
     np.testing.assert_allclose(logits(fetched), logits(wf),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_forge_http_server_publish_list_fetch(tmp_path):
+    """The zoo's client/server split (reference VelesForge service): an
+    HTTP ForgeServer serves a package directory; the SAME Forge client
+    verbs work against `http://` zoos — publish uploads, list reads the
+    index, fetch restores the trained workflow."""
+    from veles_tpu.forge import ForgeServer
+
+    wf = build(max_epochs=1)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+
+    srv = ForgeServer(str(tmp_path / "zoo"), port=0).start()
+    try:
+        zoo = Forge(f"http://127.0.0.1:{srv.port}")
+        url = zoo.publish(wf, "http-test", author="ci")
+        assert url.endswith("/pkg/http-test.forge.tar.gz")
+        entries = zoo.list()
+        assert [e["name"] for e in entries] == ["http-test"]
+        manifest, restored = zoo.fetch("http-test")
+        assert manifest["author"] == "ci"
+        assert restored.decision.epoch_number == 1
+        # path traversal rejected on both ends
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            zoo.fetch("../evil")
+        import urllib.error
+        import urllib.request
+        with _pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/pkg/%2e%2e/x.forge.tar.gz",
+                timeout=10)
+    finally:
+        srv.stop()

@@ -74,19 +74,46 @@ def unpack(path: str, restore: bool = True):
     return manifest, wf
 
 
+def _safe_name(name: str) -> str:
+    """Package names become filenames on both ends: restrict to a safe
+    alphabet so neither client nor server can be path-traversed."""
+    if not name or not all(c.isalnum() or c in "._-" for c in name) \
+            or name.startswith("."):
+        raise ValueError(f"bad package name {name!r}")
+    return name
+
+
 class Forge:
-    """A zoo directory of forge packages."""
+    """A zoo of forge packages: a directory (local path / network mount)
+    or, with `zoo="http://host:port"`, the HTTP zoo served by
+    ForgeServer — the reference's client/server split, same verbs."""
 
     def __init__(self, zoo: str) -> None:
-        self.zoo = zoo
-        os.makedirs(zoo, exist_ok=True)
+        self.remote = zoo.startswith(("http://", "https://"))
+        self.zoo = zoo.rstrip("/") if self.remote else zoo
+        if not self.remote:
+            os.makedirs(zoo, exist_ok=True)
 
     def publish(self, workflow, name: str, **meta: Any) -> str:
+        _safe_name(name)
+        if self.remote:
+            from veles_tpu.http_util import http_put_file
+            with tempfile.TemporaryDirectory() as tmp:
+                local = os.path.join(tmp, "pkg.tar.gz")
+                pack(workflow, local, name, **meta)
+                url = f"{self.zoo}/pkg/{name}.forge.tar.gz"
+                http_put_file(url, local, content_type="application/gzip")
+            return url
         dest = os.path.join(self.zoo, f"{name}.forge.tar.gz")
         pack(workflow, dest, name, **meta)
         return dest
 
     def list(self) -> List[Dict[str, Any]]:
+        if self.remote:
+            import urllib.request
+            with urllib.request.urlopen(f"{self.zoo}/index.json",
+                                        timeout=30) as resp:
+                return json.load(resp)
         out = []
         for f in sorted(os.listdir(self.zoo)):
             if f.endswith(".forge.tar.gz"):
@@ -96,8 +123,127 @@ class Forge:
         return out
 
     def fetch(self, name: str):
-        """Returns (manifest, restored workflow)."""
+        """Returns (manifest, restored workflow). TRUST MODEL applies:
+        fetching RESTORES A PICKLE — only point at a zoo you control."""
+        _safe_name(name)
+        if self.remote:
+            import urllib.request
+            with tempfile.TemporaryDirectory() as tmp:
+                local = os.path.join(tmp, "pkg.tar.gz")
+                url = f"{self.zoo}/pkg/{name}.forge.tar.gz"
+                with urllib.request.urlopen(url, timeout=60) as resp, \
+                        open(local, "wb") as f:
+                    shutil.copyfileobj(resp, f)
+                return unpack(local)
         path = os.path.join(self.zoo, f"{name}.forge.tar.gz")
         if not os.path.exists(path):
             raise FileNotFoundError(f"no package {name!r} in {self.zoo}")
         return unpack(path)
+
+
+class ForgeServer:
+    """The zoo's server half (reference VelesForge service slot): serves
+    a package directory over HTTP — GET /index.json (manifest list),
+    GET/PUT /pkg/<name>.forge.tar.gz. Run on a trusted network only:
+    packages are pickles (see TRUST MODEL above), and the server stores
+    whatever a client publishes."""
+
+    def __init__(self, directory: str, port: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        store = Forge(directory)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet; the unit logger reports
+                pass
+
+            def _pkg_path(self):
+                if not self.path.startswith("/pkg/"):
+                    return None
+                fname = self.path[len("/pkg/"):]
+                if not fname.endswith(".forge.tar.gz"):
+                    return None
+                try:
+                    _safe_name(fname[:-len(".forge.tar.gz")])
+                except ValueError:
+                    return None
+                return os.path.join(outer.directory, fname)
+
+            def do_GET(self):
+                if self.path == "/index.json":
+                    body = json.dumps(store.list()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                path = self._pkg_path()
+                if path is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                # open FIRST, size from the open fd: a concurrent PUT's
+                # os.replace between stat and open would otherwise make
+                # Content-Length disagree with the streamed body
+                try:
+                    f = open(path, "rb")
+                except OSError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                with f:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/gzip")
+                    self.send_header("Content-Length",
+                                     str(os.fstat(f.fileno()).st_size))
+                    self.end_headers()
+                    shutil.copyfileobj(f, self.wfile)
+
+            def do_PUT(self):
+                path = self._pkg_path()
+                try:
+                    n = int(self.headers.get("Content-Length", -1))
+                except (TypeError, ValueError):
+                    n = -1
+                if path is None or n < 0 or n > 2 ** 31:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                # unique temp per request: concurrent PUTs of the same
+                # name must not interleave into one file
+                fd, tmp = tempfile.mkstemp(dir=outer.directory,
+                                           suffix=".tmp")
+                remaining = n
+                with os.fdopen(fd, "wb") as f:
+                    while remaining:
+                        chunk = self.rfile.read(min(remaining, 1 << 20))
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        remaining -= len(chunk)
+                if remaining:
+                    os.remove(tmp)
+                    self.send_response(400)
+                else:
+                    os.replace(tmp, path)     # atomic: no torn packages
+                    self.send_response(201)
+                self.end_headers()
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._srv.server_port
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "ForgeServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()    # release the listening socket now

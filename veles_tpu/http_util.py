@@ -1,0 +1,22 @@
+"""Tiny shared HTTP helpers (stdlib-only; zero-egress environments use
+these on loopback/mounted networks only)."""
+
+from __future__ import annotations
+
+import os
+import urllib.request
+
+
+def http_put_file(url: str, path: str, timeout: float = 60.0,
+                  content_type: str = "application/octet-stream") -> int:
+    """STREAM a file to `url` via PUT (Content-Length set from the file;
+    urllib sends a seekable body in chunks — no full read into memory).
+    Returns the response status. Shared by the snapshot mirror and the
+    forge HTTP client so transport fixes land in one place."""
+    with open(path, "rb") as f:
+        req = urllib.request.Request(url, data=f, method="PUT")
+        req.add_header("Content-Type", content_type)
+        req.add_header("Content-Length", str(os.path.getsize(path)))
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+            return resp.status

@@ -137,18 +137,10 @@ class SnapshotterBase(Unit):
         raise NotImplementedError
 
     def _upload(self, path: str) -> None:
-        import urllib.request
+        from veles_tpu.http_util import http_put_file
         url = self.upload_url.rstrip("/") + "/" + os.path.basename(path)
-        # STREAM the file (urllib sends a seekable body in chunks given
-        # Content-Length): snapshots can be model-sized, and a full
-        # read() would double peak host memory right after pickling
-        with open(path, "rb") as f:
-            req = urllib.request.Request(url, data=f, method="PUT")
-            req.add_header("Content-Type", "application/octet-stream")
-            req.add_header("Content-Length", str(os.path.getsize(path)))
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                self.info("snapshot mirrored -> %s (HTTP %s)", url,
-                          resp.status)
+        status = http_put_file(url, path, timeout=30)
+        self.info("snapshot mirrored -> %s (HTTP %s)", url, status)
 
     def __getstate__(self):
         d = super().__getstate__()
