@@ -52,9 +52,16 @@ class GraphicsRenderer(Logger):
       parent in this mode; the artifact contract is the files on disk."""
 
     def __init__(self, directory: str = "plots",
-                 process: bool = False) -> None:
+                 process: bool = False,
+                 tensorboard_dir: str = "") -> None:
         self.directory = directory
         self.process = process
+        #: optional TensorBoard sink (SURVEY.md §5.5 TPU-equiv: "plotter
+        #: API writing to TensorBoard/matplotlib"): every "lines" spec's
+        #: new points also land as scalars tagged "<name>/<label>"
+        self.tensorboard_dir = tensorboard_dir
+        self._tb_writer = None
+        self._tb_counts: Dict[tuple, int] = {}
         self._q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._proc = None
@@ -69,10 +76,11 @@ class GraphicsRenderer(Logger):
         if self.process:
             import subprocess
             import sys
-            self._proc = subprocess.Popen(
-                [sys.executable, "-m", "veles_tpu.plotter",
-                 "--render-worker", self.directory],
-                stdin=subprocess.PIPE)
+            cmd = [sys.executable, "-m", "veles_tpu.plotter",
+                   "--render-worker", self.directory]
+            if self.tensorboard_dir:
+                cmd += ["--tensorboard", self.tensorboard_dir]
+            self._proc = subprocess.Popen(cmd, stdin=subprocess.PIPE)
         # in process mode the same daemon thread becomes the pipe FEEDER,
         # so a slow child never blocks a publishing (training) thread
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -96,6 +104,10 @@ class GraphicsRenderer(Logger):
         self._thread.join(timeout=30)
         feeder_done = not self._thread.is_alive()
         self._thread = None
+        if feeder_done:
+            # a hung render thread may still be writing scalars; closing
+            # under it would just spawn a stray unflushed writer
+            self._tb_close()
         if self._proc is not None:
             if feeder_done:
                 # EOF tells the worker to finish its queue and exit
@@ -140,10 +152,37 @@ class GraphicsRenderer(Logger):
             except Exception as e:  # noqa: BLE001 — rendering must never
                 self.warning("render failed: %s", e)   # kill training
 
+    def _tb_scalars(self, spec: Dict[str, Any]) -> None:
+        """Append each series' NEW points as TensorBoard scalars
+        (tag "<plot>/<label>", step = point index)."""
+        try:
+            if self._tb_writer is None:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb_writer = SummaryWriter(self.tensorboard_dir)
+            for label, ys in spec["series"].items():
+                key = (spec["name"], label)
+                start = self._tb_counts.get(key, 0)
+                for i in range(start, len(ys)):
+                    self._tb_writer.add_scalar(
+                        f"{spec['name']}/{label}", float(ys[i]), i)
+                self._tb_counts[key] = max(start, len(ys))
+        except Exception as e:  # noqa: BLE001 — sink must never kill
+            self.warning("tensorboard sink failed: %s", e)
+
+    def _tb_close(self) -> None:
+        if self._tb_writer is not None:
+            try:
+                self._tb_writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._tb_writer = None
+
     def _render(self, spec: Dict[str, Any]) -> Optional[str]:
         name = spec["name"]
         if spec.get("kind") == "__clear__":
             self._series.pop(name, None)    # new run under the same name
+            for key in [k for k in self._tb_counts if k[0] == name]:
+                self._tb_counts.pop(key)    # TB restarts from step 0 too
             return None
         base = os.path.join(self.directory, name)
         if spec.get("kind") == "lines":
@@ -152,6 +191,8 @@ class GraphicsRenderer(Logger):
             merged = self._series.setdefault(name, {})
             merged.update(spec["series"])
             spec = dict(spec, series=dict(merged))
+            if self.tensorboard_dir:
+                self._tb_scalars(spec)
         if not _have_matplotlib():
             path = base + ".json"
             with open(path, "w") as f:
@@ -219,10 +260,13 @@ def get_renderer(directory: str = "plots") -> GraphicsRenderer:
     global _default_renderer
     if _default_renderer is None:
         # root.common.graphics_process=1 selects the detached renderer
-        # PROCESS (full reference graphics_client isolation)
+        # PROCESS (full reference graphics_client isolation);
+        # root.common.tensorboard_dir adds the TensorBoard scalar sink
         from veles_tpu.config import root
         process = bool(root.common.get("graphics_process", False))
-        _default_renderer = GraphicsRenderer(directory, process=process)
+        tb = str(root.common.get("tensorboard_dir", "") or "")
+        _default_renderer = GraphicsRenderer(directory, process=process,
+                                             tensorboard_dir=tb)
         _default_renderer.start()
     return _default_renderer
 
@@ -258,7 +302,7 @@ class Plotter(Unit):
         return d
 
 
-def _render_worker(directory: str) -> int:
+def _render_worker(directory: str, tensorboard_dir: str = "") -> int:
     """`python -m veles_tpu.plotter --render-worker DIR` — the detached
     renderer process: length-delimited pickled specs on stdin until EOF.
     Plain subprocess instead of multiprocessing so the user's `__main__`
@@ -267,22 +311,25 @@ def _render_worker(directory: str) -> int:
     import struct
     import sys
 
-    r = GraphicsRenderer(directory)
+    r = GraphicsRenderer(directory, tensorboard_dir=tensorboard_dir)
     os.makedirs(directory, exist_ok=True)
     stdin = sys.stdin.buffer
-    while True:
-        header = stdin.read(8)
-        if len(header) < 8:
-            return 0
-        (size,) = struct.unpack("<Q", header)
-        blob = stdin.read(size)
-        if len(blob) < size:
-            return 0
-        try:
-            r._render(pickle.loads(blob))
-        except Exception:  # noqa: BLE001 — rendering must never crash
-            import traceback
-            traceback.print_exc()
+    try:
+        while True:
+            header = stdin.read(8)
+            if len(header) < 8:
+                return 0
+            (size,) = struct.unpack("<Q", header)
+            blob = stdin.read(size)
+            if len(blob) < size:
+                return 0
+            try:
+                r._render(pickle.loads(blob))
+            except Exception:  # noqa: BLE001 — rendering must never crash
+                import traceback
+                traceback.print_exc()
+    finally:
+        r._tb_close()
 
 
 if __name__ == "__main__":
@@ -290,4 +337,7 @@ if __name__ == "__main__":
 
     _p = argparse.ArgumentParser(prog="veles_tpu.plotter")
     _p.add_argument("--render-worker", required=True, metavar="DIR")
-    raise SystemExit(_render_worker(_p.parse_args().render_worker))
+    _p.add_argument("--tensorboard", default="", metavar="DIR")
+    _args = _p.parse_args()
+    raise SystemExit(_render_worker(_args.render_worker,
+                                    _args.tensorboard))
