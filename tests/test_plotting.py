@@ -222,3 +222,29 @@ def test_tensorboard_scalar_sink(tmp_path):
                 if v.tag == "err/validation":
                     points[ev.step] = v.simple_value
     assert sorted(points) == [0, 1, 2], points
+
+
+def test_no_plot_flag_disables_plotters(tmp_path):
+    """Reference CLI parity: --no-plot (root.common.plotting_disabled)
+    turns plotters into no-ops — no specs, no renderer artifacts."""
+    from veles_tpu.config import root
+
+    root.common.plotting_disabled = 1
+    try:
+        wf = build(tmp_path, max_epochs=2)
+        r = GraphicsRenderer(str(tmp_path / "plots"))
+        r.start()
+        p = AccumulatingPlotter(wf, plot_name="err", label="validation",
+                                renderer=r)
+        p.link_attrs(wf.decision, ("input", "best_validation_err"))
+        p.link_from(wf.decision)
+        p.gate_skip = ~wf.loader.epoch_ended
+        wf.end_point.link_from(p)
+        wf.initialize(device=NumpyDevice())
+        wf.run()
+        r.stop()
+        assert r.rendered == [], r.rendered
+        assert not (tmp_path / "plots").exists() \
+            or not any((tmp_path / "plots").iterdir())
+    finally:
+        root.common.plotting_disabled = 0

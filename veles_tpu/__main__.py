@@ -95,6 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "gradient as K scanned microbatches before the "
                         "single update (fused/distributed modes; "
                         "activation memory /K, numerics unchanged)")
+    p.add_argument("--no-plot", action="store_true",
+                   help="disable all plotting units (reference CLI flag):"
+                        " plotters become no-ops, no renderer starts")
     p.add_argument("--report", default="", metavar="PATH",
                    help="write an end-of-run report: PATH.html = "
                         "self-contained HTML (metrics, config snapshot, "
@@ -162,6 +165,9 @@ def main(argv=None) -> int:
         print(daemon_pid, flush=True)
         return 0
     set_verbosity(args.verbose)
+    if args.no_plot:
+        from veles_tpu.config import root as _root
+        _root.common.plotting_disabled = 1
     if args.log_file:
         add_log_file(args.log_file)
     if args.random_seed is not None:

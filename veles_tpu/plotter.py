@@ -155,19 +155,28 @@ class GraphicsRenderer(Logger):
     def _tb_scalars(self, spec: Dict[str, Any]) -> None:
         """Append each series' NEW points as TensorBoard scalars
         (tag "<plot>/<label>", step = point index)."""
-        try:
-            if self._tb_writer is None:
+        if self._tb_writer is None:
+            try:
                 from torch.utils.tensorboard import SummaryWriter
                 self._tb_writer = SummaryWriter(self.tensorboard_dir)
-            for label, ys in spec["series"].items():
-                key = (spec["name"], label)
-                start = self._tb_counts.get(key, 0)
+            except Exception as e:  # noqa: BLE001 — optional sink
+                self.warning("tensorboard sink unavailable (%s); "
+                             "disabling it for this run", e)
+                self.tensorboard_dir = ""   # one warning, zero retries
+                return
+        for label, ys in spec["series"].items():
+            key = (spec["name"], label)
+            start = self._tb_counts.get(key, 0)
+            try:
                 for i in range(start, len(ys)):
                     self._tb_writer.add_scalar(
                         f"{spec['name']}/{label}", float(ys[i]), i)
-                self._tb_counts[key] = max(start, len(ys))
-        except Exception as e:  # noqa: BLE001 — sink must never kill
-            self.warning("tensorboard sink failed: %s", e)
+                    # commit per point: a later failure must not rewind
+                    # already-written labels into duplicate events
+                    self._tb_counts[key] = i + 1
+            except Exception as e:  # noqa: BLE001 — sink must never kill
+                self.warning("tensorboard sink failed on %s/%s: %s",
+                             spec["name"], label, e)
 
     def _tb_close(self) -> None:
         if self._tb_writer is not None:
@@ -292,6 +301,11 @@ class Plotter(Unit):
         raise NotImplementedError
 
     def run(self) -> None:
+        # reference CLI parity: the disable-plotting flag turns every
+        # plotter into a no-op (CLI --no-plot sets this root knob)
+        from veles_tpu.config import root
+        if root.common.get("plotting_disabled", False):
+            return
         spec = self.make_spec()
         if spec is not None:
             self.renderer.publish(spec)

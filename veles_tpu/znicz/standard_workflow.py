@@ -196,6 +196,9 @@ class StandardWorkflow(Workflow):
 
     def _fire_plotters(self) -> None:
         """Refresh every plotter from current state (epoch boundary)."""
+        from veles_tpu.config import root
+        if root.common.get("plotting_disabled", False):
+            return      # --no-plot: no specs, and no renderer ever starts
         from veles_tpu.plotting_units import MatrixPlotter
         if not getattr(self, "_plot_series_cleared", False):
             # a NEW workflow plotting under names an earlier run used in
@@ -386,8 +389,11 @@ class StandardWorkflow(Workflow):
                     ev.loss = 0.0
                     ev.n_err = 0
                 dec.run()
+                from veles_tpu.config import root as _root
                 if getattr(self, "plotters", None) \
-                        and bool(loader.epoch_ended):
+                        and bool(loader.epoch_ended) \
+                        and not _root.common.get("plotting_disabled",
+                                                 False):
                     # weight plots need the CURRENT fused params in the
                     # unit Arrays, not the init-time values
                     from veles_tpu.plotting_units import Weights2D
