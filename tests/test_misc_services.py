@@ -34,11 +34,28 @@ def build(max_epochs=2, **gd):
 
 
 def test_lr_policies_math():
-    from veles_tpu.znicz.lr_adjust import (exp_policy, inv_policy,
-                                           step_policy)
+    from veles_tpu.znicz.lr_adjust import (exp_policy, fixed_policy,
+                                           inv_policy, multistep_policy,
+                                           poly_policy, step_policy)
     assert step_policy(1.0, 0.5, 10)(25) == 0.25
     assert abs(exp_policy(1.0, 0.9)(2) - 0.81) < 1e-12
     assert abs(inv_policy(1.0, 1.0, 1.0)(3) - 0.25) < 1e-12
+    assert fixed_policy(0.3)(12345) == 0.3
+    assert abs(poly_policy(1.0, 2.0, 100)(50) - 0.25) < 1e-12
+    assert poly_policy(1.0, 2.0, 100)(200) == 0.0     # clamped past max
+    ms = multistep_policy(1.0, 0.1, (4, 2))           # unsorted ok
+    assert [round(ms(i), 3) for i in range(6)] == \
+        [1.0, 1.0, 0.1, 0.1, 0.01, 0.01]
+
+
+def test_lr_adjust_snapshot_roundtrip_rebuilds_policy():
+    import pickle
+
+    u = LearningRateAdjust(policy="poly", base=1.0, power=2.0,
+                           max_iter=100)
+    u.iteration = 50
+    u2 = pickle.loads(pickle.dumps(u))
+    assert u2.current_scale == pytest.approx(0.25)
 
 
 def test_lr_adjust_drives_gd_scale_in_workflow():

@@ -128,4 +128,6 @@ root.common.precision_type = "float32"
 root.common.engine.backend = "xla"  # "xla" | "numpy"
 root.common.seed = 1234
 root.common.snapshot_dir = "snapshots"
-root.common.plotting = False
+#: set truthy (CLI --no-plot) to turn every plotting unit into a no-op
+#: and keep the renderer from ever starting
+root.common.plotting_disabled = 0

@@ -1,8 +1,8 @@
 """Learning-rate scheduling unit.
 
-Parity: reference `veles/znicz/lr_adjust.py` (SURVEY.md §2.8 [M]) —
-step/exp/inv policies applied to the GD units' learning rate over
-training iterations.
+Parity: reference `veles/znicz/lr_adjust.py` (SURVEY.md §2.8 [M]) — the
+Caffe-era policy set (fixed/step/multistep/exp/inv/poly) applied to the
+GD units' learning rate over training iterations.
 
 TPU-first: the GD units (and FusedTrainStep) read a runtime `lr_scale`
 multiplier that is a TRACED scalar in the compiled step, so schedule
@@ -32,7 +32,41 @@ def inv_policy(base: float, gamma: float, power: float):
     return lambda it: base / ((1.0 + gamma * it) ** power)
 
 
-_POLICIES = {"step": step_policy, "exp": exp_policy, "inv": inv_policy}
+def fixed_policy(base: float):
+    """lr(it) = base."""
+    return lambda it: base
+
+
+def poly_policy(base: float, power: float, max_iter: int):
+    """lr(it) = base · (1 − it/max_iter)^power, clamped at 0."""
+    if max_iter <= 0:
+        raise ValueError(f"poly policy needs max_iter > 0, got {max_iter}")
+    return lambda it: base * max(1.0 - it / max_iter, 0.0) ** power
+
+
+def multistep_policy(base: float, gamma: float, steps):
+    """lr(it) = base · gamma^(#{s in steps : it ≥ s})."""
+    steps = sorted(steps)
+    return lambda it: base * (gamma ** sum(1 for s in steps if it >= s))
+
+
+def _build_policy(policy, base, gamma, step, power, max_iter, steps):
+    if policy == "step":
+        return step_policy(base, gamma, step)
+    if policy == "exp":
+        return exp_policy(base, gamma)
+    if policy == "inv":
+        return inv_policy(base, gamma, power)
+    if policy == "fixed":
+        return fixed_policy(base)
+    if policy == "poly":
+        return poly_policy(base, power, max_iter)
+    if policy == "multistep":
+        return multistep_policy(base, gamma, steps)
+    raise ValueError(f"unknown lr policy {policy!r}")
+
+
+_POLICIES = ("step", "exp", "inv", "fixed", "poly", "multistep")
 
 
 class LearningRateAdjust(Unit):
@@ -43,19 +77,18 @@ class LearningRateAdjust(Unit):
     def __init__(self, workflow=None, policy: str = "exp",
                  base: float = 1.0, gamma: float = 0.999,
                  step: int = 100, power: float = 0.75,
+                 max_iter: int = 10000,
+                 steps: Optional[Iterable[int]] = None,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         if policy not in _POLICIES:
             raise ValueError(
                 f"unknown lr policy {policy!r}; one of {sorted(_POLICIES)}")
         self.policy_name = policy
-        if policy == "step":
-            self._policy = step_policy(base, gamma, step)
-        elif policy == "exp":
-            self._policy = exp_policy(base, gamma)
-        else:
-            self._policy = inv_policy(base, gamma, power)
-        self._cfg = (policy, base, gamma, step, power)
+        # an explicit empty list means "no decay steps", not the default
+        steps = tuple(steps) if steps is not None else (1000, 5000)
+        self._cfg = (policy, base, gamma, step, power, max_iter, steps)
+        self._policy = _build_policy(*self._cfg)
         self.iteration = 0
         self.gd_units: list = []
 
@@ -81,10 +114,8 @@ class LearningRateAdjust(Unit):
 
     def __setstate__(self, state):
         super().__setstate__(state)
-        policy, base, gamma, step, power = self._cfg
-        if policy == "step":
-            self._policy = step_policy(base, gamma, step)
-        elif policy == "exp":
-            self._policy = exp_policy(base, gamma)
-        else:
-            self._policy = inv_policy(base, gamma, power)
+        cfg = self._cfg
+        if len(cfg) == 5:       # pre-r4 snapshot: no max_iter/steps
+            cfg = cfg + (10000, (1000, 5000))
+            self._cfg = cfg
+        self._policy = _build_policy(*cfg)
