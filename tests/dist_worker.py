@@ -23,6 +23,7 @@ jax.config.update("jax_platforms", "cpu")
 
 def main() -> None:
     role, addr, pid = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    tp = int(sys.argv[4]) if len(sys.argv) > 4 else None
 
     import numpy as np
 
@@ -51,7 +52,7 @@ def main() -> None:
     launcher = Launcher(
         listen=addr if role == "coordinator" else "",
         master=addr if role == "worker" else "",
-        process_id=pid, n_processes=2, stats=False)
+        process_id=pid, n_processes=2, stats=False, tp=tp)
     launcher.load(factory)
     rc = launcher.main()
 

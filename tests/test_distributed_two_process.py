@@ -32,41 +32,50 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_loopback_dp_training():
+def _run_pair(extra_args=(), devices_per_process=None):
+    """Launch coordinator+worker dist_worker processes, return their
+    DIGEST dicts. Kills the pair on any failure so a crashed coordinator
+    never leaves an orphan worker blocked on the distributed connect."""
     addr = f"localhost:{_free_port()}"
     env = dict(os.environ)
-    # one local CPU device per process -> a 2-device GLOBAL mesh; clearing
-    # PALLAS_AXON_POOL_IPS skips axon/tunnel registration entirely
     env.pop("XLA_FLAGS", None)
+    # clearing PALLAS_AXON_POOL_IPS skips axon/tunnel registration
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    if devices_per_process:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{devices_per_process}")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, role, addr, str(pid)],
+            [sys.executable, WORKER, role, addr, str(pid), *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True)
         for pid, role in ((0, "coordinator"), (1, "worker"))
     ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, f"rc={p.returncode}\n{err[-3000:]}"
-        outs.append((out, err))
-
     digests = []
-    for out, err in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("DIGEST ")]
-        assert lines, f"no digest in output:\n{out}\n{err[-2000:]}"
-        digests.append(json.loads(lines[-1][len("DIGEST "):]))
+    try:
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"rc={p.returncode}\n{err[-3000:]}"
+            outs.append((out, err))
+        for out, err in outs:
+            lines = [ln for ln in out.splitlines()
+                     if ln.startswith("DIGEST ")]
+            assert lines, f"no digest in output:\n{out}\n{err[-2000:]}"
+            digests.append(json.loads(lines[-1][len("DIGEST "):]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return digests
 
-    d0, d1 = digests
+
+def test_two_process_loopback_dp_training():
+    # one local CPU device per process -> a 2-device GLOBAL mesh
+    d0, d1 = _run_pair()
     assert d0["rc"] == 0 and d1["rc"] == 0
     # both processes saw the GLOBAL mesh (2 devices, 1 local each)
     assert d0["n_global_devices"] == 2 and d0["n_local_devices"] == 1
@@ -75,4 +84,17 @@ def test_two_process_loopback_dp_training():
     assert d0["param_digest"] == d1["param_digest"], (d0, d1)
     assert d0["param_sums"] == pytest.approx(d1["param_sums"], rel=0)
     # and the model actually learned (32 validation samples, chance=24)
+    assert d0["best_validation_err"] < 16, d0
+
+
+def test_two_process_hybrid_dp_tp_mesh():
+    """Pod-slice-shaped hybrid: 2 PROCESSES (DCN analog, Gloo loopback)
+    x 4 virtual devices each = an 8-device global mesh with tensor
+    parallelism (--tp 2) spanning both hosts. The megatron gspmd step
+    must train to bit-identical params on both processes."""
+    d0, d1 = _run_pair(extra_args=("2",), devices_per_process=4)
+    assert d0["rc"] == 0 and d1["rc"] == 0
+    assert d0["n_global_devices"] == 8 and d0["n_local_devices"] == 4
+    assert d1["n_global_devices"] == 8
+    assert d0["param_digest"] == d1["param_digest"], (d0, d1)
     assert d0["best_validation_err"] < 16, d0

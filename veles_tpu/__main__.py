@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused", action="store_true",
                    help="train via the fused one-dispatch-per-minibatch "
                         "XLA step instead of the granular unit graph")
+    p.add_argument("--tp", type=int, default=None, metavar="K",
+                   help="tensor-parallel degree for distributed runs: "
+                        "global mesh (data x model=K), megatron gspmd "
+                        "step; combine with -l/-m")
     p.add_argument("--accum", type=int, default=None, metavar="K",
                    help="gradient accumulation: compute each minibatch's "
                         "gradient as K scanned microbatches before the "
@@ -204,7 +208,8 @@ def main(argv=None) -> int:
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, manhole=args.manhole, pp=args.pp,
-        serve=args.serve, accum=args.accum, report=args.report)
+        serve=args.serve, accum=args.accum, report=args.report,
+        tp=args.tp)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

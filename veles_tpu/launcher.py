@@ -37,6 +37,7 @@ class Launcher(Logger):
                  fused: bool = False, manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
                  accum: Optional[int] = None, report: str = "",
+                 tp: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -75,6 +76,17 @@ class Launcher(Logger):
             raise SystemExit("--accum applies to the fused step: combine "
                              "with --fused or a distributed -l/-m run")
         self.accum = accum
+        #: tensor-parallel degree for distributed runs: the global mesh
+        #: becomes (data = n_devices/K, model = K) and the fused step
+        #: runs in gspmd mode (megatron col/row plan) — a v5e-pod-style
+        #: dp x tp hybrid where TP collectives ride the fast links
+        if tp is not None and tp < 1:
+            raise SystemExit(f"--tp needs K >= 1 (got {tp})")
+        if tp and tp > 1 and not (listen or master):
+            raise SystemExit("--tp shards over the distributed global "
+                             "mesh: combine with -l/-m (single-process "
+                             "TP uses build_fused_step(mesh=...) directly)")
+        self.tp = tp
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -247,9 +259,12 @@ class Launcher(Logger):
 
                 from veles_tpu.parallel.distributed import is_coordinator
                 from veles_tpu.parallel.mesh import make_mesh
-                mesh = make_mesh(jax.devices())
-                self.info("distributed %s: %d processes, %d global devices",
-                          self.mode, self.n_processes, jax.device_count())
+                tp = self.tp or 1
+                mesh = make_mesh(jax.devices(), model=tp)
+                self.info(
+                    "distributed %s: %d processes, %d global devices, "
+                    "mesh %s", self.mode, self.n_processes,
+                    jax.device_count(), dict(mesh.shape))
                 if not is_coordinator() and getattr(
                         self.workflow, "snapshotter", None) is not None:
                     # host-side side effects are coordinator-only: every
@@ -258,7 +273,7 @@ class Launcher(Logger):
                     # can publish a truncated file
                     self.workflow.snapshotter = None
                 self.workflow.run_fused(device=self.device, mesh=mesh,
-                                        mode="dp",
+                                        mode="gspmd" if tp > 1 else "dp",
                                         accum_steps=self.accum, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):

@@ -704,7 +704,17 @@ class FusedTrainStep:
                 "key": repl, "lr_scale": repl}
 
     def _shard_state(self, state):
-        return jax.device_put(state, self._state_shardings())
+        shardings = self._state_shardings()
+        if self.mesh is not None and any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat):
+            # multi-process global mesh (dp x tp over DCN): device_put
+            # rejects shardings with non-addressable devices; jit treats
+            # the uniform host state (single-controller convention, see
+            # parallel/distributed.py) as replicated input and emits
+            # global arrays laid out per `shardings`
+            return jax.jit(lambda s: s, out_shardings=shardings)(state)
+        return jax.device_put(state, shardings)
 
     # -- public API ----------------------------------------------------------
 

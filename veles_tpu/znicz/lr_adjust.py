@@ -50,23 +50,24 @@ def multistep_policy(base: float, gamma: float, steps):
     return lambda it: base * (gamma ** sum(1 for s in steps if it >= s))
 
 
+#: one source of truth: name -> builder over the full cfg tuple
+_BUILDERS = {
+    "step": lambda b, g, s, p, m, ms: step_policy(b, g, s),
+    "exp": lambda b, g, s, p, m, ms: exp_policy(b, g),
+    "inv": lambda b, g, s, p, m, ms: inv_policy(b, g, p),
+    "fixed": lambda b, g, s, p, m, ms: fixed_policy(b),
+    "poly": lambda b, g, s, p, m, ms: poly_policy(b, p, m),
+    "multistep": lambda b, g, s, p, m, ms: multistep_policy(b, g, ms),
+}
+_POLICIES = tuple(sorted(_BUILDERS))
+
+
 def _build_policy(policy, base, gamma, step, power, max_iter, steps):
-    if policy == "step":
-        return step_policy(base, gamma, step)
-    if policy == "exp":
-        return exp_policy(base, gamma)
-    if policy == "inv":
-        return inv_policy(base, gamma, power)
-    if policy == "fixed":
-        return fixed_policy(base)
-    if policy == "poly":
-        return poly_policy(base, power, max_iter)
-    if policy == "multistep":
-        return multistep_policy(base, gamma, steps)
-    raise ValueError(f"unknown lr policy {policy!r}")
-
-
-_POLICIES = ("step", "exp", "inv", "fixed", "poly", "multistep")
+    try:
+        builder = _BUILDERS[policy]
+    except KeyError:
+        raise ValueError(f"unknown lr policy {policy!r}") from None
+    return builder(base, gamma, step, power, max_iter, steps)
 
 
 class LearningRateAdjust(Unit):
