@@ -24,6 +24,7 @@ jax.config.update("jax_platforms", "cpu")
 def main() -> None:
     role, addr, pid = sys.argv[1], sys.argv[2], int(sys.argv[3])
     tp = int(sys.argv[4]) if len(sys.argv) > 4 else None
+    sp = int(sys.argv[5]) if len(sys.argv) > 5 else None
 
     import numpy as np
 
@@ -49,23 +50,47 @@ def main() -> None:
             gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
             name="DistDP")
 
+    def transformer_factory():
+        # ring attention with the seq axis SPANNING processes: the
+        # long-context path over the DCN analog
+        from veles_tpu.config import root
+        from veles_tpu.samples.char_transformer import create_workflow
+        prng.seed_all(4321)
+        root.char_transformer.loader.minibatch_size = 16
+        root.char_transformer.loader.seq_len = 16
+        root.char_transformer.embed = 16
+        root.char_transformer.n_heads = 2
+        root.char_transformer.ffn = 24
+        root.char_transformer.moe_experts = 0
+        root.char_transformer.decision.max_epochs = 2
+        root.char_transformer.decision.fail_iterations = 50
+        root.char_transformer.parallel_mode = "ring"
+        return create_workflow()
+
     launcher = Launcher(
         listen=addr if role == "coordinator" else "",
         master=addr if role == "worker" else "",
-        process_id=pid, n_processes=2, stats=False, tp=tp)
-    launcher.load(factory)
+        process_id=pid, n_processes=2, stats=False, tp=tp, sp=sp)
+    launcher.load(transformer_factory if (sp or 1) > 1 else factory)
     rc = launcher.main()
 
     wf = launcher.workflow
+    # digest EVERY param of every forward (attention units carry
+    # wq/wk/wv/wo, not `weights`)
+    sums, hexes = [], []
+    for u in wf.forwards:
+        for pname, arr in sorted(u.param_arrays().items()):
+            if not arr:
+                continue
+            sums.append(float(np.abs(arr.mem).sum()))
+            hexes.append(np.asarray(arr.mem).tobytes().hex()[:32])
     digest = {
         "role": role, "rc": rc,
         "n_global_devices": jax.device_count(),
         "n_local_devices": jax.local_device_count(),
         "best_validation_err": int(wf.decision.best_validation_err),
-        "param_sums": [float(np.abs(u.weights.mem).sum())
-                       for u in wf.forwards],
-        "param_digest": [np.asarray(u.weights.mem).tobytes().hex()[:32]
-                         for u in wf.forwards],
+        "param_sums": sums,
+        "param_digest": hexes,
     }
     print("DIGEST " + json.dumps(digest), flush=True)
 

@@ -94,6 +94,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel degree for distributed runs: "
                         "global mesh (data x model=K), megatron gspmd "
                         "step; combine with -l/-m")
+    p.add_argument("--sp", type=int, default=None, metavar="K",
+                   help="sequence-parallel degree for distributed runs: "
+                        "ring attention over the mesh 'seq' axis "
+                        "(long-context); combine with -l/-m")
     p.add_argument("--accum", type=int, default=None, metavar="K",
                    help="gradient accumulation: compute each minibatch's "
                         "gradient as K scanned microbatches before the "
@@ -209,7 +213,7 @@ def main(argv=None) -> int:
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, manhole=args.manhole, pp=args.pp,
         serve=args.serve, accum=args.accum, report=args.report,
-        tp=args.tp)
+        tp=args.tp, sp=args.sp)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

@@ -37,7 +37,7 @@ class Launcher(Logger):
                  fused: bool = False, manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
                  accum: Optional[int] = None, report: str = "",
-                 tp: Optional[int] = None,
+                 tp: Optional[int] = None, sp: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -87,6 +87,15 @@ class Launcher(Logger):
                              "mesh: combine with -l/-m (single-process "
                              "TP uses build_fused_step(mesh=...) directly)")
         self.tp = tp
+        #: sequence-parallel degree (ring attention over the mesh "seq"
+        #: axis) for distributed runs — the long-context axis, spanning
+        #: hosts the same way --tp does
+        if sp is not None and sp < 1:
+            raise SystemExit(f"--sp needs K >= 1 (got {sp})")
+        if sp and sp > 1 and not (listen or master):
+            raise SystemExit("--sp shards over the distributed global "
+                             "mesh: combine with -l/-m")
+        self.sp = sp
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -260,7 +269,8 @@ class Launcher(Logger):
                 from veles_tpu.parallel.distributed import is_coordinator
                 from veles_tpu.parallel.mesh import make_mesh
                 tp = self.tp or 1
-                mesh = make_mesh(jax.devices(), model=tp)
+                sp = self.sp or 1
+                mesh = make_mesh(jax.devices(), model=tp, seq=sp)
                 self.info(
                     "distributed %s: %d processes, %d global devices, "
                     "mesh %s", self.mode, self.n_processes,
@@ -272,8 +282,10 @@ class Launcher(Logger):
                     # processes racing os.replace on one snapshot path
                     # can publish a truncated file
                     self.workflow.snapshotter = None
+                # mode="auto": FusedTrainStep derives seq/gspmd/dp from
+                # the mesh axis sizes — one source of truth
                 self.workflow.run_fused(device=self.device, mesh=mesh,
-                                        mode="gspmd" if tp > 1 else "dp",
+                                        mode="auto",
                                         accum_steps=self.accum, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):
