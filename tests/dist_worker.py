@@ -25,6 +25,7 @@ def main() -> None:
     role, addr, pid = sys.argv[1], sys.argv[2], int(sys.argv[3])
     tp = int(sys.argv[4]) if len(sys.argv) > 4 else None
     sp = int(sys.argv[5]) if len(sys.argv) > 5 else None
+    ep = bool(int(sys.argv[6])) if len(sys.argv) > 6 else False
 
     import numpy as np
 
@@ -67,11 +68,38 @@ def main() -> None:
         root.char_transformer.parallel_mode = "ring"
         return create_workflow()
 
+    def moe_factory():
+        # expert parallelism across the process boundary: 8 experts over
+        # the 8-device data axis (1 expert resident per device)
+        import tempfile
+
+        from veles_tpu.config import root
+        from veles_tpu.samples.moe import create_workflow
+        from veles_tpu.snapshotter import Snapshotter
+        prng.seed_all(4321)
+        root.moe.loader.minibatch_size = 64
+        root.moe.loader.n_train = 256
+        root.moe.loader.n_validation = 64
+        root.moe.decision.max_epochs = 2
+        root.moe.decision.fail_iterations = 50
+        wf = create_workflow()
+        # snapshotting ON: the improved-epoch write_back gathers the
+        # cross-process expert shards — every process must enter that
+        # collective (workers get dry_run=True from the Launcher); this
+        # exercises the EP/TP + snapshot deadlock regression
+        snap = Snapshotter(wf, prefix="ep_dist",
+                           directory=tempfile.mkdtemp(prefix="ep_snap_"),
+                           keep_last=1)
+        snap.link_decision(wf.decision)
+        wf.snapshotter = snap
+        return wf
+
     launcher = Launcher(
         listen=addr if role == "coordinator" else "",
         master=addr if role == "worker" else "",
-        process_id=pid, n_processes=2, stats=False, tp=tp, sp=sp)
-    launcher.load(transformer_factory if (sp or 1) > 1 else factory)
+        process_id=pid, n_processes=2, stats=False, tp=tp, sp=sp, ep=ep)
+    launcher.load(moe_factory if ep
+                  else transformer_factory if (sp or 1) > 1 else factory)
     rc = launcher.main()
 
     wf = launcher.workflow
@@ -84,6 +112,7 @@ def main() -> None:
                 continue
             sums.append(float(np.abs(arr.mem).sum()))
             hexes.append(np.asarray(arr.mem).tobytes().hex()[:32])
+    snap = getattr(wf, "snapshotter", None)
     digest = {
         "role": role, "rc": rc,
         "n_global_devices": jax.device_count(),
@@ -91,6 +120,7 @@ def main() -> None:
         "best_validation_err": int(wf.decision.best_validation_err),
         "param_sums": sums,
         "param_digest": hexes,
+        "snapshot": (snap.destination if snap is not None else None),
     }
     print("DIGEST " + json.dumps(digest), flush=True)
 

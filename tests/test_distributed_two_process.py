@@ -112,3 +112,20 @@ def test_two_process_ring_attention_seq_parallel():
     # same trained state -> same metric on both hosts (learning quality
     # for the SP path is asserted in test_transformer_sp at unit scale)
     assert d0["best_validation_err"] == d1["best_validation_err"]
+
+
+def test_two_process_expert_parallel():
+    """MoE expert parallelism across the process boundary: 8 experts
+    sharded 1-per-device over a 2-process x 4-device data mesh, token
+    all_to_all crossing hosts; bit-identical trained params. Snapshotting
+    is ON: the improved-epoch write_back all-gathers expert shards and
+    every process must enter that collective (workers dry_run) — the
+    regression test for the asymmetric-collective deadlock."""
+    d0, d1 = _run_pair(extra_args=("1", "1", "1"), devices_per_process=4)
+    assert d0["rc"] == 0 and d1["rc"] == 0
+    assert d0["n_global_devices"] == 8 and d0["n_local_devices"] == 4
+    assert d0["param_digest"] == d1["param_digest"], (d0, d1)
+    assert d0["best_validation_err"] == d1["best_validation_err"]
+    # only the coordinator wrote a snapshot file; workers ran dry
+    assert d0["snapshot"] and os.path.exists(d0["snapshot"]), d0
+    assert not d1["snapshot"], d1

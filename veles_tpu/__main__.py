@@ -98,6 +98,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel degree for distributed runs: "
                         "ring attention over the mesh 'seq' axis "
                         "(long-context); combine with -l/-m")
+    p.add_argument("--ep", action="store_true",
+                   help="expert parallelism for distributed MoE runs: "
+                        "expert tensors sharded over the data axis, "
+                        "all_to_all token exchange; combine with -l/-m")
     p.add_argument("--accum", type=int, default=None, metavar="K",
                    help="gradient accumulation: compute each minibatch's "
                         "gradient as K scanned microbatches before the "
@@ -213,7 +217,7 @@ def main(argv=None) -> int:
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, manhole=args.manhole, pp=args.pp,
         serve=args.serve, accum=args.accum, report=args.report,
-        tp=args.tp, sp=args.sp)
+        tp=args.tp, sp=args.sp, ep=args.ep)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

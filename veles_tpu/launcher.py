@@ -38,6 +38,7 @@ class Launcher(Logger):
                  pp: Optional[int] = None, serve: Optional[int] = None,
                  accum: Optional[int] = None, report: str = "",
                  tp: Optional[int] = None, sp: Optional[int] = None,
+                 ep: bool = False,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -96,6 +97,18 @@ class Launcher(Logger):
             raise SystemExit("--sp shards over the distributed global "
                              "mesh: combine with -l/-m")
         self.sp = sp
+        #: expert parallelism for distributed runs: MoE expert tensors
+        #: sharded over the data axis, all_to_all token exchange (dp
+        #: mode only — the fused step composes it with the data mesh)
+        if ep and (tp and tp > 1 or sp and sp > 1):
+            raise SystemExit("--ep composes with the data axis; it is "
+                             "exclusive with --tp/--sp in this launcher")
+        if ep and not (listen or master):
+            raise SystemExit("--ep shards experts over the distributed "
+                             "global mesh: combine with -l/-m "
+                             "(single-process EP uses "
+                             "build_fused_step(ep=True) directly)")
+        self.ep = bool(ep)
         self.listen = listen            # coordinator address to bind
         self.master = master            # coordinator address to join
         self.process_id = process_id
@@ -277,15 +290,18 @@ class Launcher(Logger):
                     jax.device_count(), dict(mesh.shape))
                 if not is_coordinator() and getattr(
                         self.workflow, "snapshotter", None) is not None:
-                    # host-side side effects are coordinator-only: every
-                    # process holds identical replicated params, and two
-                    # processes racing os.replace on one snapshot path
-                    # can publish a truncated file
-                    self.workflow.snapshotter = None
+                    # FILE writes are coordinator-only (two processes
+                    # racing os.replace can publish a truncated file) —
+                    # but the unit must KEEP EXISTING on workers: the
+                    # snapshot branch in _run_with_step is keyed on it,
+                    # and under EP/TP its write_back is a cross-process
+                    # all-gather that every process must enter (an
+                    # asymmetric collective deadlocks the job)
+                    self.workflow.snapshotter.dry_run = True
                 # mode="auto": FusedTrainStep derives seq/gspmd/dp from
                 # the mesh axis sizes — one source of truth
                 self.workflow.run_fused(device=self.device, mesh=mesh,
-                                        mode="auto",
+                                        mode="auto", ep=self.ep,
                                         accum_steps=self.accum, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):

@@ -163,6 +163,9 @@ class FusedTrainStep:
                 if hasattr(u, "prefer_pallas"):
                     u.prefer_pallas = False
         self.mode = mode
+        #: cached identity-jit that gathers cross-process shards to a
+        #: replicated array (write_back's host() path); built lazily
+        self._gather_fn = None
         # expert parallelism rides the data axis (DeepSpeed-MoE style: the
         # EP group IS the DP group): expert tensors shard over "data" in
         # the shard_map specs and MoE units run the all_to_all exchange
@@ -239,6 +242,20 @@ class FusedTrainStep:
         def deleted(a) -> bool:
             return getattr(a, "is_deleted", lambda: False)()
 
+        def host(a):
+            if getattr(a, "is_fully_addressable", True):
+                return np.asarray(a)
+            # sharded ACROSS processes (EP experts / TP shards over a
+            # multi-host mesh): gather to a replicated global array
+            # first — np.asarray on a non-addressable array raises.
+            # NOTE this is a collective: callers must invoke write_back
+            # on EVERY process (see Launcher's snapshotter.dry_run).
+            if self._gather_fn is None:
+                self._gather_fn = jax.jit(
+                    lambda t: t,
+                    out_shardings=NamedSharding(self.mesh, P()))
+            return np.asarray(self._gather_fn(a))
+
         for u, g, p, v, cfg in zip(self.forwards, self.gd_units,
                                    state["params"], state["vel"],
                                    self.cfgs):
@@ -246,7 +263,7 @@ class FusedTrainStep:
             for k, arr in u.param_arrays().items():
                 if deleted(p[k]) or (not adam and deleted(v[k])):
                     continue  # donated-away buffer: keep last value
-                arr.reset(np.asarray(p[k]))
+                arr.reset(host(p[k]))
                 if adam:
                     continue  # moments stay in the fused state pytree
                 # momentum velocities land in the GD twin so a snapshot
@@ -254,7 +271,7 @@ class FusedTrainStep:
                 # whole-workflow pickle includes optimizer state)
                 vname = _vel_attr(g, k)
                 if vname is not None:
-                    getattr(g, vname).reset(np.asarray(v[k]))
+                    getattr(g, vname).reset(host(v[k]))
 
     def _check_batch(self, n: int) -> None:
         """The actual fed batch must divide the data axis (checked per call

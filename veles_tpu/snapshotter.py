@@ -70,6 +70,10 @@ class SnapshotterBase(Unit):
         #: endpoint works. Best-effort: the local file (what resume
         #: reads) is authoritative, a failed mirror only warns.
         self.upload_url = upload_url
+        #: distributed workers run the SAME control flow (so sharded-
+        #: param gathers in write_back stay symmetric across processes)
+        #: but skip the actual file export — set by the Launcher
+        self.dry_run = False
         #: fire every `interval`-th run (epoch), like the reference's skip
         self.interval = interval
         #: minimum seconds between snapshots (0 = no rate limit)
@@ -116,6 +120,8 @@ class SnapshotterBase(Unit):
             err = dec.best_validation_err
             self.suffix = (f"{err:.6g}" if isinstance(err, float)
                            else str(err))
+        if self.dry_run:
+            return      # worker process: bookkeeping only, no file
         self.destination = self.export()
         self.info("snapshot -> %s", self.destination)
         if self.upload_url:
