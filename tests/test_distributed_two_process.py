@@ -129,3 +129,14 @@ def test_two_process_expert_parallel():
     # only the coordinator wrote a snapshot file; workers ran dry
     assert d0["snapshot"] and os.path.exists(d0["snapshot"]), d0
     assert not d1["snapshot"], d1
+
+
+def test_two_process_three_axis_mesh():
+    """The full 3-axis composition ACROSS hosts: data=2 x seq=2 x
+    model=2 over 2 processes x 4 devices — ring attention and megatron
+    TP collectives both crossing the process boundary."""
+    d0, d1 = _run_pair(extra_args=("2", "2"), devices_per_process=4)
+    assert d0["rc"] == 0 and d1["rc"] == 0
+    assert d0["n_global_devices"] == 8
+    assert d0["param_digest"] == d1["param_digest"], (d0, d1)
+    assert d0["best_validation_err"] == d1["best_validation_err"]
