@@ -26,6 +26,7 @@ def main() -> None:
     tp = int(sys.argv[4]) if len(sys.argv) > 4 else None
     sp = int(sys.argv[5]) if len(sys.argv) > 5 else None
     ep = bool(int(sys.argv[6])) if len(sys.argv) > 6 else False
+    pp = int(sys.argv[7]) if len(sys.argv) > 7 else None
 
     import numpy as np
 
@@ -39,9 +40,14 @@ def main() -> None:
         loader = SyntheticClassifierLoader(
             n_classes=4, sample_shape=(8,), n_validation=32, n_train=128,
             minibatch_size=32, noise=0.3)
+        # 4 layers so --pp runs can place one stage per global device
         return StandardWorkflow(
             layers=[
                 {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.1},
+                {"type": "all2all_tanh", "output_sample_shape": 12,
+                 "weights_stddev": 0.1},
+                {"type": "all2all_tanh", "output_sample_shape": 12,
                  "weights_stddev": 0.1},
                 {"type": "softmax", "output_sample_shape": 4,
                  "weights_stddev": 0.05},
@@ -97,7 +103,8 @@ def main() -> None:
     launcher = Launcher(
         listen=addr if role == "coordinator" else "",
         master=addr if role == "worker" else "",
-        process_id=pid, n_processes=2, stats=False, tp=tp, sp=sp, ep=ep)
+        process_id=pid, n_processes=2, stats=False, tp=tp, sp=sp, ep=ep,
+        pp=pp)
     launcher.load(moe_factory if ep
                   else transformer_factory if (sp or 1) > 1 else factory)
     rc = launcher.main()

@@ -140,3 +140,22 @@ def test_two_process_three_axis_mesh():
     assert d0["n_global_devices"] == 8
     assert d0["param_digest"] == d1["param_digest"], (d0, d1)
     assert d0["best_validation_err"] == d1["best_validation_err"]
+
+
+def test_two_process_pipeline_parallel():
+    """GPipe ACROSS hosts: 4 heterogeneous stages over a 2-process
+    global mesh — microbatch activations ppermute over the process
+    boundary both directions (fwd chain + backward), and the
+    stage-RESIDENT params gather symmetrically at write_back.
+
+    4 devices per process with only 4 stages: the stage devices must be
+    spread ROUND-ROBIN over processes (regression: a first-N prefix
+    would pin every stage to process 0, and process 1 — outside the
+    mesh — crashed at the write_back gather)."""
+    d0, d1 = _run_pair(extra_args=("1", "1", "0", "4"),
+                       devices_per_process=4)
+    assert d0["rc"] == 0 and d1["rc"] == 0
+    assert d0["n_global_devices"] == 8 and d0["n_local_devices"] == 4
+    assert d0["param_digest"] == d1["param_digest"], (d0, d1)
+    # the pipeline actually learned the separable classes
+    assert d0["best_validation_err"] < 16, d0

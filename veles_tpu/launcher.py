@@ -64,10 +64,6 @@ class Launcher(Logger):
         if pp and fused:
             raise SystemExit("--pp and --fused are mutually exclusive "
                              "execution modes")
-        if pp and (listen or master):
-            raise SystemExit("--pp is single-process (pipeline over the "
-                             "local stage mesh); distributed runs use "
-                             "the fused dp step")
         self.pp = pp
         #: gradient accumulation microbatch count for fused/distributed
         #: training (run_fused accum_steps; SURVEY.md §2.8 slot)
@@ -76,6 +72,9 @@ class Launcher(Logger):
         if accum and accum > 1 and not (fused or listen or master):
             raise SystemExit("--accum applies to the fused step: combine "
                              "with --fused or a distributed -l/-m run")
+        if accum and accum > 1 and pp:
+            raise SystemExit("--accum applies to the fused step, not the "
+                             "GPipe pipeline (--pp already microbatches)")
         self.accum = accum
         #: tensor-parallel degree for distributed runs: the global mesh
         #: becomes (data = n_devices/K, model = K) and the fused step
@@ -103,6 +102,10 @@ class Launcher(Logger):
         if ep and (tp and tp > 1 or sp and sp > 1):
             raise SystemExit("--ep composes with the data axis; it is "
                              "exclusive with --tp/--sp in this launcher")
+        if pp and (ep or (tp and tp > 1) or (sp and sp > 1)):
+            raise SystemExit("--pp is its own partitioning (one stage "
+                             "per mesh device); it is exclusive with "
+                             "--tp/--sp/--ep")
         if ep and not (listen or master):
             raise SystemExit("--ep shards experts over the distributed "
                              "global mesh: combine with -l/-m "
@@ -280,14 +283,6 @@ class Launcher(Logger):
                 import jax
 
                 from veles_tpu.parallel.distributed import is_coordinator
-                from veles_tpu.parallel.mesh import make_mesh
-                tp = self.tp or 1
-                sp = self.sp or 1
-                mesh = make_mesh(jax.devices(), model=tp, seq=sp)
-                self.info(
-                    "distributed %s: %d processes, %d global devices, "
-                    "mesh %s", self.mode, self.n_processes,
-                    jax.device_count(), dict(mesh.shape))
                 if not is_coordinator() and getattr(
                         self.workflow, "snapshotter", None) is not None:
                     # FILE writes are coordinator-only (two processes
@@ -298,11 +293,51 @@ class Launcher(Logger):
                     # all-gather that every process must enter (an
                     # asymmetric collective deadlocks the job)
                     self.workflow.snapshotter.dry_run = True
-                # mode="auto": FusedTrainStep derives seq/gspmd/dp from
-                # the mesh axis sizes — one source of truth
-                self.workflow.run_fused(device=self.device, mesh=mesh,
-                                        mode="auto", ep=self.ep,
-                                        accum_steps=self.accum, **kwargs)
+                if self.pp:
+                    # GPipe stages over the GLOBAL device set, spread
+                    # ROUND-ROBIN over processes: a first-N prefix could
+                    # leave a process with no stage device, and a
+                    # process outside the mesh cannot join the param
+                    # gathers at write_back (asymmetric crash)
+                    from veles_tpu.parallel.pipeline import make_stage_mesh
+                    n_stages = max(1, min(len(jax.devices()),
+                                          len(self.workflow.forwards)))
+                    if n_stages < self.n_processes:
+                        raise SystemExit(
+                            f"distributed --pp needs >= one stage per "
+                            f"process: {n_stages} stages < "
+                            f"{self.n_processes} processes")
+                    by_proc: dict = {}
+                    for d in jax.devices():
+                        by_proc.setdefault(d.process_index, []).append(d)
+                    stage_devs, i = [], 0
+                    procs = sorted(by_proc)
+                    while len(stage_devs) < n_stages:
+                        p = by_proc[procs[i % len(procs)]]
+                        if p:
+                            stage_devs.append(p.pop(0))
+                        i += 1
+                    smesh = make_stage_mesh(stage_devs)
+                    self.info(
+                        "distributed %s: %d processes, stage mesh %s",
+                        self.mode, self.n_processes, dict(smesh.shape))
+                    self.workflow.run_pipelined(
+                        mesh=smesh, n_microbatches=self.pp,
+                        device=self.device, **kwargs)
+                else:
+                    from veles_tpu.parallel.mesh import make_mesh
+                    mesh = make_mesh(jax.devices(), model=self.tp or 1,
+                                     seq=self.sp or 1)
+                    self.info(
+                        "distributed %s: %d processes, %d global "
+                        "devices, mesh %s", self.mode, self.n_processes,
+                        jax.device_count(), dict(mesh.shape))
+                    # mode="auto": FusedTrainStep derives seq/gspmd/dp
+                    # from the mesh axis sizes — one source of truth
+                    self.workflow.run_fused(device=self.device, mesh=mesh,
+                                            mode="auto", ep=self.ep,
+                                            accum_steps=self.accum,
+                                            **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):
                     raise SystemExit(

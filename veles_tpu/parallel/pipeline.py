@@ -278,6 +278,16 @@ class PipelineTrainStep:
 
     # -- state ----------------------------------------------------------------
 
+    def _put_staged(self, x, sh):
+        """device_put, or — when the stage mesh spans processes (PP over
+        DCN) — a jit reshard, since device_put rejects shardings with
+        non-addressable devices (same convention as FusedTrainStep.
+        _shard_state: the host value is identical on every process)."""
+        if any(d.process_index != jax.process_index()
+               for d in self.mesh.devices.flat):
+            return jax.jit(lambda t: t, out_shardings=sh)(x)
+        return jax.device_put(x, sh)
+
     def init_state(self) -> Dict[str, Any]:
         from veles_tpu import prng
         s = len(self.stages)
@@ -288,16 +298,29 @@ class PipelineTrainStep:
                     self.forwards[i].param_arrays()[name].mem.ravel()
         sh = self._stage_sharding()
         if getattr(self, "_gid", None) is None:
-            self._gid = jax.device_put(self._gid_host, sh)
-        return {"params": jax.device_put(flat, sh),
-                "vel": jax.device_put(np.zeros_like(flat), sh),
+            self._gid = self._put_staged(self._gid_host, sh)
+        return {"params": self._put_staged(flat, sh),
+                "vel": self._put_staged(np.zeros_like(flat), sh),
                 "key": prng.get().next_key(),
                 "lr_scale": jnp.float32(1.0)}
 
     def params_dicts(self, state) -> tuple:
         """Host-side per-layer param dicts recovered from the flat rows
         (tests/introspection; write_back uses the same unflatten)."""
-        flat = np.asarray(state["params"])
+        flat = state["params"]
+        if not getattr(flat, "is_fully_addressable", True):
+            # stage rows live on remote processes (PP over DCN): gather
+            # to replicated first. COLLECTIVE — every process must call
+            # write_back/params_dicts at the same point (they do: the
+            # _run_with_step paths are symmetric). Cached like fused's
+            # _gather_fn so repeated write_backs reuse the executable.
+            if getattr(self, "_gather_fn", None) is None:
+                from jax.sharding import NamedSharding
+                self._gather_fn = jax.jit(
+                    lambda t: t,
+                    out_shardings=NamedSharding(self.mesh, P()))
+            flat = self._gather_fn(flat)
+        flat = np.asarray(flat)
         out = [dict() for _ in self.forwards]
         for si, lay in enumerate(self._layouts):
             for i, name, shape, lo, hi in lay:
