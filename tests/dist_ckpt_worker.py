@@ -1,0 +1,77 @@
+"""Subprocess body for the multi-host sharded-checkpoint test: both
+processes train a gspmd (dp x tp) step over the 8-device global mesh,
+save the SHARDED state via Orbax (each host writes only its addressable
+shards), then restore into a freshly built step and verify the
+continued trajectory is exactly the uninterrupted one.
+
+Not a pytest file (no test_ prefix): launched by
+tests/test_distributed_two_process.py.
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    role, addr, pid, ckdir = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                              sys.argv[4])
+    jax.distributed.initialize(coordinator_address=addr, num_processes=2,
+                               process_id=pid)
+
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.parallel.checkpoint import restore_state, save_state
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    def build():
+        prng.seed_all(4321)
+        loader = SyntheticClassifierLoader(
+            n_classes=4, sample_shape=(8,), n_validation=32, n_train=128,
+            minibatch_size=32, noise=0.3)
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                     "weights_stddev": 0.1},
+                    {"type": "softmax", "output_sample_shape": 4,
+                     "weights_stddev": 0.05}],
+            loader=loader, loss="softmax", n_classes=4,
+            decision_config={"max_epochs": 2, "fail_iterations": 50},
+            gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+            name="CkptWF")
+        wf.initialize(device=None)
+        return wf
+
+    wf = build()
+    mesh = make_mesh(jax.devices(), model=2)
+    step = wf.build_fused_step(mesh=mesh, mode="gspmd")
+    state = step.init_state()
+    x = wf.loader.data.mem[:32]
+    y = wf.loader.labels.mem[:32]
+    state, _ = step.train(state, x, y)
+    save_state(state, ckdir)
+
+    ref = state                      # uninterrupted trajectory
+    for _ in range(2):
+        ref, (l_ref, _) = step.train(ref, x, y)
+
+    wf2 = build()                    # fresh step, restore, continue
+    step2 = wf2.build_fused_step(mesh=mesh, mode="gspmd")
+    restored = restore_state(step2, ckdir)
+    for _ in range(2):
+        restored, (l_res, _) = step2.train(restored, x, y)
+
+    print("DIGEST " + json.dumps({
+        "role": role, "rc": 0,
+        "n_global_devices": jax.device_count(),
+        "loss_uninterrupted": float(l_ref),
+        "loss_resumed": float(l_res),
+        "delta": abs(float(l_ref) - float(l_res)),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
