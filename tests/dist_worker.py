@@ -26,7 +26,8 @@ def main() -> None:
     tp = int(sys.argv[4]) if len(sys.argv) > 4 else None
     sp = int(sys.argv[5]) if len(sys.argv) > 5 else None
     ep = bool(int(sys.argv[6])) if len(sys.argv) > 6 else False
-    pp = int(sys.argv[7]) if len(sys.argv) > 7 else None
+    pp = (int(sys.argv[7]) or None) if len(sys.argv) > 7 else None
+    attn = sys.argv[8] if len(sys.argv) > 8 else "ring"
 
     import numpy as np
 
@@ -71,7 +72,7 @@ def main() -> None:
         root.char_transformer.moe_experts = 0
         root.char_transformer.decision.max_epochs = 2
         root.char_transformer.decision.fail_iterations = 50
-        root.char_transformer.parallel_mode = "ring"
+        root.char_transformer.parallel_mode = attn
         return create_workflow()
 
     def moe_factory():

@@ -101,12 +101,15 @@ def test_two_process_hybrid_dp_tp_mesh():
     assert d0["best_validation_err"] < 16, d0
 
 
-def test_two_process_ring_attention_seq_parallel():
-    """Long-context over the DCN analog: ring attention with the mesh
-    "seq" axis spanning 2 processes (2 x 4 virtual devices, --sp 2) —
-    the char-transformer trains with KV blocks ppermute-ing across the
-    process boundary, bit-identical params on both hosts."""
-    d0, d1 = _run_pair(extra_args=("1", "2"), devices_per_process=4)
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_two_process_seq_parallel(attn):
+    """Long-context over the DCN analog: the mesh "seq" axis spans 2
+    processes (2 x 4 virtual devices, --sp 2) — the char-transformer
+    trains with ring KV blocks ppermute-ing (or Ulysses all_to_all
+    exchanging sequence shards for head shards) across the process
+    boundary, bit-identical params on both hosts."""
+    d0, d1 = _run_pair(extra_args=("1", "2", "0", "0", attn),
+                       devices_per_process=4)
     assert d0["rc"] == 0 and d1["rc"] == 0
     assert d0["n_global_devices"] == 8 and d0["n_local_devices"] == 4
     assert d0["param_digest"] == d1["param_digest"], (d0, d1)
