@@ -1,0 +1,95 @@
+"""Subprocess body for the multi-host INPUT-SHARDING test: a
+deterministic PrefetchingLoader (rows are a pure function of the sample
+index) trains DP over 2 processes twice — once plain-local (reference
+trajectory, full decode) and once over the cross-process mesh, where
+run_fused wires `loader.local_rows_fn` so each host decodes ONLY the
+rows its shards own. The digests carry trained params + rows_decoded so
+the parent asserts (a) sharded == local numerics and (b) each host
+really decoded about half the rows.
+
+Not a pytest file (no test_ prefix)."""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    role, addr, pid = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    jax.distributed.initialize(coordinator_address=addr, num_processes=2,
+                               process_id=pid)
+
+    from veles_tpu import prng
+    from veles_tpu.loader.base import PrefetchingLoader
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    DIM, NCLS = 8, 4
+
+    class HashLoader(PrefetchingLoader):
+        """Rows/labels are pure functions of the global sample index, so
+        any subset decode is bit-identical to the full decode."""
+
+        def __init__(self, workflow=None, n_train=128, n_validation=32,
+                     **kw) -> None:
+            super().__init__(workflow, **kw)
+            self.split = (0, n_validation, n_train)
+
+        def load_data(self) -> None:
+            self.class_lengths = list(self.split)
+
+        def _produce_batch(self, indices):
+            idx = np.asarray(indices, np.int64)
+            labels = (idx * 2654435761 % NCLS).astype(np.int64)
+            protos = 3.0 * np.eye(NCLS, DIM, dtype=np.float32)
+            phase = idx[:, None] * 0.7 + np.arange(DIM)[None, :] * 1.3
+            x = protos[labels] + 0.3 * np.sin(phase).astype(np.float32)
+            return x, labels
+
+    def build():
+        prng.seed_all(4321)
+        loader = HashLoader(minibatch_size=32, n_workers=2, prefetch=2)
+        return StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                     "weights_stddev": 0.1},
+                    {"type": "softmax", "output_sample_shape": NCLS,
+                     "weights_stddev": 0.05}],
+            loader=loader, loss="softmax", n_classes=NCLS,
+            decision_config={"max_epochs": 2, "fail_iterations": 50},
+            gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+            name="ShardWF")
+
+    # reference: plain local fused run, full decode (identical on both
+    # processes — no mesh, local devices only)
+    wf_ref = build()
+    wf_ref.run_fused()
+    ref_rows = wf_ref.loader.rows_decoded
+    ref_params = [np.asarray(u.weights.mem) for u in wf_ref.forwards]
+
+    # sharded: DP over the cross-process mesh; local_rows_fn wired by
+    # run_fused -> each host decodes only its own shard rows
+    wf = build()
+    wf.run_fused(mesh=make_mesh(jax.devices()))
+    shard_rows = wf.loader.rows_decoded
+    params = [np.asarray(u.weights.mem) for u in wf.forwards]
+
+    max_delta = max(float(np.max(np.abs(a - b)))
+                    for a, b in zip(ref_params, params))
+    print("DIGEST " + json.dumps({
+        "role": role, "rc": 0,
+        "n_global_devices": jax.device_count(),
+        "rows_decoded_local_run": ref_rows,
+        "rows_decoded_sharded_run": shard_rows,
+        "params_max_delta_vs_local": max_delta,
+        "param_digest": [a.tobytes().hex()[:32] for a in params],
+        "best_validation_err": int(wf.decision.best_validation_err),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -176,3 +176,25 @@ def test_two_process_sharded_checkpoint_exact_resume(tmp_path):
                             "dist_ckpt_worker.py"))
     assert d0["n_global_devices"] == 8
     assert d0["delta"] == 0.0 and d1["delta"] == 0.0, (d0, d1)
+
+
+def test_two_process_input_sharding_halves_host_decode(tmp_path):
+    """Multi-host input sharding (the BASELINE.md per-host claim, made
+    real): with the mesh spanning 2 processes, run_fused wires
+    `loader.local_rows_fn` and each host DECODES only the rows its
+    shards own — about half — while the trained params match the
+    full-decode local run exactly (zero-filled non-local rows are never
+    transferred or read)."""
+    d0, d1 = _run_pair(
+        worker=os.path.join(os.path.dirname(__file__),
+                            "dist_shard_worker.py"))
+    for d in (d0, d1):
+        assert d["n_global_devices"] == 2
+        # numerics: sharded-decode == full-decode local trajectory
+        assert d["params_max_delta_vs_local"] < 1e-5, d
+        # each host decoded roughly half of what the local run decoded
+        # (prefetch-lookahead overshoot keeps it above the exact half;
+        # measured 224 vs 352 on this schedule)
+        assert d["rows_decoded_sharded_run"] <= \
+            0.7 * d["rows_decoded_local_run"], d
+    assert d0["param_digest"] == d1["param_digest"]

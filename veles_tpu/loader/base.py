@@ -217,6 +217,18 @@ class PrefetchingLoader(Loader):
         self._hflip_seed = 0
         self._pool = None
         self._pending: dict = {}
+        #: multi-host input sharding: when set (by run_fused on a mesh
+        #: spanning processes), `local_rows_fn(n) -> bool (n,)` marks the
+        #: GLOBAL batch rows whose device shards this process owns. Only
+        #: those rows are decoded; the rest are zero-filled — the jit's
+        #: data-axis in_shardings never transfer or read them, so host
+        #: decode cost divides by the host count (the BASELINE.md
+        #: per-host-sharding claim, made real). Not pickled: re-wired by
+        #: the next run.
+        self.local_rows_fn = None
+        #: decoded-row counter (tests/observability)
+        self.rows_decoded = 0
+        self._count_lock = None
 
     def initialize(self, device=None, **kwargs: Any):
         # a restored loader keeps its pickled flip seed (and must NOT
@@ -260,9 +272,36 @@ class PrefetchingLoader(Loader):
             x[flip] = x[flip, :, ::-1]
         return x
 
-    def _produce(self, indices: np.ndarray):
+    def _produce_rows(self, indices: np.ndarray):
+        """Materialize rows for exactly these indices (subclass hook for
+        custom gather paths; the default decodes + augments)."""
         x, y = self._produce_batch(indices)
         return self._augment(x, indices), y
+
+    def _produce(self, indices: np.ndarray):
+        fn = self.local_rows_fn
+        if fn is not None:
+            mask = np.asarray(fn(len(indices)))
+            if not mask.all():
+                x, y = self._produce_rows(indices[mask])
+                self._count_rows(int(mask.sum()))
+                fx = np.zeros((len(indices),) + x.shape[1:], x.dtype)
+                fy = np.zeros((len(indices),) + y.shape[1:], y.dtype)
+                fx[mask] = x
+                fy[mask] = y
+                return fx, fy
+        x, y = self._produce_rows(indices)
+        self._count_rows(len(indices))
+        return x, y
+
+    def _count_rows(self, n: int) -> None:
+        # _produce runs on pool worker threads: a bare += would lose
+        # increments under interleaving
+        import threading
+        if self._count_lock is None:
+            self._count_lock = threading.Lock()
+        with self._count_lock:
+            self.rows_decoded += n
 
     def _indices_at(self, cursor: int) -> Optional[np.ndarray]:
         if cursor >= len(self._schedule):
@@ -329,4 +368,6 @@ class PrefetchingLoader(Loader):
         d = super().__getstate__()
         d["_pool"] = None
         d["_pending"] = {}
+        d["_count_lock"] = None
+        d["local_rows_fn"] = None   # step-bound closure: re-wired by run
         return d

@@ -205,13 +205,15 @@ class MemmapImageLoader(PrefetchingLoader):
         from veles_tpu import native_gather
         return native_gather.available()
 
-    def _produce(self, indices: np.ndarray):
+    def _produce_rows(self, indices: np.ndarray):
         """Gather + seeded hflip + normalize, with augmentation applied
         to the RAW BYTES before normalization (a flipped training image
         must be normalized exactly like any other image — the mean image
         is not flipped with it; both emit modes and both gather paths
         agree on this order). The generic `_augment` post-hook is
-        superseded, so it must not run again."""
+        superseded, so it must not run again. Overriding THIS hook (not
+        `_produce`) keeps the base's multi-host local-rows sharding and
+        decode accounting."""
         x, y = self._gather(indices, self._flip_mask(indices))
         return x, y
 

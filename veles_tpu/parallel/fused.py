@@ -273,6 +273,39 @@ class FusedTrainStep:
                 if vname is not None:
                     getattr(g, vname).reset(host(v[k]))
 
+    def local_rows(self, n: int):
+        """Boolean (n,) mask of GLOBAL batch rows whose data-axis shards
+        are addressable from THIS process — the rows a loader must
+        actually materialize. Non-local rows may stay zero-filled: the
+        uniform-host-input jit transfers only local shards, so their
+        values are never read. All-true on single-process meshes (and
+        for batch sizes the data axis doesn't divide — callers fall back
+        to full decode rather than guessing the layout). Cached per n:
+        it runs per produced batch on the host-decode hot path."""
+        cache = getattr(self, "_local_rows_cache", None)
+        if cache is None:
+            cache = self._local_rows_cache = {}
+        if n in cache:
+            return cache[n]
+        if self.mesh is None:
+            mask = np.ones(n, bool)
+        else:
+            ndata = self.mesh.shape.get(DATA_AXIS, 1)
+            if ndata <= 1 or n % ndata:
+                mask = np.ones(n, bool)
+            else:
+                pidx = jax.process_index()
+                block = n // ndata
+                mask = np.zeros(n, bool)
+                # mesh.devices is (data, seq, model): every device in
+                # row d holds (a piece of) rows [d*block, (d+1)*block)
+                for d in range(ndata):
+                    if any(dev.process_index == pidx
+                           for dev in self.mesh.devices[d].flat):
+                        mask[d * block:(d + 1) * block] = True
+        cache[n] = mask
+        return mask
+
     def _check_batch(self, n: int) -> None:
         """The actual fed batch must divide the data axis (checked per call
         so callers that feed their own batches — e.g. the scaling harness —

@@ -350,6 +350,18 @@ class StandardWorkflow(Workflow):
         # the fused step uploads (sharded) itself; the loader's granular-path
         # device push would be a second, wasted H2D transfer per minibatch
         prev_on_device, loader.on_device = loader.on_device, False
+        # multi-host input sharding: tell a prefetching loader which
+        # global batch rows this process's shards own, so host decode
+        # divides by the host count (non-local rows zero-fill; the jit
+        # never transfers or reads them)
+        prev_rows_fn = getattr(loader, "local_rows_fn", None)
+        mesh = getattr(step, "mesh", None)
+        if (hasattr(loader, "local_rows_fn")
+                and hasattr(step, "local_rows") and mesh is not None):
+            import jax as _jax
+            if any(d.process_index != _jax.process_index()
+                   for d in mesh.devices.flat):
+                loader.local_rows_fn = step.local_rows
         try:
             # Metrics accumulate ON DEVICE across each class pass (lazy
             # scalar adds); the single host sync happens at last_minibatch,
@@ -410,6 +422,8 @@ class StandardWorkflow(Workflow):
                     self.snapshotter.run()
         finally:
             loader.on_device = prev_on_device
+            if hasattr(loader, "local_rows_fn"):
+                loader.local_rows_fn = prev_rows_fn
             step.write_back(state)
             self.fused_state = state
             self._stop_units()   # release loader prefetch threads etc.
