@@ -125,10 +125,25 @@ def test_standard_workflow_plot_config_granular_and_fused(tmp_path):
     val = next(p for p in curves if p.label == "validation")
     assert val.values[-1] == wf.decision.epoch_metrics[1]
 
-    wf2 = build()
-    wf2.run_fused()
-    curves2 = [p for p in wf2.plotters if hasattr(p, "values")]
-    assert all(len(p.values) == 3 for p in curves2)
+    # fused mode accumulates the VALIDATION confusion matrix too (via
+    # step.confusion): the MatrixPlotter publishes a real heatmap each
+    # epoch instead of silently skipping an all-zeros matrix — route the
+    # default renderer at a fresh dir to observe the artifact
+    from veles_tpu import plotter as plotter_mod
+    saved_renderer = plotter_mod._default_renderer
+    r2 = GraphicsRenderer(str(tmp_path / "fusedplots"))
+    r2.start()
+    plotter_mod._default_renderer = r2
+    try:
+        wf2 = build()
+        wf2.run_fused()
+        curves2 = [p for p in wf2.plotters if hasattr(p, "values")]
+        assert all(len(p.values) == 3 for p in curves2)
+    finally:
+        r2.stop()
+        plotter_mod._default_renderer = saved_renderer
+    rendered = os.listdir(tmp_path / "fusedplots")
+    assert any(f.startswith("confusion") for f in rendered), rendered
 
 
 def test_renderer_process_mode(tmp_path):

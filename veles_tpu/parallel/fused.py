@@ -166,6 +166,8 @@ class FusedTrainStep:
         #: cached identity-jit that gathers cross-process shards to a
         #: replicated array (write_back's host() path); built lazily
         self._gather_fn = None
+        #: cached per-n_classes confusion jits (see confusion())
+        self._conf_fns = None
         # expert parallelism rides the data axis (DeepSpeed-MoE style: the
         # EP group IS the DP group): expert tensors shard over "data" in
         # the shard_map specs and MoE units run the all_to_all exchange
@@ -347,12 +349,18 @@ class FusedTrainStep:
 
     # -- forward chain -------------------------------------------------------
 
-    def _forward(self, params, x, key, train: bool):
+    def _forward(self, params, x, key, train: bool,
+                 local_trace: bool = False):
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
             params = _tree_cast(params, self.compute_dtype)
-        seq_axis = SEQ_AXIS if self.mode == "seq" else None
-        ep_axis = DATA_AXIS if self.ep else None
+        # local_trace: trace the DENSE single-program form (no bound
+        # collective axis names) for use under plain jit — GSPMD handles
+        # any param sharding, gathering EP experts where needed (the
+        # confusion companion uses this)
+        seq_axis = (SEQ_AXIS if self.mode == "seq" and not local_trace
+                    else None)
+        ep_axis = DATA_AXIS if self.ep and not local_trace else None
         for i, u in enumerate(self.forwards):
             if hasattr(u, "seq_axis_name"):
                 # set at trace time so several step objects (different
@@ -792,6 +800,49 @@ class FusedTrainStep:
         w = self._weights_or_ones(w, np.shape(x)[0])
         new_state, loss, n_err = self._train_fn(state, x, y, w)
         return new_state, (loss, n_err)
+
+    def confusion(self, state, x, y, n_classes: int, w=None):
+        """(C, C) confusion counts (true row, predicted col) for one
+        minibatch, pad-mask weighted — the fused-mode companion of
+        EvaluatorSoftmax's per-minibatch accumulation (the granular
+        graph fills it unit-side; the fused step otherwise never
+        materializes predictions). Traced dense (`local_trace`): plain
+        jit + GSPMD propagation covers sharded params. Returns None for
+        non-classifier output shapes (seq heads etc.)."""
+        if getattr(self._last_fwd(), "output", None) is None:
+            return None
+        out_shape = getattr(self._last_fwd().output, "shape", ())
+        if len(out_shape) != 2 or np.size(y) != np.shape(x)[0]:
+            # (N, C) one-label-per-sample classifier heads only: flat
+            # (N*S,) sequence heads would need per-position pad-weight
+            # repeats (granular mode's _w_repeat) — not worth a second
+            # convention here
+            return None
+        if self.mesh is not None and any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat):
+            # multi-host: the per-host input sharding zero-fills
+            # non-local rows, which a dense plain-jit forward WOULD read
+            # (unlike the sharded evaluate) — skip rather than corrupt
+            return None
+        if self._conf_fns is None:
+            self._conf_fns = {}
+        fn = self._conf_fns.get(n_classes)
+        if fn is None:
+            def body(params, xb, yb, wb):
+                out = self._forward(params, xb,
+                                    jax.random.PRNGKey(0), False,
+                                    local_trace=True)
+                pred = jnp.argmax(out, axis=-1).reshape(-1)
+                yr = yb.reshape(-1).astype(jnp.int32)
+                m = jnp.zeros((n_classes, n_classes), jnp.float32)
+                return m.at[yr, pred].add(wb.reshape(-1))
+            fn = self._conf_fns[n_classes] = jax.jit(body)
+        w = self._weights_or_ones(w, np.shape(x)[0])
+        return np.asarray(fn(state["params"], x, y, w))
+
+    def _last_fwd(self):
+        return self.forwards[-1] if self.forwards else None
 
     def evaluate(self, state, x, y, w=None):
         """Forward-only metrics (validation/test minibatches)."""

@@ -161,9 +161,10 @@ class StandardWorkflow(Workflow):
         {"error_curve": True, "confusion": True, "weights": True} (any
         subset). Plotters fire once per epoch (gated on the loader's
         epoch boundary) in granular mode; run_fused drives the same
-        units at its epoch boundaries (note: the confusion matrix is a
-        granular-evaluator product — under run_fused it stays at its
-        initial zeros, since the fused step keeps metrics as scalars)."""
+        units at its epoch boundaries, accumulating the validation
+        confusion matrix through the step's `confusion()` companion
+        (single-host classifier heads; sequence heads and multi-host
+        meshes skip it — see FusedTrainStep.confusion)."""
         from veles_tpu.plotting_units import (AccumulatingPlotter,
                                               MatrixPlotter, Weights2D)
         if cfg.get("error_curve"):
@@ -378,6 +379,22 @@ class StandardWorkflow(Workflow):
                     state, (loss, n_err) = step.train(state, x, y, w)
                 else:
                     loss, n_err = step.evaluate(state, x, y, w)
+                    # fused-mode confusion accumulation (the granular
+                    # graph's evaluator fills it per minibatch; without
+                    # this the confusion plot would silently skip)
+                    cs = getattr(ev, "confusion_split", None)
+                    from veles_tpu.config import root as _r
+                    if (cs is not None and loader.minibatch_class == cs
+                            and getattr(self, "plotters", None)
+                            and getattr(ev, "compute_confusion", True)
+                            and not _r.common.get("plotting_disabled",
+                                                  False)
+                            and hasattr(step, "confusion")):
+                        m = step.confusion(state, x, y, ev.n_classes, w)
+                        if m is not None:
+                            ev.confusion_matrix.map_write()
+                            ev.confusion_matrix.mem += \
+                                m.astype(ev.confusion_matrix.mem.dtype)
                 # step losses are weighted MEANS over the minibatch; scale
                 # by the batch's valid-row weight so the class-pass total
                 # is the EXACT weighted mean (a wrapped final minibatch
