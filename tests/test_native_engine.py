@@ -298,3 +298,33 @@ def test_transformer_moe_package_matches_golden(tmp_path):
     S, V = x.shape[1], gold.shape[1]
     np.testing.assert_allclose(got.reshape(4 * S, V), gold,
                                rtol=3e-4, atol=3e-5)
+
+
+def test_alexnet_stack_package_matches_golden(tmp_path):
+    """The FLAGSHIP chain serves natively end to end: reduced-geometry
+    AlexNet (conv stride-4 + LRN + overlapping maxpool + conv stack +
+    dropout-as-identity FC tail + softmax) exported and reproduced by
+    the C++ engine against the Python golden forward."""
+    from veles_tpu.config import root
+    from veles_tpu.samples.alexnet import create_workflow
+
+    prng.seed_all(1234)
+    root.alexnet.decision.max_epochs = 1
+    root.alexnet.decision.fail_iterations = 99
+    wf = create_workflow(minibatch_size=8, input_hw=67, width_mult=0.125,
+                         fc_width=32, n_train=32, n_validation=16,
+                         n_classes=8, init="scaled")
+    wf.initialize(device=NumpyDevice())
+
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(5).randn(4, 67, 67, 3).astype(np.float32)
+    # eval-mode golden: the dropout units read the loader's minibatch
+    # class, and serving is inference (engine exports dropout=identity)
+    wf.loader.minibatch_class = 1
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    assert gold.shape == (4, 8)
+    np.testing.assert_allclose(got, gold, rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(got.sum(1), 1.0, rtol=1e-5)

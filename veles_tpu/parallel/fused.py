@@ -762,10 +762,9 @@ class FusedTrainStep:
                 "key": repl, "lr_scale": repl}
 
     def _shard_state(self, state):
+        from veles_tpu.parallel.mesh import is_multihost
         shardings = self._state_shardings()
-        if self.mesh is not None and any(
-                d.process_index != jax.process_index()
-                for d in self.mesh.devices.flat):
+        if is_multihost(self.mesh):
             # multi-process global mesh (dp x tp over DCN): device_put
             # rejects shardings with non-addressable devices; jit treats
             # the uniform host state (single-controller convention, see
@@ -818,9 +817,8 @@ class FusedTrainStep:
             # repeats (granular mode's _w_repeat) — not worth a second
             # convention here
             return None
-        if self.mesh is not None and any(
-                d.process_index != jax.process_index()
-                for d in self.mesh.devices.flat):
+        from veles_tpu.parallel.mesh import is_multihost
+        if is_multihost(self.mesh):
             # multi-host: the per-host input sharding zero-fills
             # non-local rows, which a dense plain-jit forward WOULD read
             # (unlike the sharded evaluate) — skip rather than corrupt
@@ -839,7 +837,10 @@ class FusedTrainStep:
                 return m.at[yr, pred].add(wb.reshape(-1))
             fn = self._conf_fns[n_classes] = jax.jit(body)
         w = self._weights_or_ones(w, np.shape(x)[0])
-        return np.asarray(fn(state["params"], x, y, w))
+        # DEVICE array by design: callers accumulate on device across the
+        # class pass and sync once at the boundary (the loop's
+        # one-host-sync-per-pass pipelining contract)
+        return fn(state["params"], x, y, w)
 
     def _last_fwd(self):
         return self.forwards[-1] if self.forwards else None

@@ -59,3 +59,14 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     arr = np.asarray(devices).reshape(
         shape[DATA_AXIS], shape[SEQ_AXIS], shape[MODEL_AXIS])
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def is_multihost(mesh) -> bool:
+    """True when `mesh` (or any Mesh-like with .devices) spans processes
+    other than this one — the single shared predicate for 'collectives /
+    addressability cross the process boundary here'."""
+    if mesh is None:
+        return False
+    import jax
+    pidx = jax.process_index()
+    return any(d.process_index != pidx for d in mesh.devices.flat)

@@ -283,8 +283,8 @@ class PipelineTrainStep:
         DCN) — a jit reshard, since device_put rejects shardings with
         non-addressable devices (same convention as FusedTrainStep.
         _shard_state: the host value is identical on every process)."""
-        if any(d.process_index != jax.process_index()
-               for d in self.mesh.devices.flat):
+        from veles_tpu.parallel.mesh import is_multihost
+        if is_multihost(self.mesh):
             return jax.jit(lambda t: t, out_shardings=sh)(x)
         return jax.device_put(x, sh)
 

@@ -344,7 +344,13 @@ class StandardWorkflow(Workflow):
                 train=lambda s, x, y, w=None: base.train_accum(
                     s, x, y, accum_steps, w),
                 evaluate=base.evaluate, init_state=base.init_state,
-                write_back=base.write_back)
+                write_back=base.write_back,
+                # keep the full step surface: the confusion companion,
+                # local_rows and mesh drive features below this wrapper
+                confusion=getattr(base, "confusion", None),
+                local_rows=getattr(base, "local_rows", None),
+                mesh=getattr(base, "mesh", None))
+        from veles_tpu.config import root as _root
         from veles_tpu.loader.base import TRAIN
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
@@ -359,16 +365,15 @@ class StandardWorkflow(Workflow):
         mesh = getattr(step, "mesh", None)
         if (hasattr(loader, "local_rows_fn")
                 and hasattr(step, "local_rows") and mesh is not None):
-            import jax as _jax
-            if any(d.process_index != _jax.process_index()
-                   for d in mesh.devices.flat):
+            from veles_tpu.parallel.mesh import is_multihost
+            if is_multihost(mesh):
                 loader.local_rows_fn = step.local_rows
         try:
             # Metrics accumulate ON DEVICE across each class pass (lazy
             # scalar adds); the single host sync happens at last_minibatch,
             # so device execution pipelines across minibatches (the
             # evaluator docstring's fused-mode contract).
-            acc_loss = acc_err = None
+            acc_loss = acc_err = acc_conf = None
             acc_w = 0.0
             while not bool(dec.complete):
                 loader.run()
@@ -381,20 +386,21 @@ class StandardWorkflow(Workflow):
                     loss, n_err = step.evaluate(state, x, y, w)
                     # fused-mode confusion accumulation (the granular
                     # graph's evaluator fills it per minibatch; without
-                    # this the confusion plot would silently skip)
+                    # this the confusion plot would silently skip).
+                    # Accumulated as LAZY DEVICE adds like loss/err; the
+                    # host sync stays at the class-pass boundary.
                     cs = getattr(ev, "confusion_split", None)
-                    from veles_tpu.config import root as _r
                     if (cs is not None and loader.minibatch_class == cs
                             and getattr(self, "plotters", None)
                             and getattr(ev, "compute_confusion", True)
-                            and not _r.common.get("plotting_disabled",
-                                                  False)
-                            and hasattr(step, "confusion")):
+                            and not _root.common.get("plotting_disabled",
+                                                     False)
+                            and getattr(step, "confusion", None)
+                            is not None):
                         m = step.confusion(state, x, y, ev.n_classes, w)
                         if m is not None:
-                            ev.confusion_matrix.map_write()
-                            ev.confusion_matrix.mem += \
-                                m.astype(ev.confusion_matrix.mem.dtype)
+                            acc_conf = (m if acc_conf is None
+                                        else acc_conf + m)
                 # step losses are weighted MEANS over the minibatch; scale
                 # by the batch's valid-row weight so the class-pass total
                 # is the EXACT weighted mean (a wrapped final minibatch
@@ -412,13 +418,16 @@ class StandardWorkflow(Workflow):
                     ev.loss = float(acc_loss) / max(acc_w, 1.0)
                     ev.n_err = (int(acc_err) if self.loss == "softmax"
                                 else float(acc_err))
-                    acc_loss = acc_err = None
+                    if acc_conf is not None:
+                        ev.confusion_matrix.map_write()
+                        ev.confusion_matrix.mem += np.asarray(
+                            acc_conf).astype(ev.confusion_matrix.mem.dtype)
+                    acc_loss = acc_err = acc_conf = None
                     acc_w = 0.0
                 else:
                     ev.loss = 0.0
                     ev.n_err = 0
                 dec.run()
-                from veles_tpu.config import root as _root
                 if getattr(self, "plotters", None) \
                         and bool(loader.epoch_ended) \
                         and not _root.common.get("plotting_disabled",
