@@ -53,12 +53,15 @@ class LRNormalizerForward(Forward):
                                     n=self.n))
         return None
 
-    #: opt-in: the Pallas LRN (custom_vjp, ops.pallas_kernels.lrn_pallas)
-    #: measured SLOWER inside the fused AlexNet step on v5e (6.5k vs 9.5k
-    #: samples/s, 2026-07-29) — a pallas_call is a fusion barrier + an
-    #: extra f32 HBM round-trip, while XLA keeps the LRN chain fused in
-    #: bf16 with its neighbors. Kept for workloads where LRN stands alone.
-    #: (FusedTrainStep also clears it under GSPMD auto-partitioning.)
+    #: opt-in: the Pallas LRN (custom_vjp, ops.pallas_kernels.lrn_pallas).
+    #: The ORIGINAL kernel measured slower inside the fused AlexNet step
+    #: on v5e (6.5k vs 9.5k samples/s, 2026-07-29: forced-f32 HBM I/O +
+    #: fusion barrier). Rewritten 2026-07-31 (native-dtype bf16 I/O,
+    #: sqrt/rsqrt pow, 1MB tiles) after the banded-matmul XLA path still
+    #: measured ~24% of the step; the fused-step A/B
+    #: (tools/ablate_lrn.py) decides whether this default flips.
+    #: (FusedTrainStep clears it under GSPMD auto-partitioning either
+    #: way — a pallas_call cannot be auto-partitioned.)
     prefer_pallas = False
 
     def fused_apply(self, params, x, *, key=None, train=True):
