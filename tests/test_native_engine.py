@@ -20,16 +20,19 @@ pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
                                 reason="no C++ toolchain")
 
 
-def build_wf(layers, sample_shape, n_classes=5):
+def build_wf(layers, sample_shape, n_classes=5, minibatch_size=25,
+             n_train=100, n_validation=50, max_epochs=1,
+             name="NativeTest"):
     prng.seed_all(1234)
     loader = SyntheticClassifierLoader(
-        n_classes=n_classes, sample_shape=sample_shape, n_validation=50,
-        n_train=100, minibatch_size=25, noise=0.5)
+        n_classes=n_classes, sample_shape=sample_shape,
+        n_validation=n_validation, n_train=n_train,
+        minibatch_size=minibatch_size, noise=0.5)
     wf = StandardWorkflow(
         layers=layers, loader=loader, loss="softmax", n_classes=n_classes,
-        decision_config={"max_epochs": 1, "fail_iterations": 50},
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
         gd_config={"learning_rate": 0.1},
-        name="NativeTest")
+        name=name)
     wf.initialize(device=NumpyDevice())
     return wf
 
@@ -328,3 +331,37 @@ def test_alexnet_stack_package_matches_golden(tmp_path):
     assert gold.shape == (4, 8)
     np.testing.assert_allclose(got, gold, rtol=3e-4, atol=3e-5)
     np.testing.assert_allclose(got.sum(1), 1.0, rtol=1e-5)
+
+
+def test_tp_trained_model_exports_and_serves(tmp_path, eight_devices):
+    """Cross-feature chain: a model TRAINED tensor-parallel (gspmd mesh,
+    params sharded over 'model') writes back to host Arrays, exports,
+    and the C++ engine reproduces the TRAINED forward — sharded training
+    does not corrupt the serving path."""
+    from veles_tpu.parallel.mesh import make_mesh
+
+    layers = [{"type": "all2all_tanh", "output_sample_shape": 16,
+               "weights_stddev": 0.1},
+              {"type": "softmax", "output_sample_shape": 5,
+               "weights_stddev": 0.05}]
+
+    def build(name):
+        return build_wf(layers, sample_shape=(6, 6), minibatch_size=20,
+                        n_train=80, n_validation=40, max_epochs=2,
+                        name=name)
+
+    wf = build("TPServe")
+    mesh = make_mesh(eight_devices[:4], model=2)
+    wf.run_fused(mesh=mesh, mode="gspmd")
+
+    pkg = export_workflow(wf, str(tmp_path / "pkg"))
+    from veles_tpu.native_engine import NativeEngine
+    x = np.random.RandomState(0).randn(7, 6, 6).astype(np.float32)
+    gold = python_forward(wf, x)
+    with NativeEngine(pkg) as eng:
+        got = eng.infer(x)
+    np.testing.assert_allclose(got, gold, rtol=3e-4, atol=3e-5)
+    # the params really are the trained ones (not init): training moved
+    # them, so a fresh init forward must disagree
+    init_out = python_forward(build("TPServeInit"), x)
+    assert float(np.abs(gold - init_out).max()) > 1e-3
