@@ -121,6 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "line in a new session with stdio redirected to "
                         "LOGFILE, print the background pid on stdout and "
                         "return immediately")
+    p.add_argument("--no-compile-cache", action="store_true",
+                   help="disable the persistent XLA compilation cache "
+                        "(it is auto-disabled on tunneled backends, where "
+                        "it deadlocks the first compile)")
     p.add_argument("--optimize", type=int, default=0, metavar="GENERATIONS",
                    help="genetic hyperparameter search instead of a single "
                         "run: the workflow/config module must define "
@@ -217,7 +221,8 @@ def main(argv=None) -> int:
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, manhole=args.manhole, pp=args.pp,
         serve=args.serve, accum=args.accum, report=args.report,
-        tp=args.tp, sp=args.sp, ep=args.ep)
+        tp=args.tp, sp=args.sp, ep=args.ep,
+        compile_cache=not args.no_compile_cache)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")
@@ -246,7 +251,8 @@ def run_optimize(module, args, device) -> int:
     def fitness(overrides):
         for path, value in overrides.items():
             root.override(path, value)
-        launcher = Launcher(device=device, stats=False)
+        launcher = Launcher(device=device, stats=False,
+                            compile_cache=not args.no_compile_cache)
         launcher.run_module(module)
         dec = getattr(launcher.workflow, "decision", None)
         err = getattr(dec, "best_validation_err", None)
