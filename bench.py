@@ -148,8 +148,16 @@ def child_main() -> None:
         from veles_tpu.parallel.mesh import make_mesh
         mesh = make_mesh(jax.devices(), data=n_chips)
         batch = BATCH * n_chips
+    # width/resolution knobs for CPU smoke runs of the harness itself
+    # (full geometry takes minutes to compile on XLA:CPU); the TPU
+    # protocol always runs width 1.0 at 227²
+    width = float(os.environ.get("BENCH_WIDTH", "1.0"))
+    kw = {}
+    if width != 1.0:
+        kw = dict(width_mult=width, fc_width=int(4096 * width) or 64,
+                  input_hw=int(os.environ.get("BENCH_HW", "67")))
     wf = create_workflow(minibatch_size=batch, n_train=2 * batch,
-                         n_validation=batch)
+                         n_validation=batch, **kw)
     wf.initialize(device=None)
     step = wf.build_fused_step(mesh=mesh, compute_dtype="bfloat16")
     state = step.init_state()
@@ -163,8 +171,8 @@ def child_main() -> None:
     # transfers nothing and leaves the batch resident.
     import jax.numpy as jnp
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
-    x = jax.jit(lambda k: jax.random.normal(
-        k, (batch, 227, 227, 3), jnp.float32))(k1)
+    in_shape = (batch,) + tuple(wf.loader.minibatch_data.shape[1:])
+    x = jax.jit(lambda k: jax.random.normal(k, in_shape, jnp.float32))(k1)
     y = jax.jit(lambda k: jax.random.randint(k, (batch,), 0, 64))(k2)
 
     def sync(st):
@@ -289,6 +297,14 @@ def e2e_child_main() -> None:
                          / (time.perf_counter() - t0))
     device_only = float(np.median(dev_rates))
 
+    # -- loader-only rate: the host half of the decomposition (gather +
+    # page-in, no device work). Enough batches to amortize the already-
+    # filled prefetch window (prefetch=3 near-free pops would otherwise
+    # inflate the rate) --
+    from veles_tpu.loader.memmap import loader_throughput
+    loader_rate = loader_throughput(
+        loader, n_batches=max(32, 2 * STEPS_PER_WINDOW))["samples_per_sec"]
+
     # -- end-to-end: loader -> double-buffered put -> per-step dispatch --
     nxt = fetch()
     for _ in range(4):                                   # warm per-step path
@@ -307,18 +323,83 @@ def e2e_child_main() -> None:
         rates.append(batch * STEPS_PER_WINDOW / (time.perf_counter() - t0))
     value = float(np.median(rates))
     loader.stop()
-    print(json.dumps({
+    rec = {
         "metric": "alexnet_e2e_samples_per_sec_per_chip",
         "value": round(value, 2),
         "unit": UNIT,
-        "vs_baseline": round(value / ROUND1_FLOOR, 3),
+        # vs_baseline compares same-batch protocols (the floor is a
+        # batch-1024 figure); any other batch would read as a spurious
+        # regression — same treatment as the degraded batch-128 path
+        "vs_baseline": (round(value / ROUND1_FLOOR, 3)
+                        if batch == 1024 else None),
+        "loader_samples_per_sec": round(loader_rate, 2),
         "device_only_same_protocol": round(device_only, 2),
         "overlap_efficiency": round(value / device_only, 4),
         "device_kind": jax.devices()[0].device_kind,
         "batch_per_chip": batch,
         "n_samples_packed": n,
         "loader_workers": n_workers,
-    }))
+    }
+    if "axon" in str(jax.config.jax_platforms or ""):
+        rec["caveat"] = (
+            "measured through the remote axon PJRT tunnel, whose "
+            "post-execution H2D transfers are throttled to ~40 MB/s "
+            "(vs 1.7 GB/s idle; shown environmental with controls, "
+            "BASELINE.md) — on a real TPU VM the host pipeline feeds "
+            "locally and this number rises toward device_only")
+    print(json.dumps(rec))
+
+
+#: e2e attach (VERDICT r4 item 5: device_only AND e2e sections in the
+#: machine-readable record): after a successful device-only measurement,
+#: a SHORT e2e child (small batch/windows) runs in the leftover budget
+#: and its record is merged into the final line. BENCH_ATTACH_E2E=0
+#: disables; the reserve is the minimum leftover budget to even try.
+E2E_RESERVE_S = float(os.environ.get("BENCH_E2E_RESERVE_S", "120"))
+E2E_BUDGET_S = float(os.environ.get("BENCH_E2E_BUDGET_S", "240"))
+
+
+def _run_e2e_attach(env, budget_s: float, state=None):
+    """Run the e2e child with tight, short-run settings; return its parsed
+    record, or a structured error record (never raises, never hangs past
+    budget_s). Registers the child in `state` so the supervisor's signal
+    handler can kill it — an orphaned e2e child would hold the flaky
+    tunnel while the watcher's next job contends with it."""
+    e2e_env = dict(env, BENCH_MODE="e2e",
+                   BENCH_BATCH=os.environ.get("BENCH_E2E_ATTACH_BATCH",
+                                              "256"),
+                   BENCH_STEPS="5", BENCH_WINDOWS="2",
+                   BENCH_E2E_SAMPLES=os.environ.get(
+                       "BENCH_E2E_ATTACH_SAMPLES", "1024"))
+    child = None
+    try:
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=e2e_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        if state is not None:
+            state["child"] = child
+        out, err = child.communicate(timeout=budget_s)
+        lines = [ln for ln in (out or "").splitlines() if ln.strip()]
+        if child.returncode == 0 and lines:
+            return json.loads(lines[-1])
+        tail = (err or out or "").strip().splitlines()
+        return {"error": f"e2e child rc={child.returncode}: "
+                         + " | ".join(tail[-2:])}
+    except subprocess.TimeoutExpired:
+        child.kill()
+        return {"error": f"e2e child timed out after {budget_s:.0f}s",
+                "caveat": "the axon tunnel throttles post-execution H2D "
+                          "to ~40 MB/s (BASELINE.md); e2e through the "
+                          "tunnel can exceed any reasonable budget even "
+                          "when device-only succeeds"}
+    except (ValueError, OSError) as e:
+        if child is not None and child.poll() is None:
+            child.kill()
+        return {"error": f"e2e attach failed: {e}"}
+    finally:
+        if state is not None:
+            state["child"] = None
 
 
 #: stderr markers of transient backend trouble worth a retry; anything
@@ -372,14 +453,21 @@ def supervise() -> int:
     state = {"last_err": "unknown", "attempt": 0, "child": None}
 
     def on_signal(signum, frame):
-        # an outer timeout is killing us: leave a parseable record NOW
+        # an outer timeout is killing us: leave a parseable record NOW.
+        # If the device-only headline already landed (we may be mid e2e
+        # attach), the LAST line must stay that success record, not an
+        # error that would erase it.
         ch = state["child"]
         if ch is not None and ch.poll() is None:
             ch.kill()
-        _emit(_error_record(
-            f"supervisor received signal {signum} after "
-            f"{time.monotonic() - t_start:.0f}s; last: {state['last_err']}",
-            state["attempt"]))
+        if state.get("success_rec") is not None:
+            _emit(state["success_rec"])
+        else:
+            _emit(_error_record(
+                f"supervisor received signal {signum} after "
+                f"{time.monotonic() - t_start:.0f}s; "
+                f"last: {state['last_err']}",
+                state["attempt"]))
         os._exit(0)
 
     signal.signal(signal.SIGTERM, on_signal)
@@ -410,13 +498,32 @@ def supervise() -> int:
             lines = [ln for ln in (out or "").splitlines() if ln.strip()]
             if child.returncode == 0 and lines:
                 try:
-                    json.loads(lines[-1])
+                    rec = json.loads(lines[-1])
                 except ValueError:
                     state["last_err"] = \
                         f"unparseable child output: {lines[-1]!r}"
                     retryable = False
                 else:
-                    _emit(json.loads(lines[-1]))
+                    # emit the headline NOW: if the e2e attach below
+                    # hangs and an outer timeout kills us, the driver
+                    # still has this line (the handler re-emits it)
+                    _emit(rec)
+                    state["success_rec"] = rec
+                    if (os.environ.get("BENCH_MODE") != "e2e"
+                            and os.environ.get("BENCH_ATTACH_E2E", "1")
+                            != "0"
+                            and remaining() > E2E_RESERVE_S):
+                        e2e = _run_e2e_attach(
+                            env, min(remaining() - 15.0, E2E_BUDGET_S),
+                            state)
+                        full = dict(rec)
+                        full["device_only"] = {
+                            k: rec[k] for k in
+                            ("value", "unit", "mfu", "batch_per_chip",
+                             "tflops_per_chip") if k in rec}
+                        full["e2e"] = e2e
+                        _emit(full)
+                        state["success_rec"] = full
                     return 0
             else:
                 tail = (err or out or "").strip().splitlines()

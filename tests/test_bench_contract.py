@@ -30,3 +30,34 @@ def test_error_record_is_parseable_and_carries_measurements():
     assert rec["value"] is None and "error" in rec
     assert rec["last_measured"]["best"]["value"] > 0
     assert rec["last_measured"]["device_kind"].startswith("TPU")
+
+
+def test_success_record_merges_device_only_and_e2e_sections():
+    """VERDICT r4 item 5: the driver-captured line must carry BOTH the
+    device-only headline and the e2e (host-pipeline-inclusive) record,
+    with the loader/device decomposition explicit. Narrow-width smoke on
+    XLA:CPU — the protocol (merge shape), not the numbers, is under
+    test."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(BENCH_BATCH="8", BENCH_STEPS="1", BENCH_WINDOWS="1",
+               BENCH_WIDTH="0.125", BENCH_E2E_WIDTH="0.125",
+               BENCH_E2E_ATTACH_BATCH="8", BENCH_E2E_ATTACH_SAMPLES="32",
+               BENCH_CHILD_TIMEOUT_S="300", BENCH_TOTAL_DEADLINE_S="560",
+               BENCH_ATTEMPTS="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=580)
+    assert out.returncode == 0
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "alexnet_train_samples_per_sec_per_chip"
+    assert rec["value"] > 0, rec
+    assert rec["device_only"]["value"] == rec["value"]
+    e2e = rec["e2e"]
+    assert e2e["metric"] == "alexnet_e2e_samples_per_sec_per_chip"
+    assert e2e["value"] > 0, e2e
+    assert e2e["loader_samples_per_sec"] > 0
+    assert e2e["device_only_same_protocol"] > 0
+    assert 0 < e2e["overlap_efficiency"] <= 1.5

@@ -1,0 +1,93 @@
+"""Analytic weak-scaling prediction for data-parallel training on TPU pods.
+
+The BASELINE.json north star (>=90% weak-scaling efficiency on a v5e-64,
+SURVEY.md §6) cannot be *measured* in this environment (one real chip), so
+this module turns it into a falsifiable prediction instead: given the
+measured single-chip step time, the model's gradient byte count, and the
+public per-axis ICI bandwidth, predict the efficiency of the synchronous
+data-parallel step on an (X, Y) chip mesh — and the batch-per-chip where
+it crosses a target.
+
+Model (the "How to Scale Your Model" collective-cost recipe):
+- the fused train step is compute + one gradient all-reduce per step
+  (parallel/fused.py emits a single fused psum over the dp axis — the
+  compiled-HLO collective counts are verified device-count-independent by
+  __graft_entry__.dryrun_multichip);
+- a bidirectional-ring all-reduce of V bytes over a torus axis of size X
+  with per-axis bidirectional ICI bandwidth W costs
+      T_axis = 2 * V * (X - 1) / (X * W);
+- on a 2-axis mesh the reduction decomposes per axis (reduce-scatter along
+  the first axis shrinks the payload X0-fold before the second), so
+      T_comm = 2*V*(X0-1)/(X0*W) + 2*(V/X0)*(X1-1)/(X1*W);
+- XLA overlaps the all-reduce with the tail of the backward pass; the
+  `overlap` knob discounts the exposed fraction (0 = fully exposed, the
+  conservative default used for the headline prediction).
+
+Parity: the reference had no analog — its NCCL/MPI data plane shipped full
+weight payloads per slave per step (SURVEY.md §2.4); the prediction here
+is for the TPU-native in-graph psum design.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+#: public v5e numbers (scaling-book / cloud docs): one-way ICI bandwidth
+#: per link 4.5e10 B/s, 2 links per torus axis -> 9e10 B/s bidirectional
+#: per axis; dense bf16 peak 197 TFLOP/s (bench.py PEAK_TFLOPS).
+V5E_ICI_BW_AXIS_BIDIR = 9.0e10
+
+
+def allreduce_time_s(nbytes: float, mesh_shape: Sequence[int],
+                     ici_bw_axis_bidir: float = V5E_ICI_BW_AXIS_BIDIR
+                     ) -> float:
+    """Bidirectional-ring all-reduce of `nbytes` over every axis of a
+    torus mesh, decomposed reduce-scatter-then-continue per axis."""
+    t, v = 0.0, float(nbytes)
+    for x in mesh_shape:
+        if x <= 1:
+            continue
+        t += 2.0 * v * (x - 1) / (x * ici_bw_axis_bidir)
+        v /= x      # reduce-scatter along this axis shrinks the payload
+    return t
+
+
+def predict_dp_scaling(*, grad_bytes: float, step_time_s: float,
+                       batch_per_chip: int,
+                       mesh_shape: Sequence[int] = (8, 8),
+                       ici_bw_axis_bidir: float = V5E_ICI_BW_AXIS_BIDIR,
+                       overlap: float = 0.0,
+                       target: float = 0.90) -> Dict[str, Any]:
+    """Predicted weak-scaling efficiency of the synchronous dp step.
+
+    `step_time_s` is the measured single-chip step wall time at
+    `batch_per_chip`; compute time is assumed to scale linearly with the
+    per-chip batch (true within the measured 512..2048 sweep, MEASURED.json).
+    Returns the prediction with every input echoed so a future pod run can
+    falsify it term by term.
+    """
+    t_comm = allreduce_time_s(grad_bytes, mesh_shape, ici_bw_axis_bidir)
+    exposed = t_comm * (1.0 - overlap)
+    eff = step_time_s / (step_time_s + exposed)
+    # batch-per-chip where efficiency crosses `target`: compute must cover
+    # target/(1-target) times the exposed comm time
+    per_sample_s = step_time_s / batch_per_chip
+    need_comp = exposed * target / (1.0 - target)
+    batch_at_target = need_comp / per_sample_s if per_sample_s > 0 else 0.0
+    return {
+        "model": "2-axis ring all-reduce, exposed (overlap=%g)" % overlap,
+        "inputs": {
+            "grad_bytes": float(grad_bytes),
+            "step_time_s": float(step_time_s),
+            "batch_per_chip": int(batch_per_chip),
+            "mesh_shape": list(mesh_shape),
+            "ici_bw_axis_bidir_bytes_per_s": float(ici_bw_axis_bidir),
+            "overlap": float(overlap),
+        },
+        "allreduce_time_s": t_comm,
+        "exposed_comm_s": exposed,
+        "predicted_efficiency": eff,
+        "target_efficiency": target,
+        "batch_per_chip_at_target": batch_at_target,
+        "meets_target_at_measured_batch": eff >= target,
+    }
