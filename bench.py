@@ -388,6 +388,10 @@ def _run_e2e_attach(env, budget_s: float, state=None):
                          + " | ".join(tail[-2:])}
     except subprocess.TimeoutExpired:
         child.kill()
+        try:
+            child.communicate(timeout=5)   # reap: no zombie per timeout
+        except Exception:   # noqa: BLE001
+            pass
         return {"error": f"e2e child timed out after {budget_s:.0f}s",
                 "caveat": "the axon tunnel throttles post-execution H2D "
                           "to ~40 MB/s (BASELINE.md); e2e through the "

@@ -1,0 +1,122 @@
+"""IDistributable protocol (VERDICT r4 item 8: the parity interface must
+be load-bearing, not a no-op shell).
+
+Reference `veles/distributable.py` (SURVEY.md §2.3): the per-unit
+generate/apply protocol was the reference's data-parallel mechanism.
+Here each implementor carries the subset it genuinely serves:
+- Loader: minibatch index/row-mask job piece (the multi-host per-process
+  input partitioning) + accounting update piece;
+- Snapshotter: worker-role directive (dry_run) + snapshot-state update;
+- FitnessQueueServer: full protocol — lease out, ingest results,
+  drop_slave re-queues a dead worker's individuals immediately;
+- the base interface raises on anything unimplemented (fail loudly, not
+  silently no-op)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu.distributable import IDistributable
+
+
+def test_base_interface_fails_loudly():
+    base = IDistributable()
+    for call in (lambda: base.generate_data_for_slave(0),
+                 lambda: base.apply_data_from_master({}),
+                 lambda: base.generate_data_for_master(),
+                 lambda: base.apply_data_from_slave({}, 0),
+                 lambda: base.drop_slave(0)):
+        with pytest.raises(NotImplementedError):
+            call()
+
+
+def test_loader_job_piece_carries_real_partition():
+    """generate_data_for_slave must expose the SAME row partition the
+    produce path actually decodes by (the multi-host input sharding)."""
+    from veles_tpu.loader.base import PrefetchingLoader
+
+    class P(PrefetchingLoader):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.produced = []
+
+        def load_data(self):
+            self.class_lengths[:] = [0, 8, 24]
+
+        def create_minibatch_data(self):
+            self.minibatch_data.reset(
+                np.zeros((self.minibatch_size, 4), np.float32))
+            self.minibatch_labels.reset(
+                np.zeros(self.minibatch_size, np.int64))
+
+        def _produce_batch(self, indices):
+            self.produced.append(np.asarray(indices).copy())
+            return (np.ones((len(indices), 4), np.float32),
+                    np.zeros(len(indices), np.int64))
+
+    loader = P(minibatch_size=8, n_workers=1, prefetch=1)
+    loader.initialize(device=None)
+    # every-other-row partition, as run_fused wires for a 2-host mesh
+    loader.local_rows_fn = lambda n: np.arange(n) % 2 == 0
+
+    piece = loader.generate_data_for_slave()
+    assert piece["local_rows"].dtype == bool
+    np.testing.assert_array_equal(piece["local_rows"],
+                                  np.arange(8) % 2 == 0)
+    before = loader.rows_decoded
+    loader.run()
+    # the produce path decoded exactly the job piece's rows
+    assert loader.rows_decoded - before == 4
+    # update piece reports the accounting
+    up = loader.generate_data_for_master()
+    assert up["rows_decoded"] == loader.rows_decoded
+    assert up["epoch_number"] == loader.epoch_number
+    loader.stop()
+
+
+def test_snapshotter_role_and_update_pieces(tmp_path):
+    from veles_tpu.snapshotter import Snapshotter
+
+    snap = Snapshotter(prefix="t", directory=str(tmp_path))
+    assert snap.dry_run is False
+    snap.apply_data_from_master({"dry_run": True})
+    assert snap.dry_run is True
+    up = snap.generate_data_for_master()
+    assert set(up) == {"destination", "best_validation_err"}
+
+
+def test_queue_drop_slave_requeues_immediately():
+    """A worker KNOWN dead (not merely silent) gets its individuals
+    re-issued now — no waiting out the lease."""
+    from veles_tpu.task_queue import FitnessQueueServer
+
+    srv = FitnessQueueServer(host="127.0.0.1", lease_s=3600).start()
+    try:
+        import threading
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(
+                f=srv.submit([{"x": 1.0}], timeout_s=30)),
+            daemon=True)
+        t.start()
+        import time
+        deadline = time.time() + 5
+        lease = None
+        while lease is None and time.time() < deadline:
+            got = srv.generate_data_for_slave("worker-A")
+            lease = got.get("task")
+            time.sleep(0.05)
+        assert lease is not None
+        # hour-long lease: without drop_slave this would deadlock
+        assert srv.generate_data_for_slave("worker-B")["task"] is None
+        assert srv.drop_slave("worker-A") == 1
+        release = srv.generate_data_for_slave("worker-B")["task"]
+        assert release is not None and release["id"] == lease["id"]
+        assert srv.apply_data_from_slave(
+            {"id": release["id"], "fitness": 5.0}) is True
+        t.join(timeout=10)
+        assert result.get("f") == [5.0]
+        # zombie worker-A posting late is refused
+        assert srv.apply_data_from_slave(
+            {"id": lease["id"], "fitness": 1.0}) is False
+    finally:
+        srv.stop()

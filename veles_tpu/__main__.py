@@ -201,10 +201,13 @@ def main(argv=None) -> int:
         _import_file(args.config, "veles_config")
     apply_overrides(args.overrides)
 
-    if args.listen or args.master:
+    if (args.listen or args.master) and not args.optimize:
         # MUST run before make_device: jax.distributed.initialize rejects
         # any call after the XLA backend is touched (found by live drive;
-        # the Launcher's boot_distributed is idempotent and will no-op)
+        # the Launcher's boot_distributed is idempotent and will no-op).
+        # --optimize mode does NOT join an SPMD job: individuals are
+        # independent runs and -l/-m address the fitness lease queue
+        # (run_optimize) instead.
         from veles_tpu.parallel.distributed import initialize_distributed
         initialize_distributed(coordinator=args.listen or args.master,
                                process_id=args.process_id,
@@ -238,7 +241,16 @@ def main(argv=None) -> int:
 
 def run_optimize(module, args, device) -> int:
     """Reference `--optimize` mode: GA over the module's TUNABLES, each
-    individual a full workflow run with the overrides applied to root."""
+    individual a full workflow run with the overrides applied to root.
+
+    Cluster mode (reference `veles/genetics/` distributed individuals
+    across slaves, SURVEY.md §2.5/§3.5): `-l host:port --optimize N` on
+    the coordinator starts a fitness lease queue (task_queue.py) and
+    contributes its own compute via a worker thread; `-m host:port
+    --optimize N` processes lease individuals, evaluate them locally and
+    post results; a worker lost mid-individual misses its lease and the
+    coordinator re-issues the work. Shared-secret auth via
+    VELES_WEB_TOKEN (optional)."""
     from veles_tpu.config import root
     from veles_tpu.genetics import Population
     from veles_tpu.launcher import Launcher
@@ -247,6 +259,11 @@ def run_optimize(module, args, device) -> int:
     if not tunables:
         raise SystemExit(
             f"--optimize: {args.workflow} defines no TUNABLES list")
+    if isinstance(tunables, dict):
+        # shorthand form {"root.path": (lo, hi)} (samples/moe.py style)
+        from veles_tpu.genetics import Tune
+        tunables = [Tune(path, lo, hi)
+                    for path, (lo, hi) in tunables.items()]
 
     def fitness(overrides):
         for path, value in overrides.items():
@@ -258,8 +275,42 @@ def run_optimize(module, args, device) -> int:
         err = getattr(dec, "best_validation_err", None)
         return float("inf") if err is None else float(err)
 
-    pop = Population(tunables, fitness)
-    best = pop.evolve(generations=args.optimize)
+    token = os.environ.get("VELES_WEB_TOKEN") or None
+
+    if args.master:                       # cluster worker role
+        from veles_tpu.task_queue import FitnessQueueWorker
+        host, _, port = args.master.rpartition(":")
+        try:
+            FitnessQueueWorker(host or "127.0.0.1", int(port), fitness,
+                               token=token).run()
+        except PermissionError:
+            raise SystemExit(
+                "coordinator rejected this worker's token (403): set "
+                "the same VELES_WEB_TOKEN on both ends")
+        return 0
+
+    srv = None
+    if args.listen:                       # cluster coordinator role
+        from veles_tpu.task_queue import (FitnessQueueServer,
+                                          FitnessQueueWorker)
+        host, _, port = args.listen.rpartition(":")
+        srv = FitnessQueueServer(host=host or "0.0.0.0", port=int(port),
+                                 token=token).start()
+        # the coordinator contributes compute too (reference master ran
+        # individuals itself when idle) — connect to the BOUND address:
+        # a non-loopback -l host doesn't listen on 127.0.0.1
+        local_host = host if host not in ("", "0.0.0.0") else "127.0.0.1"
+        FitnessQueueWorker(local_host, srv.port, fitness,
+                           token=token).start_thread()
+
+    pop = Population(tunables, fitness, queue_server=srv)
+    try:
+        best = pop.evolve(generations=args.optimize)
+    finally:
+        if srv is not None:
+            # drain: answer done=true for a couple of poll cycles so
+            # -m workers exit promptly instead of waiting out give_up_s
+            srv.stop(drain_s=2.0)
     print(json.dumps({"best_fitness": best.fitness,
                       "best_overrides": best.overrides(tunables)}))
     return 0

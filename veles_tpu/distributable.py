@@ -5,12 +5,29 @@ Parity: reference `veles/distributable.py` (`IDistributable`,
 generate/apply-data-for-slave/master protocol IS the data-parallelism
 mechanism (async master–slave over pickle/ZeroMQ).
 
-TPU-first: synchronous SPMD replaces the wire protocol wholesale — gradient
-averaging is a `lax.psum` inside the sharded train step (see
-`veles_tpu.parallel`), so these methods never ship bytes. The interface is
-kept for API parity and for the host-side pieces that still partition work:
-the Loader uses `generate_data_for_slave`-shaped logic to shard minibatch
-indices across the data-parallel axis.
+TPU-first: synchronous SPMD replaces the wire protocol for GRADIENTS —
+averaging is a `lax.psum` inside the sharded train step
+(`veles_tpu.parallel`) and ships no host bytes. The protocol stays
+load-bearing for the host-side work that still partitions per process:
+
+- `Loader.generate_data_for_slave` / `apply_data_from_master`
+  (loader/base.py): the minibatch index/row-mask job piece — in
+  multi-host runs each process decodes only the global-batch rows its
+  device shards own (`local_rows_fn`), which is exactly the reference's
+  disjoint-index-range handout.
+- `Snapshotter.apply_data_from_master` / `generate_data_for_master`
+  (snapshotter.py): role bookkeeping (workers write no snapshot files;
+  the coordinator aggregates best-metric state) — routed through these
+  hooks by the Launcher's distributed branch.
+- `FitnessQueueServer` (task_queue.py): population parallelism speaks
+  the full protocol — `generate_data_for_slave` IS the lease handed to a
+  polling worker, `apply_data_from_slave` IS the posted result, and
+  `drop_slave` immediately re-queues a lost worker's individuals
+  (the reference master's re-issue semantics).
+
+Methods raise NotImplementedError: each implementor overrides the subset
+of the protocol it genuinely serves, and an unimplemented hook fails
+loudly instead of silently doing nothing.
 """
 
 from __future__ import annotations
@@ -22,21 +39,33 @@ class IDistributable:
     """Duck-typed interface (the reference used zope.interface)."""
 
     def generate_data_for_slave(self, slave: Any) -> Any:
-        """Master -> slave job piece (reference semantics: weights/indices)."""
-        return None
+        """Master -> slave job piece (reference semantics: weights /
+        index ranges; here: row masks, leases)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not hand out slave jobs")
 
     def apply_data_from_master(self, data: Any) -> None:
-        pass
+        """Slave applies a job piece / role directive from the master."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not accept master data")
 
     def generate_data_for_master(self) -> Any:
-        """Slave -> master update piece (reference: weight deltas/metrics)."""
-        return None
+        """Slave -> master update piece (reference: weight deltas /
+        metrics; here: metrics, snapshot state)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not report to a master")
 
     def apply_data_from_slave(self, data: Any, slave: Optional[Any] = None
                               ) -> None:
-        pass
+        """Master ingests a slave's update piece (here: posted fitness
+        results)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not ingest slave updates")
 
     def drop_slave(self, slave: Any) -> None:
         """Slave disconnected; re-queue its outstanding work (reference
-        fault model). SPMD equivalent: restart-from-snapshot, see
-        veles_tpu/snapshotter.py."""
+        fault model). Implemented for real by the population-parallel
+        lease queue; the SPMD train step's equivalent is
+        restart-from-snapshot (veles_tpu/snapshotter.py)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} tracks no per-slave work")

@@ -52,7 +52,36 @@ class Ensemble(Logger):
         self.max_workers = max_workers
         self.members: List[Any] = []
 
-    def train(self, parallel: bool = False) -> "Ensemble":
+    def train(self, parallel: bool = False,
+              queue_server: Any = None) -> "Ensemble":
+        if queue_server is not None:
+            # cluster mode: members train on whichever -m workers lease
+            # them (task_queue lease/re-queue semantics — the reference
+            # distributed ensemble individuals across slaves; the worker
+            # side is `member_worker` below) and come back as
+            # whole-workflow pickles, the Snapshotter's format
+            import pickle
+            if queue_server.max_body < 8 << 20:
+                # results carry whole-workflow pickles; the queue's
+                # default result cap would 413 them and re-train forever
+                queue_server.max_body = 256 << 20
+            self.info("training %d members over the cluster queue",
+                      len(self.seeds))
+            results = queue_server.submit(
+                [{"seed": s} for s in self.seeds], with_artifacts=True)
+            members = []
+            for s, (_fitness, artifact) in zip(self.seeds, results):
+                if not artifact:
+                    raise RuntimeError(
+                        f"member seed={s} returned no trained artifact")
+                wf = pickle.loads(artifact)
+                # snapshot-restore contract: unpickled workflows carry
+                # their trained params but need initialize() to rebuild
+                # device arrays / jit dispatch before serving
+                wf.initialize(device=None)
+                members.append(wf)
+            self.members = members
+            return self
         if parallel:
             import concurrent.futures as cf
             import multiprocessing as mp
@@ -98,3 +127,31 @@ class Ensemble(Logger):
         member_errs = [int((p.argmax(1) != labels).sum()) for p in outs]
         return {"n_err": n_err, "member_errs": member_errs,
                 "n_samples": len(labels)}
+
+
+def member_worker(host: str, port: int,
+                  factory: Callable[[int], Any],
+                  token: Optional[str] = None,
+                  give_up_s: float = 60.0) -> int:
+    """Worker-process entry for cluster ensemble training: lease member
+    seeds from the coordinator's FitnessQueueServer, train
+    `factory(seed)` locally, post the best validation error plus the
+    trained-workflow pickle back as the result artifact. Returns the
+    number of members this worker trained.
+
+    The production counterpart of `Ensemble.train(queue_server=...)` —
+    run one of these per `-m` host (reference: slaves training ensemble
+    individuals, SURVEY.md §2.5)."""
+    import pickle
+
+    from veles_tpu.task_queue import FitnessQueueWorker
+
+    def train_member(payload: Dict[str, Any]):
+        wf = factory(int(payload["seed"]))
+        dec = getattr(wf, "decision", None)
+        err = getattr(dec, "best_validation_err", None)
+        return (float("inf") if err is None else float(err),
+                pickle.dumps(wf))
+
+    return FitnessQueueWorker(host, port, train_member, token=token,
+                              give_up_s=give_up_s).run()

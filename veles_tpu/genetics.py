@@ -64,6 +64,7 @@ class Population(Logger):
                  mutation_rate: float = 0.25,
                  mutation_scale: float = 0.2,
                  max_workers: int = 1,
+                 queue_server: Any = None,
                  rng_name: str = "genetics") -> None:
         super().__init__()
         self.tunables = list(tunables)
@@ -73,6 +74,11 @@ class Population(Logger):
         self.mutation_rate = mutation_rate
         self.mutation_scale = mutation_scale
         self.max_workers = max_workers
+        #: a started task_queue.FitnessQueueServer: individuals are
+        #: leased to cluster workers instead of evaluated locally (the
+        #: reference's master-distributes-individuals-to-slaves mode,
+        #: with lease-expiry re-queue on worker loss)
+        self.queue_server = queue_server
         self.gen = prng.get(rng_name)
         self.members: List[Chromosome] = [
             Chromosome([t.sample(self.gen) for t in self.tunables])
@@ -116,7 +122,12 @@ class Population(Logger):
         todo = [m for m in members if m.fitness is None]
         if not todo:
             return
-        if self.max_workers > 1:
+        if self.queue_server is not None:
+            fitnesses = self.queue_server.submit(
+                [m.overrides(self.tunables) for m in todo])
+            for m, f in zip(todo, fitnesses):
+                m.fitness = float(f)
+        elif self.max_workers > 1:
             with cf.ProcessPoolExecutor(self.max_workers) as pool:
                 futs = {pool.submit(self.fitness_fn,
                                     m.overrides(self.tunables)): m

@@ -314,8 +314,10 @@ class Launcher(Logger):
                     # snapshot branch in _run_with_step is keyed on it,
                     # and under EP/TP its write_back is a cross-process
                     # all-gather that every process must enter (an
-                    # asymmetric collective deadlocks the job)
-                    self.workflow.snapshotter.dry_run = True
+                    # asymmetric collective deadlocks the job). Routed
+                    # through the reference's IDistributable protocol.
+                    self.workflow.snapshotter.apply_data_from_master(
+                        {"dry_run": True})
                 if self.pp:
                     # GPipe stages over the GLOBAL device set, spread
                     # ROUND-ROBIN over processes: a first-N prefix could

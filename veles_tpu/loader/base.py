@@ -187,12 +187,19 @@ class Loader(AcceleratedUnit, IDistributable):
         per = self.minibatch_size // n_shards
         return slice(shard * per, (shard + 1) * per)
 
-    def generate_data_for_slave(self, slave: Any) -> Any:
+    def generate_data_for_slave(self, slave: Any = None) -> Any:
         return {"indices": self.minibatch_indices.mem}
 
     def apply_data_from_master(self, data: Any) -> None:
         if data and "indices" in data:
             self.fill_minibatch(np.asarray(data["indices"]))
+
+    def generate_data_for_master(self) -> Any:
+        """Update piece: this process's epoch/minibatch accounting (the
+        reference slaves reported per-minibatch metrics upstream)."""
+        return {"epoch_number": self.epoch_number,
+                "cursor": int(getattr(self, "_cursor", 0)),
+                "rows_decoded": int(getattr(self, "rows_decoded", 0))}
 
 
 class PrefetchingLoader(Loader):
@@ -278,10 +285,24 @@ class PrefetchingLoader(Loader):
         x, y = self._produce_batch(indices)
         return self._augment(x, indices), y
 
-    def _produce(self, indices: np.ndarray):
+    def local_rows_mask(self, n: int) -> np.ndarray:
+        """The partition kernel behind `generate_data_for_slave`: which
+        of `n` global-batch rows THIS process must materialize (all of
+        them outside multi-host runs)."""
         fn = self.local_rows_fn
-        if fn is not None:
-            mask = np.asarray(fn(len(indices)))
+        return np.ones(n, bool) if fn is None else np.asarray(fn(n))
+
+    def generate_data_for_slave(self, slave: Any = None) -> Any:
+        """Job piece for this data-parallel participant: the minibatch
+        indices plus the row mask its device shards own — the reference
+        master's disjoint-index-range handout, computed SPMD-side."""
+        piece = super().generate_data_for_slave(slave)
+        piece["local_rows"] = self.local_rows_mask(self.minibatch_size)
+        return piece
+
+    def _produce(self, indices: np.ndarray):
+        if self.local_rows_fn is not None:
+            mask = self.local_rows_mask(len(indices))
             if not mask.all():
                 x, y = self._produce_rows(indices[mask])
                 self._count_rows(int(mask.sum()))

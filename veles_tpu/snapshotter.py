@@ -33,6 +33,7 @@ import pickle
 import time
 from typing import Any, Optional
 
+from veles_tpu.distributable import IDistributable
 from veles_tpu.units import Unit
 
 #: compression name -> (module opener, filename suffix)
@@ -52,7 +53,7 @@ def _open_codec(compression: str):
             f"unknown compression {compression!r}; one of {sorted(_CODECS)}")
 
 
-class SnapshotterBase(Unit):
+class SnapshotterBase(Unit, IDistributable):
     """Common machinery: serialize `self.workflow` to a stamped file."""
 
     def __init__(self, workflow=None, prefix: str = "wf",
@@ -141,6 +142,26 @@ class SnapshotterBase(Unit):
 
     def export(self) -> str:
         raise NotImplementedError
+
+    # -- IDistributable (reference veles/distributable.py, SURVEY.md §2.3):
+    # the Launcher's distributed branch speaks to the snapshotter through
+    # these hooks instead of poking attributes -------------------------------
+
+    def apply_data_from_master(self, data: Any) -> None:
+        """Role directive from the coordinator. Workers keep RUNNING the
+        unit (sharded-param gathers in write_back must stay symmetric
+        across processes) but skip the file export — the reference's
+        slaves likewise never wrote master-side state."""
+        if isinstance(data, dict) and "dry_run" in data:
+            self.dry_run = bool(data["dry_run"])
+
+    def generate_data_for_master(self) -> Any:
+        """Update piece the coordinator can aggregate/publish: where the
+        latest snapshot landed and at what metric."""
+        dec = getattr(self, "_decision", None)
+        return {"destination": getattr(self, "destination", ""),
+                "best_validation_err":
+                    getattr(dec, "best_validation_err", None)}
 
     def _upload(self, path: str) -> None:
         from veles_tpu.http_util import http_put_file

@@ -1,0 +1,463 @@
+"""Cluster work queue for population parallelism (genetics / ensemble).
+
+Parity: reference `veles/genetics/` distributed GA individuals across the
+launcher's slaves and the master re-issued work lost to dead slaves
+(SURVEY.md §2.5 genetics row, §3.5 meta-run call stack). The TPU-native
+SPMD data plane is wrong for this — individuals are INDEPENDENT full
+training runs, not shards of one program — so population parallelism gets
+its own tiny control plane: an HTTP lease queue on the coordinator.
+
+Design:
+- `FitnessQueueServer` (coordinator): holds tasks (id -> payload dict),
+  leases one per `GET /task`, accepts `POST /result`, and re-queues any
+  task whose lease expires (worker death = missed lease, exactly the
+  reference master's re-issue semantics). First result wins: a zombie
+  worker posting after its lease was re-issued is ignored.
+- `FitnessQueueWorker` (worker): poll loop — lease, evaluate via the
+  local fitness callable, post the result; exits when the server says
+  done. Workers run anywhere a socket reaches the coordinator: other
+  hosts via `-m`, or a thread in the coordinator process itself (the
+  master contributes compute, like the reference's master-as-worker).
+
+Hardening mirrors web_status.py's heartbeat endpoint: optional shared
+token (`X-Veles-Token`, constant-time compare), size-capped bodies
+(oversized results get 413, not silent truncation), whitelisted result
+fields. Task payloads are config values (data). Result ARTIFACTS
+(ensemble members) are pickles and therefore code on unpickle: the
+server refuses artifact-bearing results unless the connection is
+loopback or a shared token is configured — never accept artifacts from
+an open non-loopback port.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from veles_tpu.distributable import IDistributable
+from veles_tpu.logger import Logger
+
+_QUEUED, _LEASED, _DONE = "queued", "leased", "done"
+
+
+class FitnessQueueServer(Logger, IDistributable):
+    """Lease queue over HTTP. `submit(payloads)` blocks until every task
+    has a result, re-queuing expired leases along the way.
+
+    Speaks the reference's per-unit distributed protocol
+    (`IDistributable`, SURVEY.md §2.3) for real: the HTTP handlers are
+    transport around `generate_data_for_slave` (lease an individual to a
+    worker), `apply_data_from_slave` (ingest a posted result) and
+    `drop_slave` (immediately re-queue everything a lost worker held,
+    without waiting out its lease)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 token: Optional[str] = None,
+                 lease_s: float = 120.0,
+                 max_body: int = 64 * 1024) -> None:
+        super().__init__()
+        self.host = host
+        self.port = port
+        self.token = token
+        self.lease_s = lease_s
+        #: result-body size cap; ensemble raises it so trained-workflow
+        #: pickles (base64 in the result body) fit
+        self.max_body = max_body
+        self._lock = threading.Lock()
+        self._tasks: Dict[str, Dict[str, Any]] = {}
+        self._epoch = 0          # submit() round, namespaces task ids
+        self._shutdown = False
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- queue internals (called under self._lock) ---------------------------
+
+    def _sweep_expired(self) -> None:
+        """Re-queue every lease past its expiry (worker lost its lease:
+        re-issue, reference master semantics). Caller holds the lock."""
+        now = time.time()
+        for t in self._tasks.values():
+            if t["state"] == _LEASED and now > t["lease_expiry"]:
+                t["state"] = _QUEUED
+                t["requeued"] = t.get("requeued", 0) + 1
+
+    def _lease_one(self, worker: str = "") -> Optional[Dict[str, Any]]:
+        now = time.time()
+        self._sweep_expired()
+        for tid, t in self._tasks.items():
+            if t["state"] == _QUEUED:
+                t["state"] = _LEASED
+                t["lease_expiry"] = now + self.lease_s
+                t["worker"] = worker
+                # lease_s rides along so the worker can renew at the
+                # right cadence for long-running individuals
+                return {"id": tid, "payload": t["payload"],
+                        "lease_s": self.lease_s}
+        return None
+
+    # -- IDistributable: the reference's per-unit protocol, for real ---------
+
+    def generate_data_for_slave(self, slave: Any) -> Dict[str, Any]:
+        """Lease one individual to worker `slave` (master -> slave job
+        piece). Returns the wire reply the /task endpoint sends."""
+        with self._lock:
+            if self._shutdown:
+                return {"done": True}
+            return {"done": False, "task": self._lease_one(str(slave))}
+
+    def apply_data_from_slave(self, data: Dict[str, Any],
+                              slave: Optional[Any] = None) -> bool:
+        """Ingest a worker's result (slave -> master update piece).
+        Returns False for late zombie results (first post won)."""
+        with self._lock:
+            return self._post_result(str(data["id"])[:128],
+                                     float(data["fitness"]),
+                                     data.get("artifact"))
+
+    def drop_slave(self, slave: Any) -> int:
+        """A worker is known dead (not merely silent): re-queue every
+        individual it holds NOW instead of waiting out the lease.
+        Returns how many tasks were re-issued."""
+        n = 0
+        with self._lock:
+            for t in self._tasks.values():
+                if t["state"] == _LEASED and t.get("worker") == str(slave):
+                    t["state"] = _QUEUED
+                    t["requeued"] = t.get("requeued", 0) + 1
+                    n += 1
+        if n:
+            self.info("drop_slave(%s): re-queued %d individual(s)",
+                      slave, n)
+        return n
+
+    def _renew(self, tid: str) -> bool:
+        t = self._tasks.get(tid)
+        if t is None or t["state"] != _LEASED:
+            return False
+        t["lease_expiry"] = time.time() + self.lease_s
+        return True
+
+    def _post_result(self, tid: str, fitness: float,
+                     artifact: Optional[bytes] = None) -> bool:
+        t = self._tasks.get(tid)
+        if t is None or t["state"] == _DONE:
+            return False          # late zombie result: first one won
+        t["state"] = _DONE
+        t["fitness"] = fitness
+        t["artifact"] = artifact
+        return True
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    def start(self) -> "FitnessQueueServer":
+        token = self.token
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _auth(self) -> bool:
+                if not token:
+                    return True
+                import hmac
+                got = self.headers.get("X-Veles-Token", "")
+                if hmac.compare_digest(got, token):
+                    return True
+                self.send_response(403)
+                self.end_headers()
+                return False
+
+            def _reply(self, obj: Dict[str, Any], code: int = 200) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if not self.path.startswith("/task"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                if not self._auth():
+                    return
+                from urllib.parse import parse_qs, urlsplit
+                q = parse_qs(urlsplit(self.path).query)
+                worker = (q.get("worker") or [""])[0][:128]
+                self._reply(outer.generate_data_for_slave(worker))
+
+            def do_POST(self) -> None:  # noqa: N802
+                if self.path.startswith("/renew"):
+                    if not self._auth():
+                        return
+                    try:
+                        n = max(0, min(int(
+                            self.headers.get("Content-Length", "0")),
+                            4096))
+                        raw = json.loads(self.rfile.read(n) or b"{}")
+                        tid = str(raw["id"])[:128]
+                    except (ValueError, KeyError, TypeError):
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    with outer._lock:
+                        ok = outer._renew(tid)
+                    self._reply({"renewed": ok})
+                    return
+                if not self.path.startswith("/result"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                if not self._auth():
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                if length > outer.max_body:
+                    # explicit refusal, NOT silent truncation: a
+                    # truncated body parses as garbage, 400s, and the
+                    # task re-queues + re-trains forever
+                    self.send_response(413)
+                    self.end_headers()
+                    return
+                try:
+                    raw = json.loads(self.rfile.read(max(0, length))
+                                     or b"{}")
+                    artifact = None
+                    if raw.get("artifact") is not None:
+                        # an artifact is a pickle (= code on unpickle):
+                        # only accept it from loopback peers or token-
+                        # authenticated workers. Refusing alone would
+                        # livelock (lease expires -> same member
+                        # re-trains -> refused again), so the task is
+                        # FAILED (inf fitness, no artifact): the
+                        # coordinator's Ensemble.train raises with a
+                        # clear message instead of looping forever.
+                        if not token and \
+                                not self.client_address[0].startswith(
+                                    "127."):
+                            outer.apply_data_from_slave(
+                                {"id": raw.get("id", ""),
+                                 "fitness": float("inf"),
+                                 "artifact": None})
+                            self.send_response(403)
+                            self.end_headers()
+                            return
+                        import base64
+                        artifact = base64.b64decode(raw["artifact"])
+                    accepted = outer.apply_data_from_slave(
+                        {"id": raw["id"], "fitness": raw["fitness"],
+                         "artifact": artifact},
+                        slave=raw.get("worker"))
+                except (ValueError, KeyError, TypeError):
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                self._reply({"accepted": accepted})
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="fitness-queue")
+        self._thread.start()
+        return self
+
+    def stop(self, drain_s: float = 0.0) -> None:
+        """Stop serving. With `drain_s`, keep answering `/task` with
+        done=true for that long first so polling workers exit cleanly
+        instead of discovering a refused port (they also give up on
+        their own after `give_up_s`)."""
+        with self._lock:
+            self._shutdown = True
+        if drain_s > 0:
+            time.sleep(drain_s)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- coordinator-side API ------------------------------------------------
+
+    def submit(self, payloads: List[Dict[str, Any]],
+               poll_s: float = 0.2,
+               timeout_s: Optional[float] = None,
+               with_artifacts: bool = False) -> List[Any]:
+        """Enqueue one task per payload; block until every task has a
+        fitness (re-queuing lost leases); return fitnesses in payload
+        order — or (fitness, artifact_bytes) pairs when
+        `with_artifacts`. Raises TimeoutError after `timeout_s` (None =
+        forever)."""
+        with self._lock:
+            self._epoch += 1
+            self._tasks = {
+                f"g{self._epoch}-{i}": {"payload": p, "state": _QUEUED}
+                for i, p in enumerate(payloads)}
+            order = list(self._tasks)
+        t0 = time.monotonic()
+        while True:
+            with self._lock:
+                # sweep expired leases even while no worker is polling,
+                # so `pending` reflects re-queue state for logging
+                self._sweep_expired()
+                pending = [t for t in self._tasks.values()
+                           if t["state"] != _DONE]
+                if not pending:
+                    if with_artifacts:
+                        return [(float(self._tasks[tid]["fitness"]),
+                                 self._tasks[tid].get("artifact"))
+                                for tid in order]
+                    return [float(self._tasks[tid]["fitness"])
+                            for tid in order]
+            if timeout_s is not None \
+                    and time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"{len(pending)} fitness task(s) unfinished after "
+                    f"{timeout_s:.0f}s")
+            time.sleep(poll_s)
+
+    @property
+    def requeue_count(self) -> int:
+        with self._lock:
+            return sum(t.get("requeued", 0) for t in self._tasks.values())
+
+
+class FitnessQueueWorker(Logger):
+    """Worker loop: lease tasks from the coordinator, evaluate with the
+    local `fitness_fn(payload) -> float`, post results. `run()` returns
+    when the server reports done (or `max_tasks` is reached)."""
+
+    def __init__(self, host: str, port: int,
+                 fitness_fn: Callable[[Dict[str, Any]], float],
+                 token: Optional[str] = None, poll_s: float = 0.5,
+                 worker_id: str = "", give_up_s: float = 60.0) -> None:
+        super().__init__()
+        self.host = host
+        self.port = port
+        self.fitness_fn = fitness_fn
+        self.token = token
+        self.poll_s = poll_s
+        import os
+        import socket as _socket
+        #: identity sent with every lease request, so the coordinator
+        #: can drop_slave() this worker's outstanding leases by name
+        self.worker_id = worker_id or \
+            f"{_socket.gethostname()}:{os.getpid()}"
+        #: exit the loop after this long without reaching the server —
+        #: a coordinator that died (or already closed after its run)
+        #: must not leave workers polling a refused port forever
+        self.give_up_s = give_up_s
+        self.tasks_done = 0
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None
+                 ) -> Optional[Dict[str, Any]]:
+        import http.client
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=10)
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Veles-Token"] = self.token
+        try:
+            conn.request(method, path,
+                         json.dumps(body) if body else None, headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 403:
+                # auth failure is NOT "coordinator unreachable": idling
+                # out give_up_s and exiting 0 would report success for a
+                # worker that evaluated nothing
+                raise PermissionError(
+                    "coordinator rejected the shared token (403)")
+            if resp.status != 200:
+                return None
+            return json.loads(data)
+        finally:
+            conn.close()
+
+    def run(self, max_tasks: Optional[int] = None) -> int:
+        """Returns the number of tasks completed by this worker."""
+        from urllib.parse import quote
+        task_path = f"/task?worker={quote(self.worker_id)}"
+        last_contact = time.monotonic()
+        while max_tasks is None or self.tasks_done < max_tasks:
+            try:
+                got = self._request("GET", task_path)
+            except OSError:
+                got = None                 # coordinator not up yet / gone
+            if got is None:
+                if time.monotonic() - last_contact > self.give_up_s:
+                    self.info("no coordinator contact for %.0fs; exiting",
+                              self.give_up_s)
+                    break
+                time.sleep(self.poll_s)
+                continue
+            last_contact = time.monotonic()
+            if got.get("done"):
+                break
+            task = got.get("task")
+            if not task:
+                time.sleep(self.poll_s)
+                continue
+            # renew the lease while the (possibly long) evaluation runs,
+            # so individuals slower than lease_s are not re-issued and
+            # redundantly trained by idle workers
+            stop_renew = threading.Event()
+            lease_s = float(task.get("lease_s") or 120.0)
+
+            def _renew_loop(tid=task["id"]):
+                # cadence must be well under the lease (renewing at the
+                # lease period itself races expiry)
+                while not stop_renew.wait(max(0.2, lease_s / 3.0)):
+                    try:
+                        self._request("POST", "/renew", {"id": tid})
+                    except (OSError, PermissionError):
+                        return              # server gone: stop renewing
+
+            renewer = threading.Thread(target=_renew_loop, daemon=True)
+            renewer.start()
+            body = {"id": task["id"]}
+            try:
+                out = self.fitness_fn(task["payload"])
+                if isinstance(out, tuple):  # (fitness, artifact bytes)
+                    fitness, artifact = out
+                    import base64
+                    body["fitness"] = float(fitness)
+                    body["artifact"] = \
+                        base64.b64encode(artifact).decode()
+                else:
+                    body["fitness"] = float(out)
+            except Exception as e:          # noqa: BLE001 — one bad
+                # individual (NaN hyperparams, crashed run) must not
+                # kill the worker loop and stall the whole GA; report
+                # worst-possible fitness instead (json round-trips
+                # Infinity on both of our ends)
+                self.warning("fitness evaluation failed for %s: %s",
+                             task["id"], e)
+                body["fitness"] = float("inf")
+            finally:
+                stop_renew.set()
+            posted = None
+            try:
+                posted = self._request("POST", "/result", body)
+                if posted is None:
+                    self.warning("result post for %s rejected "
+                                 "(oversized or bad body?); the lease "
+                                 "will re-issue it", task["id"])
+            except OSError:
+                pass                        # lease will re-issue the task
+            if posted is not None and posted.get("accepted"):
+                # only ACCEPTED results count: a rejected/unreachable
+                # post means the task re-issues elsewhere, and
+                # member_worker's return value must not claim it
+                self.tasks_done += 1
+        return self.tasks_done
+
+    def start_thread(self) -> threading.Thread:
+        """Run the worker loop on a daemon thread (the coordinator
+        contributing its own compute, reference master-as-worker)."""
+        t = threading.Thread(target=self.run, daemon=True,
+                             name=f"fitness-worker{self.worker_id}")
+        t.start()
+        return t
