@@ -293,3 +293,13 @@ def test_oversized_result_gets_413_not_truncation():
         assert w._request("POST", "/result", big) is None       # 413
     finally:
         srv.stop()
+
+
+def test_worker_gives_up_and_reports_it():
+    """A worker that never reaches a coordinator must not report
+    success: run() ends with ended_by='gave_up' and zero tasks (the CLI
+    turns that into a nonzero exit)."""
+    w = FitnessQueueWorker("127.0.0.1", 1, lambda p: 0.0,
+                           poll_s=0.1, give_up_s=1.0)
+    assert w.run() == 0
+    assert w.ended_by == "gave_up"

@@ -163,6 +163,14 @@ def test_web_status_serves_workflow_json(tmp_path):
     assert status["epoch"] == 1
     assert any(u["name"] == "repeater" for u in status["units"])
 
+    # error-curve history rides in the status JSON (dashboard curves,
+    # VERDICT r4 item 7): one record per completed epoch
+    assert len(status["history"]) == 1
+    rec = status["history"][0]
+    assert rec["epoch"] == 1
+    assert rec["valid_err"] == wf.decision.best_validation_err
+    assert set(rec) >= {"train_err", "valid_err", "test_err", "best_err"}
+
     srv = WebStatusServer(wf, port=0)
     srv.start()
     try:
@@ -170,9 +178,13 @@ def test_web_status_serves_workflow_json(tmp_path):
                 f"http://127.0.0.1:{srv.port}/status.json", timeout=5) as r:
             remote = json.loads(r.read())
         assert remote["epoch"] == 1
+        assert len(remote["history"]) == 1
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}/", timeout=5) as r:
-            assert b"veles_tpu" in r.read()
+            page = r.read()
+        assert b"veles_tpu" in page
+        # the live dashboard draws the curves from /status.json
+        assert b"drawCurves" in page and b'id="curves"' in page
     finally:
         srv.stop()
 

@@ -280,13 +280,20 @@ def run_optimize(module, args, device) -> int:
     if args.master:                       # cluster worker role
         from veles_tpu.task_queue import FitnessQueueWorker
         host, _, port = args.master.rpartition(":")
+        worker = FitnessQueueWorker(host or "127.0.0.1", int(port),
+                                    fitness, token=token)
         try:
-            FitnessQueueWorker(host or "127.0.0.1", int(port), fitness,
-                               token=token).run()
+            worker.run()
         except PermissionError:
             raise SystemExit(
                 "coordinator rejected this worker's token (403): set "
                 "the same VELES_WEB_TOKEN on both ends")
+        if worker.ended_by == "gave_up" and worker.tasks_done == 0:
+            # never reached the coordinator: exiting 0 would report a
+            # worker that participated when it evaluated nothing
+            raise SystemExit(
+                f"no coordinator contact at {args.master} within "
+                f"{worker.give_up_s:.0f}s and no individuals evaluated")
         return 0
 
     srv = None

@@ -61,10 +61,11 @@ class Ensemble(Logger):
             # side is `member_worker` below) and come back as
             # whole-workflow pickles, the Snapshotter's format
             import pickle
-            if queue_server.max_body < 8 << 20:
-                # results carry whole-workflow pickles; the queue's
-                # default result cap would 413 them and re-train forever
-                queue_server.max_body = 256 << 20
+            # results carry whole-workflow pickles; a result cap below
+            # the artifact size would 413 every post (the server fails
+            # the task, train() raises — but raising the cap up front
+            # avoids burning a training run to find out)
+            queue_server.max_body = max(queue_server.max_body, 256 << 20)
             self.info("training %d members over the cluster queue",
                       len(self.seeds))
             results = queue_server.submit(

@@ -20,3 +20,20 @@ def http_put_file(url: str, path: str, timeout: float = 60.0,
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
             return resp.status
+
+
+def check_shared_token(handler, token) -> bool:
+    """Constant-time shared-token check for an http.server handler: when
+    `token` is set, the request must carry it in `X-Veles-Token` or a 403
+    is sent and False returned. One implementation for every authed
+    endpoint (web-status heartbeats, fitness-queue lease/result/renew) so
+    hardening fixes land in one place."""
+    if not token:
+        return True
+    import hmac
+    got = handler.headers.get("X-Veles-Token", "")
+    if hmac.compare_digest(got, token):
+        return True
+    handler.send_response(403)
+    handler.end_headers()
+    return False

@@ -156,17 +156,11 @@ class FitnessQueueServer(Logger, IDistributable):
         token = self.token
         outer = self
 
+        from veles_tpu.http_util import check_shared_token
+
         class Handler(BaseHTTPRequestHandler):
             def _auth(self) -> bool:
-                if not token:
-                    return True
-                import hmac
-                got = self.headers.get("X-Veles-Token", "")
-                if hmac.compare_digest(got, token):
-                    return True
-                self.send_response(403)
-                self.end_headers()
-                return False
+                return check_shared_token(self, token)
 
             def _reply(self, obj: Dict[str, Any], code: int = 200) -> None:
                 body = json.dumps(obj).encode()
@@ -214,9 +208,19 @@ class FitnessQueueServer(Logger, IDistributable):
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 if length > outer.max_body:
-                    # explicit refusal, NOT silent truncation: a
-                    # truncated body parses as garbage, 400s, and the
-                    # task re-queues + re-trains forever
+                    # explicit refusal, NOT silent truncation (a
+                    # truncated body parses as garbage and 400s) — and
+                    # like the artifact-auth refusal below, the task is
+                    # FAILED so the coordinator surfaces an error
+                    # instead of re-training the same member forever
+                    tid = ""
+                    from urllib.parse import parse_qs, urlsplit
+                    q = parse_qs(urlsplit(self.path).query)
+                    tid = (q.get("id") or [""])[0]
+                    if tid:
+                        outer.apply_data_from_slave(
+                            {"id": tid, "fitness": float("inf"),
+                             "artifact": None})
                     self.send_response(413)
                     self.end_headers()
                     return
@@ -350,6 +354,11 @@ class FitnessQueueWorker(Logger):
         #: must not leave workers polling a refused port forever
         self.give_up_s = give_up_s
         self.tasks_done = 0
+        #: how the last run() ended: "done" (server said so), "gave_up"
+        #: (no contact for give_up_s), or "max_tasks". Callers use this
+        #: to distinguish a worker that participated from one that never
+        #: reached the coordinator at all.
+        self.ended_by = ""
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None
@@ -390,11 +399,13 @@ class FitnessQueueWorker(Logger):
                 if time.monotonic() - last_contact > self.give_up_s:
                     self.info("no coordinator contact for %.0fs; exiting",
                               self.give_up_s)
+                    self.ended_by = "gave_up"
                     break
                 time.sleep(self.poll_s)
                 continue
             last_contact = time.monotonic()
             if got.get("done"):
+                self.ended_by = "done"
                 break
             task = got.get("task")
             if not task:
@@ -440,7 +451,10 @@ class FitnessQueueWorker(Logger):
                 stop_renew.set()
             posted = None
             try:
-                posted = self._request("POST", "/result", body)
+                # id rides in the query string too: a 413 refusal can't
+                # read the body, but must still fail the right task
+                posted = self._request(
+                    "POST", f"/result?id={quote(task['id'])}", body)
                 if posted is None:
                     self.warning("result post for %s rejected "
                                  "(oversized or bad body?); the lease "
@@ -452,6 +466,8 @@ class FitnessQueueWorker(Logger):
                 # post means the task re-issues elsewhere, and
                 # member_worker's return value must not claim it
                 self.tasks_done += 1
+        if not self.ended_by:
+            self.ended_by = "max_tasks"
         return self.tasks_done
 
     def start_thread(self) -> threading.Thread:

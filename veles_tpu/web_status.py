@@ -32,6 +32,12 @@ th{text-align:left;background:#222}h1{font-size:1.2em}
 <h1>veles_tpu — workflow status</h1>
 <div id="meta"></div>
 <div id="cluster"></div>
+<svg id="curves" width="640" height="200" style="display:none;
+background:#181818;border:1px solid #444;margin:1em 0"></svg>
+<div id="legend" style="display:none">
+<span style="color:#e66">train</span>
+<span style="color:#6ae">valid</span>
+<span style="color:#ddd">&nbsp;(errors per epoch)</span></div>
 <table id="procs" style="display:none"><thead><tr><th>process</th>
 <th>host</th><th>devices</th><th>last seen</th></tr></thead>
 <tbody></tbody></table>
@@ -64,6 +70,34 @@ async function tick(){
                    `<td>${u.time.toFixed(3)}</td>`;
     tb.appendChild(tr);
   }
+  drawCurves(s.history || []);
+}
+function drawCurves(h){
+  const svg = document.getElementById('curves');
+  const leg = document.getElementById('legend');
+  if (h.length < 2){ svg.style.display = 'none';
+                     leg.style.display = 'none'; return; }
+  svg.style.display = ''; leg.style.display = '';
+  const W = 640, H = 200, P = 24;
+  const xs = h.map(r => r.epoch);
+  const series = [['train_err', '#e66'], ['valid_err', '#6ae']];
+  let ymax = 1e-9;
+  for (const [k] of series)
+    for (const r of h) if (r[k] != null) ymax = Math.max(ymax, r[k]);
+  const x = e => P + (W - 2*P) * (e - xs[0]) /
+                 Math.max(1, xs[xs.length-1] - xs[0]);
+  const y = v => H - P - (H - 2*P) * v / ymax;
+  let out = `<text x="4" y="14" fill="#888" font-size="11">` +
+            `${ymax.toFixed(0)}</text>` +
+            `<text x="4" y="${H-6}" fill="#888" font-size="11">0</text>`;
+  for (const [k, color] of series){
+    const pts = h.filter(r => r[k] != null)
+                 .map(r => `${x(r.epoch).toFixed(1)},` +
+                           `${y(r[k]).toFixed(1)}`).join(' ');
+    out += `<polyline points="${pts}" fill="none" ` +
+           `stroke="${color}" stroke-width="1.5"/>`;
+  }
+  svg.innerHTML = out;
 }
 setInterval(tick, 1000); tick();
 </script></body></html>"""
@@ -86,6 +120,10 @@ def workflow_status(workflow) -> Dict[str, Any]:
     if decision is not None:
         status["epoch"] = decision.epoch_number
         status["best_err"] = decision.best_validation_err
+        # error curves for the dashboard (bounded: the page only needs
+        # the shape, and an unbounded run must not grow the payload)
+        status["history"] = list(
+            getattr(decision, "history", [])[-1000:])
     try:
         import jax
         if jax.process_count() > 1:
@@ -172,13 +210,9 @@ class WebStatusServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                if token:
-                    import hmac
-                    got = self.headers.get("X-Veles-Token", "")
-                    if not hmac.compare_digest(got, token):
-                        self.send_response(403)
-                        self.end_headers()
-                        return
+                from veles_tpu.http_util import check_shared_token
+                if not check_shared_token(self, token):
+                    return
                 try:
                     n = max(0, min(
                         int(self.headers.get("Content-Length", "0")),

@@ -60,6 +60,10 @@ class DecisionGD(DecisionBase):
         self.epoch_metrics = [None, None, None]  # last completed epoch's
         self.best_validation_err = None
         self.best_epoch = 0
+        #: per-epoch error history (reference web dashboard's error
+        #: curves; also consumed by publishing reports): one record per
+        #: completed TRAIN pass, granular and fused modes alike
+        self.history: list = []
         self._accum = [0.0, 0.0, 0.0]
         self._epochs_since_improvement = 0
 
@@ -86,6 +90,14 @@ class DecisionGD(DecisionBase):
         if cls == TRAIN:
             self.epoch_metrics = list(self.epoch_n_err)
             self.epoch_number += 1
+            self.history.append({
+                "epoch": self.epoch_number,
+                "train_err": float(self.epoch_n_err[TRAIN]),
+                "valid_err": float(self.epoch_n_err[VALIDATION]),
+                "test_err": float(self.epoch_n_err[TEST]),
+                "best_err": (None if self.best_validation_err is None
+                             else float(self.best_validation_err)),
+            })
             self.info(
                 "epoch %d: train_err=%g valid_err=%g test_err=%g best=%s",
                 self.epoch_number, self.epoch_n_err[TRAIN],
