@@ -303,3 +303,17 @@ def test_worker_gives_up_and_reports_it():
                            poll_s=0.1, give_up_s=1.0)
     assert w.run() == 0
     assert w.ended_by == "gave_up"
+
+
+def test_bad_token_worker_raises_not_gave_up():
+    """PermissionError must escape run() (it subclasses OSError, which
+    run() swallows for unreachable-coordinator) so the CLI reports a
+    token mismatch, not 'no coordinator contact'."""
+    srv = FitnessQueueServer(host="127.0.0.1", token="sekrit").start()
+    try:
+        w = FitnessQueueWorker("127.0.0.1", srv.port, lambda p: 0.0,
+                               poll_s=0.1, give_up_s=5.0)
+        with pytest.raises(PermissionError):
+            w.run()
+    finally:
+        srv.stop()

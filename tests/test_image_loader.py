@@ -75,3 +75,33 @@ def test_trains_on_image_tree(image_tree):
     # color classes are linearly separable: must reach ~0 errors
     assert wf.decision.best_validation_err <= 1, \
         wf.decision.best_validation_err
+
+
+def test_fused_conv_trains_on_image_tree(image_tree):
+    """The production seam (VERDICT r4 item 6): real PNG decode ->
+    threaded prefetch -> fused conv train step, loss falls. The on-chip
+    twin is tools/image_tree_smoke.py (narrow AlexNet on the real
+    device)."""
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.seed_all(1234)
+    loader = ImageDirectoryLoader(
+        data_path=image_tree, size_hw=(12, 12), n_validation=6,
+        minibatch_size=6, shuffle_train=True, prefetch=2)
+    wf = StandardWorkflow(
+        layers=[{"type": "conv_strictrelu", "n_kernels": 8, "kx": 5,
+                 "ky": 5, "sliding": (2, 2), "padding": (2, 2),
+                 "weights_stddev": 0.1},
+                {"type": "max_pooling", "kx": 2, "ky": 2,
+                 "sliding": (2, 2)},
+                {"type": "softmax", "output_sample_shape": 3,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=3,
+        decision_config={"max_epochs": 6, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.05, "gradient_moment": 0.9},
+        name="ImgFused")
+    wf.initialize(device=None)
+    wf.run_fused()
+    assert wf.decision.best_validation_err <= 2, \
+        (wf.decision.best_validation_err, wf.decision.history)
+    # per-epoch history recorded in fused mode too
+    assert len(wf.decision.history) >= 1

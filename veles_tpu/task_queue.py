@@ -389,10 +389,14 @@ class FitnessQueueWorker(Logger):
         """Returns the number of tasks completed by this worker."""
         from urllib.parse import quote
         task_path = f"/task?worker={quote(self.worker_id)}"
+        self.ended_by = ""                 # fresh verdict for THIS run
         last_contact = time.monotonic()
         while max_tasks is None or self.tasks_done < max_tasks:
             try:
                 got = self._request("GET", task_path)
+            except PermissionError:
+                raise    # auth failure, NOT unreachable: PermissionError
+                # subclasses OSError and would otherwise idle out below
             except OSError:
                 got = None                 # coordinator not up yet / gone
             if got is None:
@@ -456,9 +460,12 @@ class FitnessQueueWorker(Logger):
                 posted = self._request(
                     "POST", f"/result?id={quote(task['id'])}", body)
                 if posted is None:
-                    self.warning("result post for %s rejected "
-                                 "(oversized or bad body?); the lease "
-                                 "will re-issue it", task["id"])
+                    self.warning(
+                        "result post for %s rejected: oversized results "
+                        "are FAILED by the server (no retry); other "
+                        "rejections re-issue via the lease", task["id"])
+            except PermissionError:
+                raise
             except OSError:
                 pass                        # lease will re-issue the task
             if posted is not None and posted.get("accepted"):

@@ -90,6 +90,10 @@ class DecisionGD(DecisionBase):
         if cls == TRAIN:
             self.epoch_metrics = list(self.epoch_n_err)
             self.epoch_number += 1
+            if not hasattr(self, "history"):
+                # snapshot from before history existed: resume must not
+                # crash, it just starts recording from here
+                self.history = []
             self.history.append({
                 "epoch": self.epoch_number,
                 "train_err": float(self.epoch_n_err[TRAIN]),
