@@ -1,0 +1,112 @@
+"""Granular (unit-by-unit dispatch) vs fused (one XLA dispatch per
+minibatch) AlexNet training cost — VERDICT r4 item 9: the
+reference-parity execution model's measured price.
+
+Both modes run the SAME minibatch count on the same resident batch with
+per-step host dispatch (no train_repeat scan, so the two loops differ
+only in dispatch granularity). Through the remote tunnel the granular
+number includes real per-unit dispatch latency — that is part of the
+mode's honest cost here, and the caveat field says so.
+
+Usage: python tools/granular_vs_fused.py [batch] [steps]
+Prints one JSON line with both rates and the ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(batch: int = 512, steps: int = 8) -> None:
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.loader.base import TRAIN
+    from veles_tpu.samples.alexnet import create_workflow
+
+    def fresh():
+        prng.seed_all(1)
+        wf = create_workflow(minibatch_size=batch, n_train=2 * batch,
+                             n_validation=batch)
+        wf.initialize(device=None)
+        return wf
+
+    # -- granular: the unit graph, one dispatch per unit. The batch is
+    # STAGED ONCE before timing (same as the fused loop's resident
+    # batch) so the two loops differ only in dispatch granularity, not
+    # loader/H2D cost -------------------------------------------------------
+    wf = fresh()
+    ld = wf.loader
+    while int(ld.minibatch_class) != TRAIN:
+        ld.run()                                   # stage one TRAIN batch
+
+    def granular_minibatch():
+        for u in wf.forwards:
+            u.run()
+        wf.evaluator.run()
+        for g in wf.gds:
+            g.run()
+        return True
+
+    def sync_granular():
+        # a scalar device_get is the reliable barrier through the remote
+        # tunnel (bench.py's sync note); fall back to host mem when the
+        # unit never went to device
+        g = wf.gds[0] if wf.gds else wf.forwards[-1]
+        arr = getattr(g, "weights", None) or wf.forwards[-1].output
+        if g.device is not None and \
+                getattr(g.device, "backend_name", "") == "xla":
+            np.asarray(jax.device_get(arr.devmem(g.device))[:1])
+        else:
+            np.asarray(arr.mem[:1])
+
+    done = 0
+    while done < 2:                                # warmup/compile
+        done += granular_minibatch()
+    sync_granular()
+    t0 = time.perf_counter()
+    done = 0
+    while done < steps:
+        done += granular_minibatch()
+    sync_granular()
+    granular_rate = batch * steps / (time.perf_counter() - t0)
+
+    # -- fused: one donated XLA computation per minibatch --------------------
+    wf2 = fresh()
+    step = wf2.build_fused_step(compute_dtype="bfloat16")
+    state = step.init_state()
+    import jax.numpy as jnp
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    shape = (batch,) + tuple(wf2.loader.minibatch_data.shape[1:])
+    x = jax.jit(lambda k: jax.random.normal(k, shape, jnp.float32))(k1)
+    y = jax.jit(lambda k: jax.random.randint(k, (batch,), 0, 64))(k2)
+    state, _ = step.train(state, x, y)             # compile + warm
+    np.asarray(state["params"][-1]["bias"][:1])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _ = step.train(state, x, y)
+    np.asarray(state["params"][-1]["bias"][:1])
+    fused_rate = batch * steps / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "alexnet_granular_vs_fused",
+        "batch": batch, "steps": steps,
+        "granular_samples_per_sec": round(granular_rate, 2),
+        "fused_samples_per_sec": round(fused_rate, 2),
+        "fused_over_granular": round(fused_rate / granular_rate, 3),
+        "device_kind": jax.devices()[0].device_kind,
+        "caveat": "granular includes per-unit host dispatch; through the "
+                  "remote tunnel that latency is inflated vs a local "
+                  "TPU VM (tools/README: r4 layer_profile finding)",
+    }))
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:3]))

@@ -1,21 +1,23 @@
 #!/bin/bash
-# Round-5 TPU tunnel watcher (VERDICT r4 item 1 — the headline item).
-# Probe the flaky axon tunnel in a loop; the moment it answers:
-#   1. bench.py with current defaults (capture a driver-parseable number
-#      FIRST, in case the tunnel dies again),
-#   2. the two queued A/Bs from tools/README.md:
-#        ablate_lrn.py 1024            (one-pass Pallas LRN vs banded matmul)
-#        ablate.py full avgpool slicepool  (maxpool lowering bound)
-# then exit 0 so the session applies the pre-committed decision rules
-# (flip LRNormalizerForward.prefer_pallas if Pallas wins; adopt
-# maxpool_forward_slices if it wins; re-sweep batches) in the warm window.
-# All output also lands in the TRACKED ONCHIP_LATE.md so a post-session
-# capture still reaches the next round.
+# Round-5 TPU tunnel watcher — the FULL on-chip queue (VERDICT r4 items
+# 1, 2, 6, 9, 10). Probe the flaky axon tunnel in a loop; the moment it
+# answers, run in priority order (most driver-critical first, each
+# timeout-bounded so one hang cannot eat the warm window):
+#   1. bench.py (current defaults)           -> driver-parseable number
+#   2. ablate_lrn.py 1024                    -> one-pass Pallas LRN A/B
+#   3. ablate.py full avgpool slicepool      -> maxpool lowering A/B
+#   4. batch re-sweep 512/1024/2048          -> BENCH_BATCH default call
+#   5. CLI smoke (mnist_simple --fused)      -> Launcher path on chip
+#   6. image_tree_smoke.py                   -> real-decode train seam
+#   7. granular_vs_fused.py 512              -> execution-mode price
+# Everything lands in tpu_watch/ + the TRACKED ONCHIP_LATE.md, then the
+# watcher exits 0 so the session applies the pre-committed decision
+# rules (tools/README.md) while the tunnel is warm.
 cd /root/repo || exit 1
 mkdir -p tpu_watch
 END=$((SECONDS + ${TPU_WATCH_BUDGET_S:-39600}))
 log() { echo "$(date -u +%H:%M:%S) $*" >> tpu_watch/r5.log; }
-log "r5 watcher start"
+log "r5 watcher (full queue) start"
 while [ $SECONDS -lt $END ]; do
   if timeout 150 python -c "
 import jax, jax.numpy as jnp
@@ -25,27 +27,53 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
     log "tunnel UP: $(tail -1 tpu_watch/r5_probe.txt)"
     timeout 600 python bench.py \
       > tpu_watch/r5_bench_out.txt 2> tpu_watch/r5_bench_err.txt
-    log "bench rc=$? last: $(tail -1 tpu_watch/r5_bench_out.txt | head -c 300)"
+    log "1 bench rc=$? last: $(tail -1 tpu_watch/r5_bench_out.txt | head -c 200)"
     timeout 900 python tools/ablate_lrn.py 1024 \
       > tpu_watch/r5_lrn_ab.txt 2>&1
-    log "ablate_lrn rc=$?"
+    log "2 ablate_lrn rc=$?"
     timeout 900 python tools/ablate.py full avgpool slicepool \
       > tpu_watch/r5_pool_ab.txt 2>&1
-    log "ablate pool rc=$?"
+    log "3 ablate pool rc=$?"
+    for B in 512 2048; do
+      BENCH_BATCH=$B BENCH_ATTACH_E2E=0 timeout 420 python bench.py \
+        > tpu_watch/r5_bench_b$B.txt 2> tpu_watch/r5_bench_b$B.err
+      log "4 bench batch=$B rc=$? last: $(tail -1 tpu_watch/r5_bench_b$B.txt | head -c 160)"
+    done
+    timeout 420 python -m veles_tpu veles_tpu/samples/mnist_simple.py \
+      --fused --no-stats root.mnist_simple.decision.max_epochs=2 \
+      > tpu_watch/r5_cli_smoke.txt 2>&1
+    log "5 CLI smoke rc=$? (0 = Launcher path proven on chip)"
+    timeout 600 python tools/image_tree_smoke.py 3 \
+      > tpu_watch/r5_image_smoke.txt 2>&1
+    log "6 image smoke rc=$? last: $(tail -1 tpu_watch/r5_image_smoke.txt | head -c 200)"
+    timeout 600 python tools/granular_vs_fused.py 512 8 \
+      > tpu_watch/r5_gran_fused.txt 2>&1
+    log "7 granular_vs_fused rc=$?"
     {
       echo "# ONCHIP_LATE — r5 watcher capture ($(date -u +%FT%TZ))"
       echo
-      echo "## bench.py (pre-decision defaults)"
+      echo "## 1. bench.py (pre-decision defaults)"
       echo '```'; tail -3 tpu_watch/r5_bench_out.txt; echo '```'
-      echo "## ablate_lrn.py 1024 (banded-matmul vs one-pass Pallas LRN)"
+      echo "## 2. ablate_lrn.py 1024 (banded-matmul vs one-pass Pallas LRN)"
       echo '```'; cat tpu_watch/r5_lrn_ab.txt; echo '```'
-      echo "## ablate.py full avgpool slicepool"
+      echo "## 3. ablate.py full avgpool slicepool"
       echo '```'; cat tpu_watch/r5_pool_ab.txt; echo '```'
+      echo "## 4. batch sweep"
+      for B in 512 2048; do
+        echo "batch $B:"; echo '```'; tail -1 tpu_watch/r5_bench_b$B.txt; echo '```'
+      done
+      echo "## 5. CLI smoke (exit 0 = Launcher proven on chip)"
+      echo '```'; tail -4 tpu_watch/r5_cli_smoke.txt; echo '```'
+      echo "## 6. image tree smoke"
+      echo '```'; tail -1 tpu_watch/r5_image_smoke.txt; echo '```'
+      echo "## 7. granular vs fused"
+      echo '```'; tail -1 tpu_watch/r5_gran_fused.txt; echo '```'
       echo
       echo "Decision rules (tools/README.md): flip"
       echo "LRNormalizerForward.prefer_pallas if Pallas wins; adopt"
-      echo "maxpool_forward_slices if slicepool beats full; re-sweep"
-      echo "BENCH_BATCH and flip default to 2048 if it still wins."
+      echo "maxpool_forward_slices if slicepool beats full; flip"
+      echo "BENCH_BATCH default to 2048 if the sweep confirms it;"
+      echo "record CLI/image/granular results in BASELINE.md+ROOFLINE.md."
     } > ONCHIP_LATE.md
     log "ONCHIP_LATE.md written; exiting for in-session decisions"
     exit 0
