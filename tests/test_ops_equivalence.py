@@ -6,6 +6,8 @@ dtype tolerance. Backwards are checked as jax.vjp(xla forward) vs the
 hand-derived numpy backward.
 """
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -183,6 +185,15 @@ def test_lrn_forward_backward():
     _, vjp = jax.vjp(f, x)
     (ex,) = vjp(jnp.asarray(err_y))
     assert_close(ex, ex_ref)
+
+    # the cached-residual VJP variant (cache_bwd=True) is the SAME math
+    # with a different residual policy: forward and gradient must match
+    # the recompute variant (and thus the numpy golden) exactly
+    fc = partial(ox.lrn_forward, cache_bwd=True)
+    assert_close(jax.jit(fc)(x), y_ref)
+    _, vjp_c = jax.vjp(fc, x)
+    (ex_c,) = vjp_c(jnp.asarray(err_y))
+    assert_close(ex_c, ex_ref)
 
 
 def test_dropout_equivalence():

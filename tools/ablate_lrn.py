@@ -1,6 +1,9 @@
-"""On-chip A/B: fused AlexNet step with the XLA banded-matmul LRN vs the
-Pallas one-pass LRN (ops.pallas_kernels.lrn_pallas after the r4 rewrite:
-native-dtype HBM I/O, sqrt/rsqrt pow, static scalars).
+"""On-chip A/B/C: fused AlexNet step with
+  A. the XLA banded-matmul LRN, backward recomputing s/d from x;
+  B. the same lowering with the forward's d and s CACHED as residuals
+     (bwd: one window dot, zero pow — ROOFLINE.md r4 attack);
+  C. the Pallas one-pass LRN (ops.pallas_kernels.lrn_pallas after the
+     r4 rewrite: native-dtype HBM I/O, sqrt/rsqrt pow, static scalars).
 
 Usage: python tools/ablate_lrn.py [batch]
 """
@@ -18,7 +21,8 @@ BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 K = 8
 
 
-def measure(name: str, prefer_pallas: bool) -> float:
+def measure(name: str, prefer_pallas: bool,
+            cache_bwd: bool = False) -> float:
     import jax
     import jax.numpy as jnp
 
@@ -29,6 +33,7 @@ def measure(name: str, prefer_pallas: bool) -> float:
     from veles_tpu.znicz.standard_workflow import StandardWorkflow
 
     LRNormalizerForward.prefer_pallas = prefer_pallas
+    LRNormalizerForward.cache_bwd = cache_bwd
     prng.seed_all(1)
     loader = SyntheticClassifierLoader(
         n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
@@ -65,5 +70,7 @@ if __name__ == "__main__":
         "no TPU visible: prefer_pallas would silently fall back to the "
         "XLA path and the A/B would compare XLA against itself")
     a = measure("xla-lrn", False)
+    c = measure("xla-lrn-cached-bwd", False, cache_bwd=True)
     b = measure("pallas-lrn", True)
-    print(f"pallas/xla = {b / a:.3f}", flush=True)
+    print(f"cached/xla = {c / a:.3f}  pallas/xla = {b / a:.3f}",
+          flush=True)

@@ -44,8 +44,11 @@ def main(batch: int = 512, steps: int = 8) -> None:
     # loader/H2D cost -------------------------------------------------------
     wf = fresh()
     ld = wf.loader
+    # stage one TRAIN batch: minibatch_class is CONSTRUCTED as TRAIN, so
+    # run() at least once and then until the schedule lands on TRAIN
+    ld.run()
     while int(ld.minibatch_class) != TRAIN:
-        ld.run()                                   # stage one TRAIN batch
+        ld.run()
 
     def granular_minibatch():
         for u in wf.forwards:
@@ -56,16 +59,16 @@ def main(batch: int = 512, steps: int = 8) -> None:
         return True
 
     def sync_granular():
-        # a scalar device_get is the reliable barrier through the remote
-        # tunnel (bench.py's sync note); fall back to host mem when the
-        # unit never went to device
-        g = wf.gds[0] if wf.gds else wf.forwards[-1]
-        arr = getattr(g, "weights", None) or wf.forwards[-1].output
-        if g.device is not None and \
-                getattr(g.device, "backend_name", "") == "xla":
-            np.asarray(jax.device_get(arr.devmem(g.device))[:1])
-        else:
-            np.asarray(arr.mem[:1])
+        # barrier on the LAST unit the loop dispatched (gds run in
+        # backprop order, so gds[-1] is final); a scalar device_get of
+        # its device buffer is the reliable barrier through the remote
+        # tunnel (bench.py's sync note). Units run the xla backend even
+        # with device=None (backend_name defaults to "xla"), so host
+        # .mem would be a STALE buffer, not a barrier.
+        g = wf.gds[-1] if wf.gds else wf.forwards[-1]
+        arr = getattr(g, "weights", None) \
+            or getattr(g, "err_input", None) or wf.forwards[-1].output
+        np.asarray(jax.device_get(arr.devmem(g.device).ravel()[0:1]))
 
     done = 0
     while done < 2:                                # warmup/compile

@@ -471,7 +471,7 @@ def _pow_neg_quarters(s, beta: float):
 
 
 def lrn_forward(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
-                n: int = 5):
+                n: int = 5, cache_bwd: bool = False):
     """AlexNet-style across-channel LRN: y = x·(k + α·W(x²))^(−β) with W
     the ±half shifted-add window (odd n only — even n would silently
     widen to n+1 taps; the Pallas and C++ twins share the ±half
@@ -479,10 +479,19 @@ def lrn_forward(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
 
     custom-VJP: backward is the closed form
         err_x = g·d − 2αβ · x · W(g·x·d/s),  d = s^(−β)
-    (W self-adjoint), recomputed from x — no pow in either pass (see
-    _pow_neg_quarters) and no extra residual memory beyond x itself."""
+    (W self-adjoint). Two residual policies, same math:
+    - cache_bwd=False (default): recompute s and d from x in the
+      backward — no residual memory beyond x, but the bwd pays a second
+      window dot (W(x²)) plus the pow chain;
+    - cache_bwd=True: stash d and s from the forward — bwd drops to ONE
+      window dot and zero pow at the cost of two activation-sized
+      residuals (the ROOFLINE.md "cache the forward window-dot" attack;
+      whether the HBM saved beats the residual traffic is an on-chip
+      A/B, tools/ablate_lrn.py)."""
     if n % 2 == 0:
         raise ValueError(f"LRN window n must be odd, got {n}")
+    if cache_bwd:
+        return _lrn_cvjp_cached(x, k, alpha, beta, n)
     return _lrn_cvjp(x, k, alpha, beta, n)
 
 
@@ -504,6 +513,27 @@ def _lrn_bwd_rule(k, alpha, beta, n, x, g):
 
 
 _lrn_cvjp.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _lrn_cvjp_cached(x, k, alpha, beta, n):
+    s = k + alpha * _lrn_window_sum(x * x, n)
+    return x * _pow_neg_quarters(s, beta)
+
+
+def _lrn_fwd_rule_cached(x, k, alpha, beta, n):
+    s = k + alpha * _lrn_window_sum(x * x, n)
+    d = _pow_neg_quarters(s, beta)
+    return x * d, (x, d, s)
+
+
+def _lrn_bwd_rule_cached(k, alpha, beta, n, res, g):
+    x, d, s = res
+    core = _lrn_window_sum(g * x * d / s, n)
+    return (g * d - (2.0 * alpha * beta) * x * core,)
+
+
+_lrn_cvjp_cached.defvjp(_lrn_fwd_rule_cached, _lrn_bwd_rule_cached)
 
 
 # ---------------------------------------------------------------------------

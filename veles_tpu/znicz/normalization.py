@@ -64,11 +64,19 @@ class LRNormalizerForward(Forward):
     #: way — a pallas_call cannot be auto-partitioned.)
     prefer_pallas = False
 
+    #: opt-in: stash the forward's d=s^(−β) and s as residuals so the
+    #: custom-VJP backward drops one window dot and the whole pow chain
+    #: (ROOFLINE.md r4 "cache the forward window-dot" attack) at the
+    #: cost of two activation-sized residuals. On-chip A/B
+    #: (tools/ablate_lrn.py) decides the default.
+    cache_bwd = False
+
     def fused_apply(self, params, x, *, key=None, train=True):
         from veles_tpu.ops import pallas_kernels as pk
         if self.prefer_pallas and pk.available():
             return pk.lrn_pallas(x, self.k, self.alpha, self.beta, self.n)
-        return ox.lrn_forward(x, self.k, self.alpha, self.beta, self.n)
+        return ox.lrn_forward(x, self.k, self.alpha, self.beta, self.n,
+                              cache_bwd=self.cache_bwd)
 
     def numpy_run(self) -> None:
         self.output.mem = ref.lrn_forward(self.input.mem, self.k, self.alpha,
