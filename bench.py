@@ -201,6 +201,27 @@ def child_main() -> None:
     tflops = per_chip * train_flops / 1e12
     kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(kind)
+    # the falsifiable v5e-64 weak-scaling prediction from THIS run's
+    # measured step time (ROOFLINE.md r5; inputs echoed in the record).
+    # Guarded: an exception here must never cost the measured value the
+    # supervisor's whole design exists to protect.
+    try:
+        from veles_tpu.parallel.scaling_model import predict_dp_scaling
+        n_params = sum(int(v.size) for layer in state["params"]
+                       for v in layer.values())
+        pred = predict_dp_scaling(grad_bytes=4 * n_params,
+                                  step_time_s=BATCH / per_chip,
+                                  batch_per_chip=BATCH, mesh_shape=(8, 8))
+        scaling_rec = {
+            "predicted_efficiency": round(
+                pred["predicted_efficiency"], 4),
+            "batch_per_chip_at_90pct": round(
+                pred["batch_per_chip_at_target"], 1),
+            "allreduce_ms": round(1e3 * pred["allreduce_time_s"], 3),
+            "inputs": pred["inputs"],
+        }
+    except Exception as e:  # noqa: BLE001
+        scaling_rec = {"error": str(e)[:200]}
     print(json.dumps({
         "metric": METRIC,
         "value": round(per_chip, 2),
@@ -213,6 +234,7 @@ def child_main() -> None:
         "batch_per_chip": BATCH,
         "train_gflops_per_sample": round(train_flops / 1e9, 3),
         "fwd_layer_gflops_per_sample": layer_gflops,
+        "scaling_prediction_v5e64": scaling_rec,
     }))
 
 
