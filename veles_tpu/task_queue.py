@@ -31,11 +31,13 @@ an open non-loopback port.
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import parse_qs, quote, urlsplit
 
 from veles_tpu.distributable import IDistributable
 from veles_tpu.logger import Logger
@@ -170,6 +172,15 @@ class FitnessQueueServer(Logger, IDistributable):
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _fail_task(self, tid: str) -> None:
+                """Permanently fail a task (inf fitness, no artifact) so
+                the coordinator surfaces an error instead of re-leasing
+                the same doomed work forever."""
+                if tid:
+                    outer.apply_data_from_slave(
+                        {"id": tid, "fitness": float("inf"),
+                         "artifact": None})
+
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 if not self.path.startswith("/task"):
                     self.send_response(404)
@@ -177,7 +188,6 @@ class FitnessQueueServer(Logger, IDistributable):
                     return
                 if not self._auth():
                     return
-                from urllib.parse import parse_qs, urlsplit
                 q = parse_qs(urlsplit(self.path).query)
                 worker = (q.get("worker") or [""])[0][:128]
                 self._reply(outer.generate_data_for_slave(worker))
@@ -213,14 +223,8 @@ class FitnessQueueServer(Logger, IDistributable):
                     # like the artifact-auth refusal below, the task is
                     # FAILED so the coordinator surfaces an error
                     # instead of re-training the same member forever
-                    tid = ""
-                    from urllib.parse import parse_qs, urlsplit
                     q = parse_qs(urlsplit(self.path).query)
-                    tid = (q.get("id") or [""])[0]
-                    if tid:
-                        outer.apply_data_from_slave(
-                            {"id": tid, "fitness": float("inf"),
-                             "artifact": None})
+                    self._fail_task((q.get("id") or [""])[0])
                     self.send_response(413)
                     self.end_headers()
                     return
@@ -240,14 +244,10 @@ class FitnessQueueServer(Logger, IDistributable):
                         if not token and \
                                 not self.client_address[0].startswith(
                                     "127."):
-                            outer.apply_data_from_slave(
-                                {"id": raw.get("id", ""),
-                                 "fitness": float("inf"),
-                                 "artifact": None})
+                            self._fail_task(str(raw.get("id", "")))
                             self.send_response(403)
                             self.end_headers()
                             return
-                        import base64
                         artifact = base64.b64decode(raw["artifact"])
                     accepted = outer.apply_data_from_slave(
                         {"id": raw["id"], "fitness": raw["fitness"],
@@ -387,7 +387,6 @@ class FitnessQueueWorker(Logger):
 
     def run(self, max_tasks: Optional[int] = None) -> int:
         """Returns the number of tasks completed by this worker."""
-        from urllib.parse import quote
         task_path = f"/task?worker={quote(self.worker_id)}"
         self.ended_by = ""                 # fresh verdict for THIS run
         last_contact = time.monotonic()
@@ -437,7 +436,6 @@ class FitnessQueueWorker(Logger):
                 out = self.fitness_fn(task["payload"])
                 if isinstance(out, tuple):  # (fitness, artifact bytes)
                     fitness, artifact = out
-                    import base64
                     body["fitness"] = float(fitness)
                     body["artifact"] = \
                         base64.b64encode(artifact).decode()
