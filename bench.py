@@ -137,6 +137,19 @@ def child_main() -> None:
     from veles_tpu import prng
     from veles_tpu.samples.alexnet import create_workflow
 
+    # A/B-winner overrides (the tunnel watcher re-runs the bench with
+    # the measured winner BEFORE any source default flips, so a
+    # post-session warm window still yields a best-config number):
+    # BENCH_LRN = recompute | cached | pallas; BENCH_POOL = slices
+    lrn_mode = os.environ.get("BENCH_LRN", "")
+    if lrn_mode:
+        from veles_tpu.znicz.normalization import LRNormalizerForward
+        LRNormalizerForward.prefer_pallas = lrn_mode == "pallas"
+        LRNormalizerForward.cache_bwd = lrn_mode == "cached"
+    if os.environ.get("BENCH_POOL") == "slices":
+        from veles_tpu.znicz.pooling import MaxPooling
+        MaxPooling.lowering = "slices"
+
     prng.seed_all(1234)
     # On a multi-chip host, shard the data axis over every local chip so
     # the per-chip division below matches where the work actually ran; a

@@ -49,6 +49,51 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
     timeout 600 python tools/granular_vs_fused.py 512 8 \
       > tpu_watch/r5_gran_fused.txt 2>&1
     log "7 granular_vs_fused rc=$?"
+    # 8. apply the measured winners WITHOUT source edits (bench env
+    # knobs) and capture one best-config headline — so even a
+    # post-session warm window leaves the best honest number
+    eval "$(python - <<'PY'
+import json, re
+
+def ablate_rate(path, name):
+    try:
+        for ln in open(path):
+            m = re.match(rf"ABLATE {re.escape(name)}: (\d+) samples/s", ln)
+            if m:
+                return int(m.group(1))
+    except OSError:
+        pass
+    return 0
+
+lrn = {"recompute": ablate_rate("tpu_watch/r5_lrn_ab.txt", "xla-lrn"),
+       "cached": ablate_rate("tpu_watch/r5_lrn_ab.txt",
+                             "xla-lrn-cached-bwd"),
+       "pallas": ablate_rate("tpu_watch/r5_lrn_ab.txt", "pallas-lrn")}
+best_lrn = max(lrn, key=lrn.get) if max(lrn.values()) else "recompute"
+full = ablate_rate("tpu_watch/r5_pool_ab.txt", "full")
+slices = ablate_rate("tpu_watch/r5_pool_ab.txt", "slicepool")
+pool = "slices" if slices > full > 0 else ""
+
+def bench_value(path):
+    try:
+        rec = json.loads(open(path).read().strip().splitlines()[-1])
+        return rec.get("value") or 0, rec.get("batch_per_chip") or 0
+    except (OSError, ValueError, IndexError):
+        return 0, 0
+
+cands = [bench_value("tpu_watch/r5_bench_out.txt"),
+         bench_value("tpu_watch/r5_bench_b512.txt"),
+         bench_value("tpu_watch/r5_bench_b2048.txt")]
+best_batch = max(cands)[1] or 1024
+print(f"BEST_LRN={best_lrn} BEST_POOL={pool} BEST_BATCH={best_batch}")
+PY
+)"
+    log "8 decisions: lrn=$BEST_LRN pool=${BEST_POOL:-reduce_window} batch=$BEST_BATCH"
+    BENCH_LRN=$BEST_LRN ${BEST_POOL:+BENCH_POOL=$BEST_POOL} \
+      BENCH_BATCH=$BEST_BATCH BENCH_ATTACH_E2E=0 \
+      timeout 600 python bench.py \
+      > tpu_watch/r5_bench_best.txt 2> tpu_watch/r5_bench_best.err
+    log "8 best-config bench rc=$? last: $(tail -1 tpu_watch/r5_bench_best.txt | head -c 200)"
     {
       echo "# ONCHIP_LATE — r5 watcher capture ($(date -u +%FT%TZ))"
       echo
@@ -68,6 +113,9 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       echo '```'; tail -1 tpu_watch/r5_image_smoke.txt; echo '```'
       echo "## 7. granular vs fused"
       echo '```'; tail -1 tpu_watch/r5_gran_fused.txt; echo '```'
+      echo "## 8. best-config bench (measured winners applied via env)"
+      echo "winners: lrn=$BEST_LRN pool=${BEST_POOL:-reduce_window} batch=$BEST_BATCH"
+      echo '```'; tail -1 tpu_watch/r5_bench_best.txt; echo '```'
       echo
       echo "Decision rules (tools/README.md): flip"
       echo "LRNormalizerForward.prefer_pallas if Pallas wins; adopt"
