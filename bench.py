@@ -47,7 +47,7 @@ UNIT = "samples/s/chip"
 # batch-sweep result (r3, TPU v5 lite): 128 -> 6456, 256 -> 8951,
 # 512 -> 9620, 1024 -> 9907, 2048 -> 10043 samples/s/chip; 1024 is the
 # knee — 2048 adds 1.4% for 2x the compile/input footprint
-BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+BATCH = int(os.environ.get("BENCH_BATCH") or "1024")
 WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
 STEPS_PER_WINDOW = int(os.environ.get("BENCH_STEPS", "20"))
 
@@ -143,6 +143,11 @@ def child_main() -> None:
     # BENCH_LRN = recompute | cached | pallas; BENCH_POOL = slices
     lrn_mode = os.environ.get("BENCH_LRN", "")
     if lrn_mode:
+        if lrn_mode not in ("recompute", "cached", "pallas"):
+            # fail LOUDLY: a typo silently measuring the default config
+            # would be recorded as the "winner applied" headline
+            raise SystemExit(f"unknown BENCH_LRN {lrn_mode!r} "
+                             "(want recompute|cached|pallas)")
         from veles_tpu.znicz.normalization import LRNormalizerForward
         LRNormalizerForward.prefer_pallas = lrn_mode == "pallas"
         LRNormalizerForward.cache_bwd = lrn_mode == "cached"

@@ -78,7 +78,7 @@ def bench_value(path):
     try:
         rec = json.loads(open(path).read().strip().splitlines()[-1])
         return rec.get("value") or 0, rec.get("batch_per_chip") or 0
-    except (OSError, ValueError, IndexError):
+    except (OSError, ValueError, IndexError, AttributeError, TypeError):
         return 0, 0
 
 cands = [bench_value("tpu_watch/r5_bench_out.txt"),
@@ -88,9 +88,14 @@ best_batch = max(cands)[1] or 1024
 print(f"BEST_LRN={best_lrn} BEST_POOL={pool} BEST_BATCH={best_batch}")
 PY
 )"
+    # defaults in case the decision parser died (eval of empty output)
+    : "${BEST_LRN:=recompute}" "${BEST_POOL:=}" "${BEST_BATCH:=1024}"
     log "8 decisions: lrn=$BEST_LRN pool=${BEST_POOL:-reduce_window} batch=$BEST_BATCH"
-    BENCH_LRN=$BEST_LRN ${BEST_POOL:+BENCH_POOL=$BEST_POOL} \
-      BENCH_BATCH=$BEST_BATCH BENCH_ATTACH_E2E=0 \
+    # `env` so the expanded assignments are arguments to env, not a
+    # command name (a bare expanded VAR=x word would exec-fail rc=127);
+    # empty BENCH_POOL is inert — bench.py only reacts to "slices"
+    env BENCH_LRN="$BEST_LRN" BENCH_POOL="$BEST_POOL" \
+      BENCH_BATCH="$BEST_BATCH" BENCH_ATTACH_E2E=0 \
       timeout 600 python bench.py \
       > tpu_watch/r5_bench_best.txt 2> tpu_watch/r5_bench_best.err
     log "8 best-config bench rc=$? last: $(tail -1 tpu_watch/r5_bench_best.txt | head -c 200)"
