@@ -121,6 +121,27 @@ def analytic_flops_per_sample(step) -> tuple:
     return 3.0 * fwd_flops, per_layer
 
 
+def apply_ab_overrides() -> None:
+    """A/B-winner overrides for EVERY measuring child (device-only and
+    e2e alike — a merged record must measure ONE configuration):
+    BENCH_LRN = recompute | cached | pallas; BENCH_POOL = slices.
+    The tunnel watcher re-runs the bench with the measured winner via
+    these BEFORE any source default flips."""
+    lrn_mode = os.environ.get("BENCH_LRN", "")
+    if lrn_mode:
+        if lrn_mode not in ("recompute", "cached", "pallas"):
+            # fail LOUDLY: a typo silently measuring the default config
+            # would be recorded as the "winner applied" headline
+            raise SystemExit(f"unknown BENCH_LRN {lrn_mode!r} "
+                             "(want recompute|cached|pallas)")
+        from veles_tpu.znicz.normalization import LRNormalizerForward
+        LRNormalizerForward.prefer_pallas = lrn_mode == "pallas"
+        LRNormalizerForward.cache_bwd = lrn_mode == "cached"
+    if os.environ.get("BENCH_POOL") == "slices":
+        from veles_tpu.znicz.pooling import MaxPooling
+        MaxPooling.lowering = "slices"
+
+
 def child_main() -> None:
     import jax
 
@@ -137,24 +158,7 @@ def child_main() -> None:
     from veles_tpu import prng
     from veles_tpu.samples.alexnet import create_workflow
 
-    # A/B-winner overrides (the tunnel watcher re-runs the bench with
-    # the measured winner BEFORE any source default flips, so a
-    # post-session warm window still yields a best-config number):
-    # BENCH_LRN = recompute | cached | pallas; BENCH_POOL = slices
-    lrn_mode = os.environ.get("BENCH_LRN", "")
-    if lrn_mode:
-        if lrn_mode not in ("recompute", "cached", "pallas"):
-            # fail LOUDLY: a typo silently measuring the default config
-            # would be recorded as the "winner applied" headline
-            raise SystemExit(f"unknown BENCH_LRN {lrn_mode!r} "
-                             "(want recompute|cached|pallas)")
-        from veles_tpu.znicz.normalization import LRNormalizerForward
-        LRNormalizerForward.prefer_pallas = lrn_mode == "pallas"
-        LRNormalizerForward.cache_bwd = lrn_mode == "cached"
-    if os.environ.get("BENCH_POOL") == "slices":
-        from veles_tpu.znicz.pooling import MaxPooling
-        MaxPooling.lowering = "slices"
-
+    apply_ab_overrides()
     prng.seed_all(1234)
     # On a multi-chip host, shard the data axis over every local chip so
     # the per-chip division below matches where the work actually ran; a
@@ -292,6 +296,7 @@ def e2e_child_main() -> None:
         pack_arrays(pack_dir, data, rng.randint(0, 64, n).astype(np.int64),
                     [0, 0, n], shard_mb=256.0)
 
+    apply_ab_overrides()
     prng.seed_all(1234)
     loader = MemmapImageLoader(
         data_path=pack_dir, minibatch_size=batch, emit="uint8",

@@ -81,9 +81,12 @@ def main(batch: int = 512, steps: int = 8) -> None:
     sync_granular()
     granular_rate = batch * steps / (time.perf_counter() - t0)
 
-    # -- fused: one donated XLA computation per minibatch --------------------
+    # -- fused: one donated XLA computation per minibatch. SAME f32
+    # compute as the granular units — a bf16 fused step would conflate
+    # dtype speedup with dispatch granularity, the one thing this tool
+    # isolates --------------------------------------------------------------
     wf2 = fresh()
-    step = wf2.build_fused_step(compute_dtype="bfloat16")
+    step = wf2.build_fused_step()
     state = step.init_state()
     import jax.numpy as jnp
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
@@ -104,6 +107,7 @@ def main(batch: int = 512, steps: int = 8) -> None:
         "granular_samples_per_sec": round(granular_rate, 2),
         "fused_samples_per_sec": round(fused_rate, 2),
         "fused_over_granular": round(fused_rate / granular_rate, 3),
+        "compute_dtype": "float32 (both modes)",
         "device_kind": jax.devices()[0].device_kind,
         "caveat": "granular includes per-unit host dispatch; through the "
                   "remote tunnel that latency is inflated vs a local "

@@ -277,10 +277,17 @@ def run_optimize(module, args, device) -> int:
 
     token = os.environ.get("VELES_WEB_TOKEN") or None
 
+    def parse_addr(addr: str, flag: str):
+        host, _, port = addr.rpartition(":")
+        if not port.isdigit():
+            raise SystemExit(
+                f"{flag} needs host:port (got {addr!r})")
+        return host, int(port)
+
     if args.master:                       # cluster worker role
         from veles_tpu.task_queue import FitnessQueueWorker
-        host, _, port = args.master.rpartition(":")
-        worker = FitnessQueueWorker(host or "127.0.0.1", int(port),
+        host, port = parse_addr(args.master, "-m")
+        worker = FitnessQueueWorker(host or "127.0.0.1", port,
                                     fitness, token=token)
         try:
             worker.run()
@@ -300,8 +307,17 @@ def run_optimize(module, args, device) -> int:
     if args.listen:                       # cluster coordinator role
         from veles_tpu.task_queue import (FitnessQueueServer,
                                           FitnessQueueWorker)
-        host, _, port = args.listen.rpartition(":")
-        srv = FitnessQueueServer(host=host or "0.0.0.0", port=int(port),
+        host, port = parse_addr(args.listen, "-l")
+        if not token and not host.startswith("127."):
+            # unauthenticated fitness results on an open port = any
+            # network peer can forge the GA's optimization outcome
+            # (task ids are predictable). Secure by default: demand the
+            # shared secret, or an explicit loopback bind.
+            raise SystemExit(
+                "--optimize -l on a non-loopback address needs a shared "
+                "secret: set VELES_WEB_TOKEN on the coordinator and "
+                "every -m worker (or bind -l 127.0.0.1:PORT)")
+        srv = FitnessQueueServer(host=host or "0.0.0.0", port=port,
                                  token=token).start()
         # the coordinator contributes compute too (reference master ran
         # individuals itself when idle) — connect to the BOUND address:

@@ -79,15 +79,17 @@ class FitnessQueueServer(Logger, IDistributable):
 
     def _sweep_expired(self) -> None:
         """Re-queue every lease past its expiry (worker lost its lease:
-        re-issue, reference master semantics). Caller holds the lock."""
-        now = time.time()
+        re-issue, reference master semantics). Caller holds the lock.
+        Monotonic clock: an NTP step must not mass-expire (or extend)
+        every outstanding lease."""
+        now = time.monotonic()
         for t in self._tasks.values():
             if t["state"] == _LEASED and now > t["lease_expiry"]:
                 t["state"] = _QUEUED
                 t["requeued"] = t.get("requeued", 0) + 1
 
     def _lease_one(self, worker: str = "") -> Optional[Dict[str, Any]]:
-        now = time.time()
+        now = time.monotonic()
         self._sweep_expired()
         for tid, t in self._tasks.items():
             if t["state"] == _QUEUED:
@@ -139,7 +141,7 @@ class FitnessQueueServer(Logger, IDistributable):
         t = self._tasks.get(tid)
         if t is None or t["state"] != _LEASED:
             return False
-        t["lease_expiry"] = time.time() + self.lease_s
+        t["lease_expiry"] = time.monotonic() + self.lease_s
         return True
 
     def _post_result(self, tid: str, fitness: float,
@@ -216,7 +218,13 @@ class FitnessQueueServer(Logger, IDistributable):
                     return
                 if not self._auth():
                     return
-                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    length = int(
+                        self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
                 if length > outer.max_body:
                     # explicit refusal, NOT silent truncation (a
                     # truncated body parses as garbage and 400s) — and
@@ -364,13 +372,18 @@ class FitnessQueueWorker(Logger):
                  body: Optional[Dict[str, Any]] = None
                  ) -> Optional[Dict[str, Any]]:
         import http.client
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=10)
+        payload = json.dumps(body) if body else None
+        # the socket timeout must scale with the body: a fixed 10s would
+        # abort multi-MB artifact uploads (ensemble member pickles) on
+        # real links, and the dropped result would re-train the member
+        timeout = 10.0 + (len(payload) / 1e6 * 1.5 if payload else 0.0)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["X-Veles-Token"] = self.token
         try:
-            conn.request(method, path,
-                         json.dumps(body) if body else None, headers)
+            conn.request(method, path, payload, headers)
             resp = conn.getresponse()
             data = resp.read()
             if resp.status == 403:
@@ -449,12 +462,13 @@ class FitnessQueueWorker(Logger):
                 self.warning("fitness evaluation failed for %s: %s",
                              task["id"], e)
                 body["fitness"] = float("inf")
-            finally:
-                stop_renew.set()
             posted = None
             try:
                 # id rides in the query string too: a 413 refusal can't
-                # read the body, but must still fail the right task
+                # read the body, but must still fail the right task.
+                # The renewer keeps running THROUGH the post: a slow
+                # multi-MB artifact upload must not lose its lease
+                # mid-transfer.
                 posted = self._request(
                     "POST", f"/result?id={quote(task['id'])}", body)
                 if posted is None:
@@ -466,6 +480,8 @@ class FitnessQueueWorker(Logger):
                 raise
             except OSError:
                 pass                        # lease will re-issue the task
+            finally:
+                stop_renew.set()
             if posted is not None and posted.get("accepted"):
                 # only ACCEPTED results count: a rejected/unreachable
                 # post means the task re-issues elsewhere, and
