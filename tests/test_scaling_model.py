@@ -65,3 +65,40 @@ def test_flagship_prediction_meets_target():
                            batch_per_chip=1024, mesh_shape=(8, 8))
     assert p["meets_target_at_measured_batch"]
     assert p["batch_per_chip_at_target"] < 1024
+
+
+def test_tp_layer_rule_of_thumb():
+    """docs/SCALING.md's 'TP worth it when layer width x batch makes the
+    all-reduce smaller than the compute it buys', numeric: the 4096-wide
+    FC pair at batch >= 512 clears the bar; a tiny layer does not."""
+    from veles_tpu.parallel.scaling_model import predict_tp_layer
+
+    big = predict_tp_layer(batch_tokens=512, width=4096, hidden=4096,
+                           tp=2)
+    assert big["worth_it"], big
+    tiny = predict_tp_layer(batch_tokens=8, width=64, hidden=64, tp=8)
+    assert not tiny["worth_it"], tiny
+    # comm is per-step constant in tp (ring (k-1)/k factor saturates),
+    # compute shrinks with tp: the ratio must worsen as tp grows
+    worse = predict_tp_layer(batch_tokens=512, width=4096, hidden=4096,
+                             tp=8)
+    assert worse["comm_over_comp"] > big["comm_over_comp"]
+
+
+def test_ring_sp_crossing():
+    """Ring hop hides under compute iff S_local exceeds the
+    peak·bytes/(2·W) crossing — independent of heads/batch/head_dim
+    (they cancel), ~2.2k tokens on v5e bf16."""
+    from veles_tpu.parallel.scaling_model import ring_sp_overlap
+
+    r = ring_sp_overlap(batch=8, heads=16, head_dim=128, seq_local=4096)
+    assert r["hidden"], r
+    assert 1500 < r["seq_local_at_crossing"] < 3000
+    small = ring_sp_overlap(batch=8, heads=16, head_dim=128,
+                            seq_local=512)
+    assert not small["hidden"], small
+    # the crossing is where the two times meet
+    at = ring_sp_overlap(batch=2, heads=4, head_dim=64,
+                         seq_local=int(r["seq_local_at_crossing"]))
+    assert at["hop_compute_s"] == pytest.approx(at["hop_transfer_s"],
+                                                rel=1e-3)

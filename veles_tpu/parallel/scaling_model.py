@@ -91,3 +91,65 @@ def predict_dp_scaling(*, grad_bytes: float, step_time_s: float,
         "batch_per_chip_at_target": batch_at_target,
         "meets_target_at_measured_batch": eff >= target,
     }
+
+
+#: v5e dense bf16 peak, FLOP/s (bench.py PEAK_TFLOPS)
+V5E_PEAK_FLOPS = 197e12
+
+
+def predict_tp_layer(*, batch_tokens: int, width: int, hidden: int,
+                     tp: int, dtype_bytes: int = 2,
+                     ici_bw_axis_bidir: float = V5E_ICI_BW_AXIS_BIDIR,
+                     peak_flops: float = V5E_PEAK_FLOPS
+                     ) -> Dict[str, Any]:
+    """Megatron col→row FFN pair under `tp`-way tensor parallelism: is
+    the per-layer activation all-reduce smaller than the compute it
+    buys? (docs/SCALING.md "TP pays activation all-reduces per layer
+    pair", made numeric.)
+
+    Per forward, the row-parallel output all-reduces `batch_tokens ×
+    width` activations over the tp axis; backward mirrors it (2×/step).
+    Compute per step ≈ 3 × 2·batch_tokens·width·hidden·2 (fwd + ~2×
+    bwd) split tp ways."""
+    act_bytes = batch_tokens * width * dtype_bytes
+    t_comm = 2.0 * allreduce_time_s(act_bytes, (tp,), ici_bw_axis_bidir)
+    flops = 3.0 * 2.0 * batch_tokens * width * hidden * 2.0
+    t_comp = flops / tp / peak_flops
+    return {
+        "comm_s": t_comm,
+        "comp_s": t_comp,
+        "comm_over_comp": t_comm / t_comp if t_comp else float("inf"),
+        "worth_it": t_comm < t_comp,
+        "inputs": {"batch_tokens": batch_tokens, "width": width,
+                   "hidden": hidden, "tp": tp,
+                   "dtype_bytes": dtype_bytes},
+    }
+
+
+def ring_sp_overlap(*, batch: int, heads: int, head_dim: int,
+                    seq_local: int, dtype_bytes: int = 2,
+                    ici_bw_axis_bidir: float = V5E_ICI_BW_AXIS_BIDIR,
+                    peak_flops: float = V5E_PEAK_FLOPS
+                    ) -> Dict[str, Any]:
+    """Ring attention: each hop ppermutes the local K,V shard while the
+    chip computes attention of its queries against the PREVIOUS shard.
+    The hop hides iff per-hop compute ≥ per-hop transfer
+    (docs/SCALING.md "S_local·d ≳ hop bytes", made numeric — below the
+    crossing, Ulysses' two all_to_alls win)."""
+    hop_bytes = 2 * batch * heads * seq_local * head_dim * dtype_bytes
+    t_hop = hop_bytes / ici_bw_axis_bidir
+    # per-hop attention compute: QK^T + PV over one (S_local x S_local)
+    # block for every head
+    flops = 2.0 * 2.0 * batch * heads * seq_local * seq_local * head_dim
+    t_comp = flops / peak_flops
+    # t_comp >= t_hop  ⇔  4·S²·d/peak >= 2·S·d·bytes/W
+    #                  ⇔  S_local >= peak·bytes/(2·W)   (d, B, H cancel)
+    crossing = peak_flops * dtype_bytes / (2.0 * ici_bw_axis_bidir)
+    return {
+        "hop_transfer_s": t_hop,
+        "hop_compute_s": t_comp,
+        "hidden": t_comp >= t_hop,
+        "seq_local_at_crossing": crossing,
+        "inputs": {"batch": batch, "heads": heads, "head_dim": head_dim,
+                   "seq_local": seq_local, "dtype_bytes": dtype_bytes},
+    }
