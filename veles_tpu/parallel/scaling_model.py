@@ -126,25 +126,33 @@ def predict_tp_layer(*, batch_tokens: int, width: int, hidden: int,
     }
 
 
+#: one direction of one v5e ICI link — the ring's K/V hop
+#: (lax.ppermute i -> i+1, ops/attention.py) travels ONE way, so it
+#: rides a single link, not the per-axis bidirectional aggregate the
+#: all-reduce formula legitimately uses
+V5E_ICI_BW_ONEWAY = 4.5e10
+
+
 def ring_sp_overlap(*, batch: int, heads: int, head_dim: int,
                     seq_local: int, dtype_bytes: int = 2,
-                    ici_bw_axis_bidir: float = V5E_ICI_BW_AXIS_BIDIR,
+                    ici_bw_oneway: float = V5E_ICI_BW_ONEWAY,
                     peak_flops: float = V5E_PEAK_FLOPS
                     ) -> Dict[str, Any]:
-    """Ring attention: each hop ppermutes the local K,V shard while the
-    chip computes attention of its queries against the PREVIOUS shard.
-    The hop hides iff per-hop compute ≥ per-hop transfer
-    (docs/SCALING.md "S_local·d ≳ hop bytes", made numeric — below the
-    crossing, Ulysses' two all_to_alls win)."""
+    """Ring attention: each hop `lax.ppermute`s the local K,V shard one
+    step around the ring while the chip computes attention of its
+    queries against the PREVIOUS shard. The hop hides iff per-hop
+    compute ≥ per-hop transfer (docs/SCALING.md "S_local·d ≳ hop
+    bytes", made numeric — below the crossing, Ulysses' two all_to_alls
+    win). Unidirectional: the hop uses ONE link's bandwidth."""
     hop_bytes = 2 * batch * heads * seq_local * head_dim * dtype_bytes
-    t_hop = hop_bytes / ici_bw_axis_bidir
+    t_hop = hop_bytes / ici_bw_oneway
     # per-hop attention compute: QK^T + PV over one (S_local x S_local)
     # block for every head
     flops = 2.0 * 2.0 * batch * heads * seq_local * seq_local * head_dim
     t_comp = flops / peak_flops
-    # t_comp >= t_hop  ⇔  4·S²·d/peak >= 2·S·d·bytes/W
-    #                  ⇔  S_local >= peak·bytes/(2·W)   (d, B, H cancel)
-    crossing = peak_flops * dtype_bytes / (2.0 * ici_bw_axis_bidir)
+    # t_comp >= t_hop  ⇔  4·S²·d/peak >= 2·S·d·bytes/W_oneway
+    #                  ⇔  S_local >= peak·bytes/(2·W_oneway)  (d,B,H cancel)
+    crossing = peak_flops * dtype_bytes / (2.0 * ici_bw_oneway)
     return {
         "hop_transfer_s": t_hop,
         "hop_compute_s": t_comp,
