@@ -4,6 +4,8 @@ model), causal and non-causal; plus the MultiHeadAttention unit family
 trains (SURVEY.md §4 multi-device test strategy)."""
 
 import jax
+
+from veles_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -32,7 +34,7 @@ def test_ring_attention_matches_golden(seq_mesh, causal):
     q, k, v = make_qkv(0)
     gold = np.asarray(oa.mha_forward(q, k, v, causal=causal))
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda q_, k_, v_: oa.ring_attention(q_, k_, v_, "seq",
                                              causal=causal),
         mesh=seq_mesh, in_specs=(P(None, "seq"),) * 3,
@@ -45,7 +47,7 @@ def test_ring_attention_matches_golden(seq_mesh, causal):
 def test_ulysses_attention_matches_golden(seq_mesh, causal):
     q, k, v = make_qkv(1)
     gold = np.asarray(oa.mha_forward(q, k, v, causal=causal))
-    uly = jax.jit(jax.shard_map(
+    uly = jax.jit(shard_map(
         lambda q_, k_, v_: oa.ulysses_attention(q_, k_, v_, "seq",
                                                 causal=causal),
         mesh=seq_mesh, in_specs=(P(None, "seq"),) * 3,
@@ -63,7 +65,7 @@ def test_ring_attention_differentiable(seq_mesh):
         return (oa.mha_forward(q_, k_, v_, causal=True) ** 2).sum()
 
     def loss_ring(q_, k_, v_):
-        f = jax.shard_map(
+        f = shard_map(
             lambda a, b, c: oa.ring_attention(a, b, c, "seq", causal=True),
             mesh=seq_mesh, in_specs=(P(None, "seq"),) * 3,
             out_specs=P(None, "seq"))
@@ -87,7 +89,7 @@ def test_ring_attention_kv_block_tiling(seq_mesh, causal):
         return (oa.mha_forward(q_, k_, v_, causal=causal) ** 2).sum()
 
     def loss_ring(q_, k_, v_):
-        f = jax.shard_map(
+        f = shard_map(
             lambda a, b, c: oa.ring_attention(a, b, c, "seq",
                                               causal=causal, kv_block=2),
             mesh=seq_mesh, in_specs=(P(None, "seq"),) * 3,
@@ -95,7 +97,7 @@ def test_ring_attention_kv_block_tiling(seq_mesh, causal):
         return (f(q_, k_, v_) ** 2).sum()
 
     # forward
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda a, b, c: oa.ring_attention(a, b, c, "seq", causal=causal,
                                           kv_block=2),
         mesh=seq_mesh, in_specs=(P(None, "seq"),) * 3,
@@ -111,7 +113,7 @@ def test_ring_attention_kv_block_tiling(seq_mesh, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-4)
     # a non-dividing kv_block falls back to one block per hop
-    ring_nd = jax.jit(jax.shard_map(
+    ring_nd = jax.jit(shard_map(
         lambda a, b, c: oa.ring_attention(a, b, c, "seq", causal=causal,
                                           kv_block=3),
         mesh=seq_mesh, in_specs=(P(None, "seq"),) * 3,
@@ -161,7 +163,7 @@ def test_attention_unit_fused_ring_on_mesh(eight_devices):
     def fwd(q_, k_, v_):
         return oa_.ring_attention(q_, k_, v_, "seq", causal=True)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         fwd, mesh=mesh, in_specs=(P("data", "seq"),) * 3,
         out_specs=P("data", "seq")))
     got = np.asarray(f(q, k, v))

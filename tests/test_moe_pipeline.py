@@ -4,6 +4,8 @@ gradients must flow (SURVEY.md §2.4 axis checklist: dp/tp/sp now + ep/pp
 here)."""
 
 import jax
+
+from veles_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -55,7 +57,7 @@ def test_moe_ep_matches_dense(eight_devices):
     gold = np.asarray(om.moe_forward(x, wr, w1, b1, w2, b2, capacity=n))
 
     mesh = Mesh(np.asarray(eight_devices[:4]), ("expert",))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x_, wr_, w1_, b1_, w2_, b2_: om.moe_forward_ep(
             x_, wr_, w1_, b1_, w2_, b2_, "expert", capacity=n // 4),
         mesh=mesh,

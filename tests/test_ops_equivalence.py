@@ -374,7 +374,9 @@ def test_finite_difference_gradcheck_composite_stack():
 
     from veles_tpu.ops import xla as ox
 
-    with jax.enable_x64(True):
+    from veles_tpu._compat import enable_x64
+
+    with enable_x64(True):
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(3, 10, 10, 2), jnp.float64)
         y = jnp.asarray(rng.randint(0, 4, 3))

@@ -1,0 +1,288 @@
+"""Resilience layer, in-process half: fault-plan grammar, hardened
+snapshot writes (sha256 sidecar, corrupt/partial fallback), the fused
+step's non-finite-loss guard, and epoch hooks. The multi-process
+supervisor end-to-end tests live in test_supervisor.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from veles_tpu.resilience import NonFiniteLossError
+from veles_tpu.resilience import faults as rfaults
+from veles_tpu.resilience import hooks as rhooks
+from veles_tpu.resilience.faults import FaultPlan
+from veles_tpu.snapshotter import Snapshotter
+from veles_tpu.workflow import Workflow
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """No fault plan or epoch hook leaks between tests."""
+    rfaults.install_plan(None)
+    rhooks.clear_epoch_hooks()
+    yield
+    rfaults.install_plan(None)
+    rhooks.clear_epoch_hooks()
+
+
+# -- fault-plan grammar --------------------------------------------------------
+
+def test_fault_plan_compact_grammar():
+    plan = FaultPlan.parse("kill@epoch=2; hang@epoch=5; nan@step=10; "
+                           "corrupt_snapshot@write=2")
+    assert [e.key for e in plan.entries] == [
+        "kill@epoch=2", "hang@epoch=5", "nan@step=10",
+        "corrupt_snapshot@write=2"]
+
+
+def test_fault_plan_bare_action_defaults_to_one():
+    plan = FaultPlan.parse("corrupt_snapshot")
+    assert plan.entries[0].key == "corrupt_snapshot@write=1"
+
+
+def test_fault_plan_json_grammar():
+    plan = FaultPlan.parse(json.dumps(
+        [{"action": "kill", "epoch": 3}, {"action": "nan", "step": 7}]))
+    assert [e.key for e in plan.entries] == ["kill@epoch=3", "nan@step=7"]
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@epoch=1",        # unknown action
+    "kill@step=1",            # kill keys on epoch, not step
+    "nan@step=zero",          # non-numeric trigger
+    "",                       # empty
+    ";;",                     # no entries
+])
+def test_fault_plan_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_fault_entries_fire_once_and_persist(tmp_path):
+    """An entry fires at most once, and with a state file the fired set
+    survives into a new plan instance (a restarted process whose epoch
+    counter re-crosses the trigger must not re-fire the fault)."""
+    state = str(tmp_path / "fault_state.json")
+    plan = FaultPlan.parse("nan@step=2", state_path=state)
+    assert not plan.nan_at_step()          # step 1
+    assert plan.nan_at_step()              # step 2: fires
+    assert not plan.nan_at_step(2)         # same trigger: spent
+    # "restarted process": a fresh plan over the same state file
+    plan2 = FaultPlan.parse("nan@step=2", state_path=state)
+    assert not plan2.nan_at_step(2)
+
+
+def test_active_plan_reads_env(monkeypatch):
+    rfaults.reset()
+    monkeypatch.delenv("VELES_FAULT_PLAN", raising=False)
+    assert rfaults.active_plan() is None
+    rfaults.reset()
+    monkeypatch.setenv("VELES_FAULT_PLAN", "nan@step=3")
+    plan = rfaults.active_plan()
+    assert plan is not None and plan.entries[0].key == "nan@step=3"
+    rfaults.reset()
+
+
+# -- epoch hook registry -------------------------------------------------------
+
+def test_epoch_hooks_fire_in_order_and_remove():
+    seen = []
+    a = rhooks.add_epoch_hook(lambda e: seen.append(("a", e)))
+    rhooks.add_epoch_hook(lambda e: seen.append(("b", e)))
+    rhooks.fire_epoch(1)
+    assert seen == [("a", 1), ("b", 1)]
+    rhooks.remove_epoch_hook(a)
+    rhooks.remove_epoch_hook(a)     # double-remove is a no-op
+    rhooks.fire_epoch(2)
+    assert seen[-1] == ("b", 2)
+
+
+def test_decision_fires_epoch_hook():
+    """The Decision unit is the single epoch-boundary authority for BOTH
+    execution modes; its epoch increments must reach the registry."""
+    wf = _tiny_workflow(max_epochs=3)
+    seen = []
+    rhooks.add_epoch_hook(seen.append)
+    wf.run_fused()
+    assert seen == [1, 2, 3]
+
+
+# -- hardened snapshot writes --------------------------------------------------
+
+def _snapshot(tmp_path, suffix, mtime=None):
+    """Write one real (pickled-workflow) snapshot with a pinned stamp."""
+    wf = Workflow(name="SnapWF")
+    snap = Snapshotter(wf, prefix="hard", directory=str(tmp_path))
+    snap.initialize()
+    snap.suffix = suffix
+    path = snap.export()
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+def test_export_writes_sha256_sidecar_and_verifies(tmp_path):
+    path = _snapshot(tmp_path, "a")
+    sidecar = path + ".sha256"
+    assert os.path.exists(sidecar)
+    with open(sidecar) as f:
+        digest, name = f.read().split()
+    assert len(digest) == 64 and name == os.path.basename(path)
+    assert Snapshotter.verify(path)
+    assert not os.path.exists(path + ".tmp")
+    assert Snapshotter.latest(str(tmp_path), prefix="hard") == path
+
+
+def test_latest_skips_truncated_snapshot(tmp_path):
+    """A snapshot truncated mid-file (torn write) is detected and the
+    previous valid snapshot wins."""
+    old = _snapshot(tmp_path, "old", mtime=1_000_000)
+    new = _snapshot(tmp_path, "new", mtime=2_000_000)
+    with open(new, "r+b") as f:
+        f.truncate(os.path.getsize(new) // 2)
+    assert not Snapshotter.verify(new)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard") == old
+
+
+def test_latest_skips_bitflipped_snapshot_via_checksum(tmp_path):
+    old = _snapshot(tmp_path, "old", mtime=1_000_000)
+    new = _snapshot(tmp_path, "new", mtime=2_000_000)
+    size = os.path.getsize(new)
+    with open(new, "r+b") as f:       # same size, different bytes
+        f.seek(size // 2)
+        f.write(b"\x00\xff\x00\xff")
+    assert not Snapshotter.verify(new)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard") == old
+
+
+def test_latest_verifies_legacy_gz_without_sidecar(tmp_path):
+    """Pre-hardening snapshots have no sidecar: gz stream integrity is
+    the fallback check, so a truncated legacy file is still skipped."""
+    old = _snapshot(tmp_path, "old", mtime=1_000_000)
+    new = _snapshot(tmp_path, "new", mtime=2_000_000)
+    os.remove(old + ".sha256")
+    os.remove(new + ".sha256")
+    with open(new, "r+b") as f:
+        f.truncate(os.path.getsize(new) // 2)
+    assert Snapshotter.verify(old)
+    assert not Snapshotter.verify(new)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard") == old
+
+
+def test_latest_skip_rolls_back_one_valid(tmp_path):
+    """skip=1 = the supervisor's non-finite rollback: second-newest
+    VALID snapshot (corrupt ones don't count against the skip)."""
+    oldest = _snapshot(tmp_path, "a", mtime=1_000_000)
+    middle = _snapshot(tmp_path, "b", mtime=2_000_000)
+    newest = _snapshot(tmp_path, "c", mtime=3_000_000)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard",
+                              skip=1) == middle
+    with open(newest, "r+b") as f:
+        f.truncate(10)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard",
+                              skip=1) == oldest
+    assert Snapshotter.latest(str(tmp_path), prefix="hard",
+                              skip=2) is None
+
+
+def test_latest_returns_none_when_all_corrupt(tmp_path):
+    path = _snapshot(tmp_path, "only")
+    with open(path, "r+b") as f:
+        f.truncate(8)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard") is None
+
+
+def test_corrupt_snapshot_fault_hook(tmp_path):
+    """corrupt_snapshot@write=2 tears exactly the second export (via the
+    Snapshotter's post-write hook), and latest() falls back to the
+    first."""
+    rfaults.install_plan(FaultPlan.parse("corrupt_snapshot@write=2"))
+    wf = Workflow(name="SnapWF")
+    snap = Snapshotter(wf, prefix="fault", directory=str(tmp_path),
+                       interval=1)
+    snap.initialize()
+    snap.suffix = "w1"
+    snap.run()
+    first = snap.destination
+    os.utime(first, (1_000_000, 1_000_000))
+    snap.suffix = "w2"
+    snap._last_time = 0.0
+    snap.run()
+    second = snap.destination
+    assert second != first
+    assert Snapshotter.verify(first)
+    assert not Snapshotter.verify(second)
+    assert Snapshotter.latest(str(tmp_path), prefix="fault") == first
+
+
+def test_keep_last_prunes_sidecars(tmp_path):
+    wf = Workflow(name="SnapWF")
+    snap = Snapshotter(wf, prefix="prune", directory=str(tmp_path),
+                       interval=1, keep_last=1)
+    snap.initialize()
+    for i in range(3):
+        snap.suffix = f"s{i}"
+        snap._last_time = 0.0
+        snap.run()
+    files = sorted(os.listdir(tmp_path))
+    assert len([f for f in files if f.endswith(".sha256")]) == 1
+    assert len([f for f in files if not f.endswith(".sha256")]) == 1
+
+
+def test_import_still_reads_hardened_snapshot(tmp_path):
+    path = _snapshot(tmp_path, "roundtrip")
+    wf = Snapshotter.import_(path)
+    assert wf.name == "SnapWF"
+
+
+# -- non-finite loss guard -----------------------------------------------------
+
+def _tiny_workflow(max_epochs=5):
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.seed_all(13)
+    loader = SyntheticClassifierLoader(
+        n_classes=3, sample_shape=(8,), n_validation=30, n_train=90,
+        minibatch_size=30, noise=0.3)
+    return StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8,
+                 "weights_stddev": 0.1},
+                {"type": "softmax", "output_sample_shape": 3,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=3,
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": 1000},
+        gd_config={"learning_rate": 0.05}, name="GuardWF")
+
+
+def test_nonfinite_guard_aborts_on_injected_nan():
+    """nan@step=K + guard: the fused loop raises NonFiniteLossError at
+    the class-pass boundary, BEFORE the decision/snapshot branch runs —
+    the poisoned epoch is never counted and never snapshotted."""
+    rfaults.install_plan(FaultPlan.parse("nan@step=2"))
+    wf = _tiny_workflow()
+    with pytest.raises(NonFiniteLossError) as exc:
+        wf.run_fused(nonfinite_guard=True)
+    assert "non-finite loss" in str(exc.value)
+    # the guard fired at the train-pass boundary of epoch 1, before
+    # dec.run() could complete the epoch (or gate a snapshot on it)
+    assert wf.decision.epoch_number == 0
+
+
+def test_nonfinite_guard_off_by_default():
+    """Without the guard an injected NaN does NOT raise (parity with the
+    old behavior: the decision just sees a NaN loss and keeps going)."""
+    rfaults.install_plan(FaultPlan.parse("nan@step=2"))
+    wf = _tiny_workflow(max_epochs=2)
+    wf.run_fused()      # completes despite the NaN
+    assert wf.decision.epoch_number == 2
+
+
+def test_clean_run_unaffected_by_guard():
+    wf = _tiny_workflow(max_epochs=2)
+    wf.run_fused(nonfinite_guard=True)
+    assert wf.decision.epoch_number == 2
+    assert np.isfinite(wf.evaluator.loss)

@@ -48,10 +48,14 @@ def test_snapshotter_writes_stamped_compressed_file(tmp_path):
     wf.run()
     files = sorted(os.listdir(tmp_path))
     assert files, "no snapshot written despite improvements"
+    # every snapshot rides with its sha256 integrity sidecar
+    snaps = [f for f in files if not f.endswith(".sha256")]
     assert all(f.startswith("t_") and f.endswith(".pickle.gz")
-               for f in files)
+               for f in snaps)
+    assert sorted(f + ".sha256" for f in snaps) == \
+        sorted(f for f in files if f.endswith(".sha256"))
     # stamp embeds the best validation error at write time
-    assert wf.snapshotter.destination in [str(tmp_path / f) for f in files]
+    assert wf.snapshotter.destination in [str(tmp_path / f) for f in snaps]
 
 
 def test_snapshotter_resume_continues_training(tmp_path):
@@ -78,7 +82,9 @@ def test_snapshotter_keep_last_prunes(tmp_path):
     wf.initialize(device=NumpyDevice())
     wf.run()
     files = os.listdir(tmp_path)
-    assert len(files) == 1
+    # one snapshot + its sha256 sidecar survive the pruning
+    assert len([f for f in files if not f.endswith(".sha256")]) == 1
+    assert len([f for f in files if f.endswith(".sha256")]) == 1
 
 
 def test_snapshot_import_sniffs_codec(tmp_path):

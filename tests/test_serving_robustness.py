@@ -1,0 +1,204 @@
+"""InferenceServer robustness: /healthz, bounded admission (503 on
+overload instead of unbounded queuing), per-request timeouts, and
+graceful drain on shutdown."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One tiny trained workflow + server per module (building the jit
+    forward dominates the cost; individual tests re-tune the knobs)."""
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.serving import InferenceServer
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    prng.seed_all(41)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(10,), n_validation=40, n_train=160,
+        minibatch_size=40, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.1},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 2, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="RobustServeWF")
+    wf.run_fused()
+    srv = InferenceServer(wf, max_batch=16).start()
+    yield srv
+    srv.stop(drain_s=0)
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post_predict(url, rows, timeout=30):
+    req = json.dumps({"inputs": rows}).encode()
+    try:
+        with urllib.request.urlopen(urllib.request.Request(
+                url + "/predict", data=req,
+                headers={"Content-Type": "application/json"}),
+                timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_reports_ok_and_stats(served):
+    url = f"http://127.0.0.1:{served.port}"
+    status, payload = _get(url + "/healthz")
+    assert status == 200
+    assert payload["status"] == "ok"
+    assert payload["uptime_s"] >= 0
+    assert payload["queue_limit"] == served.queue_limit
+    before = payload["n_dispatches"]
+    _post_predict(url, np.zeros((2, 10)).tolist())
+    status, payload = _get(url + "/healthz")
+    assert payload["n_dispatches"] > before
+
+
+def test_overload_sheds_with_503(served):
+    """queue_limit in-flight requests: the next one is rejected at the
+    door with 503, not queued forever."""
+    url = f"http://127.0.0.1:{served.port}"
+    old_limit = served.queue_limit
+    release = threading.Event()
+    orig_forward = served._forward_rows
+
+    def slow_forward(x):
+        release.wait(10)
+        return orig_forward(x)
+
+    served.queue_limit = 1
+    served._forward_rows = slow_forward
+    results = []
+
+    def client():
+        results.append(_post_predict(url, np.zeros((1, 10)).tolist()))
+
+    try:
+        t1 = threading.Thread(target=client)
+        t1.start()
+        deadline = time.time() + 5
+        while served._inflight < 1 and time.time() < deadline:
+            time.sleep(0.01)     # first request is inside the server
+        status, payload = _post_predict(url, np.zeros((1, 10)).tolist())
+        assert status == 503
+        assert "overloaded" in payload["error"]
+        assert served.n_rejected >= 1
+    finally:
+        release.set()
+        t1.join(timeout=15)
+        served.queue_limit = old_limit
+        served._forward_rows = orig_forward
+    assert results and results[0][0] == 200   # the slow one still landed
+
+
+def test_request_timeout_returns_503(served):
+    """A queued request that misses request_timeout_s is answered 503
+    and abandoned (the batcher drops it instead of dispatching)."""
+    url = f"http://127.0.0.1:{served.port}"
+    old_timeout = served.request_timeout_s
+    release = threading.Event()
+    orig_forward = served._forward_rows
+
+    def slow_forward(x):
+        release.wait(10)
+        return orig_forward(x)
+
+    served.request_timeout_s = 0.3
+    served._forward_rows = slow_forward
+    first = []
+
+    def client():
+        first.append(_post_predict(url, np.zeros((1, 10)).tolist()))
+
+    try:
+        t1 = threading.Thread(target=client)
+        t1.start()
+        deadline = time.time() + 5
+        while served._inflight < 1 and time.time() < deadline:
+            time.sleep(0.01)     # first request is stuck dispatching
+        # second request queues behind the stuck dispatch and times out
+        status, payload = _post_predict(url, np.zeros((1, 10)).tolist())
+        assert status == 503
+        assert "timed out" in payload["error"]
+        assert served.n_timeouts >= 1
+    finally:
+        release.set()
+        t1.join(timeout=15)
+        served.request_timeout_s = old_timeout
+        served._forward_rows = orig_forward
+
+
+def test_graceful_drain_finishes_inflight_then_refuses():
+    """stop(): in-flight work completes, new work gets 503, /healthz
+    flips to draining — then the listener closes."""
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.serving import InferenceServer
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    prng.seed_all(42)
+    loader = SyntheticClassifierLoader(
+        n_classes=3, sample_shape=(6,), n_validation=30, n_train=60,
+        minibatch_size=30, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[{"type": "softmax", "output_sample_shape": 3,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=3,
+        decision_config={"max_epochs": 1, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1}, name="DrainWF")
+    wf.run_fused()
+    srv = InferenceServer(wf, max_batch=8).start()
+    url = f"http://127.0.0.1:{srv.port}"
+
+    release = threading.Event()
+    orig_forward = srv._forward_rows
+
+    def slow_forward(x):
+        release.wait(10)
+        return orig_forward(x)
+
+    srv._forward_rows = slow_forward
+    results = []
+    t = threading.Thread(target=lambda: results.append(
+        _post_predict(url, np.zeros((1, 6)).tolist())))
+    t.start()
+    deadline = time.time() + 5
+    while srv._inflight < 1 and time.time() < deadline:
+        time.sleep(0.01)
+
+    stopper = threading.Thread(target=lambda: srv.stop(drain_s=10))
+    stopper.start()
+    deadline = time.time() + 5
+    while not srv._draining and time.time() < deadline:
+        time.sleep(0.01)
+    # while draining: health says so (503) and new predicts are refused
+    status, payload = _get(url + "/healthz")
+    assert status == 503 and payload["status"] == "draining"
+    status, payload = _post_predict(url, np.zeros((1, 6)).tolist())
+    assert status == 503 and "draining" in payload["error"]
+
+    release.set()           # let the in-flight request finish
+    t.join(timeout=15)
+    stopper.join(timeout=15)
+    assert not stopper.is_alive()
+    assert results and results[0][0] == 200   # drained, not dropped
+    assert srv._httpd is None                 # listener actually closed

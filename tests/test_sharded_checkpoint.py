@@ -8,7 +8,8 @@ import pytest
 
 from veles_tpu import prng
 from veles_tpu.parallel import make_mesh
-from veles_tpu.parallel.checkpoint import restore_state, save_state
+from veles_tpu.parallel.checkpoint import (CheckpointGeometryError,
+                                           restore_state, save_state)
 from veles_tpu.parallel.mesh import MODEL_AXIS
 
 
@@ -106,6 +107,45 @@ def test_ep_sharded_roundtrip(tmp_path, eight_devices):
         np.asarray(restored["params"][0]["w1"]))
     s2, (loss, _) = step2.train(restored, x, y)
     assert np.isfinite(float(loss))
+
+
+def test_geometry_mismatch_raises_clear_error(tmp_path):
+    """Restoring into a differently-shaped step raises ONE typed error
+    naming the mismatched leaves (resilience satellite), not a raw Orbax
+    traceback the operator has to reverse-engineer."""
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    wf = build()
+    step = wf.build_fused_step()
+    state = step.init_state()
+    save_state(state, str(tmp_path))
+
+    def build_narrow():
+        prng.seed_all(55)
+        loader = SyntheticClassifierLoader(
+            n_classes=10, sample_shape=(8, 8), n_validation=96,
+            n_train=480, minibatch_size=48, noise=0.6)
+        wf = StandardWorkflow(
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                     "weights_stddev": 0.05},    # 16 != saved 32
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "weights_stddev": 0.05}],
+            loader=loader, loss="softmax", n_classes=10,
+            decision_config={"max_epochs": 2, "fail_iterations": 50},
+            gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+            name="NarrowWF")
+        wf.initialize(device=None)
+        return wf
+
+    step2 = build_narrow().build_fused_step()
+    with pytest.raises(CheckpointGeometryError) as exc:
+        restore_state(step2, str(tmp_path))
+    msg = str(exc.value)
+    assert "mismatched leaves" in msg
+    # the first layer's weights disagree on shape and must be NAMED
+    assert "params/0/weights" in msg
+    assert exc.value.mismatches
 
 
 def test_roundtrip_nondefault_prng_impl_and_adam(tmp_path):

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Chaos harness: drive a short CPU training job through every fault
+plan the resilience layer claims to survive, and print a pass/fail
+recovery matrix.
+
+    python tools/chaos.py [--keep] [--only kill,stall,...]
+
+Each scenario runs `python -m veles_tpu --supervise` on a tiny
+synthetic-classifier workflow (6 epochs, snapshots on improvement) with
+one VELES_FAULT_PLAN entry injected, then checks that the run finished
+with the SAME final epoch count as the uninterrupted baseline — i.e.
+recovery was automatic and complete. Exit code: 0 when every scenario
+recovers, 1 otherwise.
+
+This is the operational twin of tests/test_supervisor.py: CI asserts a
+fast subset; this prints the whole matrix for a human (and is the thing
+to run after touching supervisor/snapshotter/fault code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKFLOW_SRC = '''
+from veles_tpu.config import root
+from veles_tpu import prng
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+root.chaoswf.snapshot_dir = "."
+
+MAX_EPOCHS = 6
+
+def create_workflow():
+    prng.seed_all(77)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(10,), n_validation=40, n_train=200,
+        minibatch_size=40, noise=0.4)
+    return StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "weights_stddev": 0.1},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": MAX_EPOCHS,
+                         "fail_iterations": 100000},
+        gd_config={"learning_rate": 0.05, "gradient_moment": 0.9},
+        snapshot_config={"directory": root.chaoswf.snapshot_dir,
+                         "prefix": "chaoswf"},
+        name="ChaosWF")
+
+def run(load, main):
+    wf, restored = load(create_workflow)
+    main()
+    print("FINAL", wf.decision.epoch_number, flush=True)
+'''
+
+#: the matrix: name -> (fault plan, extra CLI flags, expectation)
+SCENARIOS = {
+    "baseline": ("", (), "completes uninterrupted"),
+    "kill": ("kill@epoch=2", (), "SIGKILL mid-run -> restart from "
+                                 "snapshot"),
+    "stall": ("hang@epoch=2", ("--stall-timeout", "10"),
+              "hang -> stall detector kills + restarts"),
+    "nan": ("nan@step=5", ("--fused", "--nonfinite-guard"),
+            "NaN loss -> guard aborts -> rollback restart"),
+    "corrupt": ("corrupt_snapshot@write=2; kill@epoch=3", (),
+                "torn newest snapshot -> checksum fallback"),
+}
+
+
+def run_scenario(name: str, plan: str, extra, verbose: bool) -> dict:
+    tmp = tempfile.mkdtemp(prefix=f"chaos_{name}_")
+    wf_py = os.path.join(tmp, "chaoswf.py")
+    with open(wf_py, "w") as f:
+        f.write(WORKFLOW_SRC)
+    report = os.path.join(tmp, "report.json")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("VELES_FAULT_STATE", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if plan:
+        env["VELES_FAULT_PLAN"] = plan
+    else:
+        env.pop("VELES_FAULT_PLAN", None)
+    cmd = [sys.executable, "-m", "veles_tpu", wf_py, "--no-stats", "-v",
+           "--supervise", "--snapshot-dir", tmp,
+           "--snapshot-prefix", "chaoswf", "--max-restarts", "3",
+           "--supervise-report", report,
+           f"root.chaoswf.snapshot_dir={tmp}", *extra]
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env, cwd=tmp, capture_output=True,
+                          text=True, timeout=600)
+    elapsed = time.time() - t0
+    final = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("FINAL")]
+    final_epoch = int(final[-1].split()[1]) if final else None
+    attempts = None
+    if os.path.exists(report):
+        with open(report) as f:
+            attempts = len(json.load(f)["attempts"])
+    ok = proc.returncode == 0 and final_epoch == 6
+    if plan:     # a fault scenario that never needed recovery is a FAIL
+        ok = ok and (attempts or 0) >= 2
+    if verbose and not ok:
+        sys.stderr.write(proc.stderr[-3000:] + "\n")
+    return {"tmp": tmp, "ok": ok, "rc": proc.returncode,
+            "final_epoch": final_epoch, "attempts": attempts,
+            "elapsed": elapsed}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", default="",
+                    help="comma-separated scenario subset "
+                         f"(of {', '.join(SCENARIOS)})")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the per-scenario temp dirs for debugging")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="dump child stderr on failure")
+    args = ap.parse_args()
+    only = {s.strip() for s in args.only.split(",") if s.strip()}
+    unknown = only - set(SCENARIOS)
+    if unknown:
+        ap.error(f"unknown scenarios: {sorted(unknown)}")
+
+    rows = []
+    for name, (plan, extra, blurb) in SCENARIOS.items():
+        if only and name not in only:
+            continue
+        print(f"chaos: {name}: {blurb} …", flush=True)
+        r = run_scenario(name, plan, extra, args.verbose)
+        rows.append((name, plan or "—", r))
+        if not args.keep:
+            import shutil
+            shutil.rmtree(r["tmp"], ignore_errors=True)
+
+    print()
+    print(f"{'scenario':<10} {'fault plan':<36} {'recovered':<10} "
+          f"{'epochs':<7} {'attempts':<9} {'secs':<6}")
+    failed = 0
+    for name, plan, r in rows:
+        verdict = "PASS" if r["ok"] else "FAIL"
+        failed += not r["ok"]
+        print(f"{name:<10} {plan:<36} {verdict:<10} "
+              f"{r['final_epoch'] or '-':<7} {r['attempts'] or '-':<9} "
+              f"{r['elapsed']:<6.1f}")
+    print()
+    if failed:
+        print(f"{failed} scenario(s) did NOT recover", file=sys.stderr)
+        return 1
+    print("all scenarios recovered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the persistent XLA compilation cache "
                         "(it is auto-disabled on tunneled backends, where "
                         "it deadlocks the first compile)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run under the resilience supervisor: this "
+                        "process becomes a light parent that spawns the "
+                        "training run, watches its per-epoch heartbeat, "
+                        "and on crash/hang restarts it from the newest "
+                        "VALID snapshot (exponential backoff, bounded "
+                        "retries, no-progress cutoff)")
+    p.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                   help="supervisor retry budget: give up after N "
+                        "restarts (default 3)")
+    p.add_argument("--stall-timeout", type=float, default=300.0,
+                   metavar="SECONDS",
+                   help="supervisor hang detection: kill + restart the "
+                        "job when its heartbeat (touched every epoch) "
+                        "goes stale this long (default 300; 0 disables)")
+    p.add_argument("--snapshot-dir", default=".", metavar="DIR",
+                   help="where the supervisor looks for snapshots to "
+                        "restart from (default: cwd)")
+    p.add_argument("--snapshot-prefix", default="", metavar="PREFIX",
+                   help="snapshot filename prefix filter for --supervise "
+                        "restarts")
+    p.add_argument("--supervise-report", default="", metavar="PATH",
+                   help="write the supervisor's JSON exit report "
+                        "(attempt log, outcome) to PATH")
+    p.add_argument("--nonfinite-guard", action="store_true",
+                   help="abort fused/pipelined training with a distinct "
+                        "exit code the moment the loss goes NaN/inf "
+                        "(the supervisor then rolls back one snapshot "
+                        "before retrying)")
     p.add_argument("--optimize", type=int, default=0, metavar="GENERATIONS",
                    help="genetic hyperparameter search instead of a single "
                         "run: the workflow/config module must define "
@@ -142,19 +171,11 @@ def _daemonize(log_path: str, argv) -> int:
     the foreground one."""
     import subprocess
 
+    from veles_tpu.resilience.supervisor import strip_flags
+
     log_path = os.path.abspath(log_path)
-    cmd = [sys.executable, "-m", "veles_tpu"]
-    skip = False
-    for a in argv:
-        if skip:
-            skip = False
-            continue
-        if a == "--daemon":
-            skip = True                       # drop the flag + its value
-            continue
-        if a.startswith("--daemon="):
-            continue
-        cmd.append(a)
+    cmd = [sys.executable, "-m", "veles_tpu"] \
+        + strip_flags(argv, {"--daemon": True})
     logfd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
     nullfd = os.open(os.devnull, os.O_RDONLY)
     try:
@@ -168,8 +189,43 @@ def _daemonize(log_path: str, argv) -> int:
     return child.pid
 
 
+#: supervisor-only flags, stripped from the child's command line
+#: (flag name -> takes a value)
+_SUPERVISOR_FLAGS = {"--supervise": False, "--max-restarts": True,
+                     "--stall-timeout": True, "--snapshot-dir": True,
+                     "--snapshot-prefix": True, "--supervise-report": True}
+
+
+def _supervise(args, argv) -> int:
+    """--supervise: become the resilience supervisor. This process stays
+    import-light (no jax, no workflow module) — it only spawns/watches
+    the real training command (= argv minus the supervisor-only flags)
+    and restarts it from snapshots."""
+    if args.serve is not None:
+        raise SystemExit("--supervise supervises training runs; it "
+                         "conflicts with --serve")
+    if args.optimize:
+        raise SystemExit("--supervise and --optimize are exclusive "
+                         "modes (GA individuals are already independent "
+                         "restartable runs)")
+    from veles_tpu.resilience.supervisor import Supervisor, strip_flags
+    cmd = [sys.executable, "-m", "veles_tpu"] \
+        + strip_flags(argv, _SUPERVISOR_FLAGS)
+    sup = Supervisor(
+        [cmd], snapshot_dir=args.snapshot_dir,
+        snapshot_prefix=args.snapshot_prefix,
+        max_restarts=args.max_restarts,
+        stall_timeout=args.stall_timeout,
+        report_path=args.supervise_report)
+    return sup.run()
+
+
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    # intermixed parsing: this environment's argparse otherwise refuses
+    # trailing `root.a.b=value` overrides once any optional flag
+    # separates them from the workflow positional (`wf.py --no-stats
+    # root.x=1` errored with "unrecognized arguments")
+    args = build_parser().parse_intermixed_args(argv)
     if "=" in args.config:
         # `veles_tpu wf.py root.a.b=1` with config omitted: argparse binds
         # the first override to the config positional — reroute it
@@ -181,6 +237,8 @@ def main(argv=None) -> int:
         print(daemon_pid, flush=True)
         return 0
     set_verbosity(args.verbose)
+    if args.supervise:
+        return _supervise(args, argv if argv is not None else sys.argv[1:])
     if args.no_plot:
         from veles_tpu.config import root as _root
         _root.common.plotting_disabled = 1
@@ -225,7 +283,8 @@ def main(argv=None) -> int:
         fused=args.fused, manhole=args.manhole, pp=args.pp,
         serve=args.serve, accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,
-        compile_cache=not args.no_compile_cache)
+        compile_cache=not args.no_compile_cache,
+        nonfinite_guard=args.nonfinite_guard)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.resilience import EXIT_NONFINITE, NonFiniteLossError
 from veles_tpu.snapshotter import Snapshotter
 
 
@@ -39,6 +40,7 @@ class Launcher(Logger):
                  accum: Optional[int] = None, report: str = "",
                  tp: Optional[int] = None, sp: Optional[int] = None,
                  ep: bool = False, compile_cache: bool = True,
+                 nonfinite_guard: bool = False,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -112,6 +114,10 @@ class Launcher(Logger):
                              "(single-process EP uses "
                              "build_fused_step(ep=True) directly)")
         self.ep = bool(ep)
+        #: abort fused/pipelined training with a distinct exit code the
+        #: moment a class pass's loss goes non-finite (resilience layer:
+        #: the Supervisor rolls back one snapshot before retrying)
+        self.nonfinite_guard = nonfinite_guard
         #: opt-out for the persistent XLA compile cache (the cache is
         #: also auto-skipped on axon backends — see
         #: enable_compilation_cache)
@@ -265,6 +271,27 @@ class Launcher(Logger):
             from veles_tpu.manhole import ManholeServer
             self._manhole = ManholeServer(self.workflow,
                                           port=self.manhole_port).start()
+        # resilience plumbing: when a Supervisor spawned this process it
+        # exports VELES_HEARTBEAT_FILE — touch it now (startup liveness,
+        # covers the first compile) and at every epoch boundary. A fault
+        # plan (VELES_FAULT_PLAN) rides the same epoch hook registry;
+        # heartbeat hooks register FIRST so a hang fault's last epoch is
+        # still reported before the process stops heartbeating.
+        from veles_tpu.resilience import faults as _faults
+        from veles_tpu.resilience import hooks as _rhooks
+        installed_hooks = []
+        hb_path = os.environ.get("VELES_HEARTBEAT_FILE", "")
+        if hb_path:
+            from veles_tpu.resilience.supervisor import write_heartbeat
+            epoch0 = getattr(getattr(self.workflow, "decision", None),
+                             "epoch_number", 0)
+            write_heartbeat(hb_path, epoch0)
+            installed_hooks.append(_rhooks.add_epoch_hook(
+                lambda epoch: write_heartbeat(hb_path, epoch)))
+        plan = _faults.active_plan()
+        if plan is not None:
+            self.warning("fault plan active: %s", plan)
+            installed_hooks.append(_rhooks.add_epoch_hook(plan.on_epoch))
         profiling = False
         if self.profile_dir:
             import jax
@@ -359,24 +386,27 @@ class Launcher(Logger):
                         jax.device_count(), dict(mesh.shape))
                     # mode="auto": FusedTrainStep derives seq/gspmd/dp
                     # from the mesh axis sizes — one source of truth
-                    self.workflow.run_fused(device=self.device, mesh=mesh,
-                                            mode="auto", ep=self.ep,
-                                            accum_steps=self.accum,
-                                            **kwargs)
+                    self.workflow.run_fused(
+                        device=self.device, mesh=mesh,
+                        mode="auto", ep=self.ep,
+                        accum_steps=self.accum,
+                        nonfinite_guard=self.nonfinite_guard, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):
                     raise SystemExit(
                         f"--pp: {type(self.workflow).__name__} has no "
                         "pipeline step (StandardWorkflow-family only)")
-                self.workflow.run_pipelined(n_microbatches=self.pp,
-                                            device=self.device, **kwargs)
+                self.workflow.run_pipelined(
+                    n_microbatches=self.pp, device=self.device,
+                    nonfinite_guard=self.nonfinite_guard, **kwargs)
             elif self.fused:
                 if not hasattr(self.workflow, "run_fused"):
                     raise SystemExit(
                         f"--fused: {type(self.workflow).__name__} has no "
                         "fused step (StandardWorkflow-family only)")
-                self.workflow.run_fused(device=self.device,
-                                        accum_steps=self.accum, **kwargs)
+                self.workflow.run_fused(
+                    device=self.device, accum_steps=self.accum,
+                    nonfinite_guard=self.nonfinite_guard, **kwargs)
             else:
                 self.workflow.initialize(device=self.device, **kwargs)
                 self.workflow.run()
@@ -384,7 +414,17 @@ class Launcher(Logger):
             self.warning("interrupted; stopping workflow")
             self.workflow.stop()
             return 130
+        except NonFiniteLossError as e:
+            # distinct exit code: the Supervisor maps it to "roll back
+            # one snapshot before retrying" (the newest snapshot may
+            # already embed the divergence)
+            self.error("training aborted: %s (exit %d)", e,
+                       EXIT_NONFINITE)
+            self.workflow.stop()
+            return EXIT_NONFINITE
         finally:
+            for fn in installed_hooks:   # next run re-registers fresh
+                _rhooks.remove_epoch_hook(fn)
             if profiling:
                 import jax
                 jax.profiler.stop_trace()

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from veles_tpu._compat import axis_size as _axis_size
+
 NEG_INF = -1e30
 
 
@@ -87,7 +89,7 @@ def ring_attention(q, k, v, axis_name: str,
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, _ = q.shape
     if kv_block is None:
@@ -157,7 +159,7 @@ def ulysses_attention(q, k, v, axis_name: str,
     sharding for a head sharding, full-sequence attention runs on H/n
     local heads, and a second all_to_all restores the sequence sharding.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
 
     def seq_to_heads(x):  # (B, S/n, H, D) -> (B, S, H/n, D)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

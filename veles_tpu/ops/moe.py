@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from veles_tpu._compat import axis_size as _axis_size
+
 
 def router_probs(x, wr):
     """x: (N, D), wr: (D, E) -> (N, E) softmax router probabilities."""
@@ -84,7 +86,7 @@ def moe_forward_ep(x, wr, w1, b1, w2, b2, axis_name: str,
     a second `all_to_all` returns the results. This is the standard
     expert-parallel exchange, riding ICI.
     """
-    n_dev = lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     n_loc, d = x.shape
     e_total = wr.shape[1]
     e_loc = w1.shape[0]

@@ -27,6 +27,19 @@ import numpy as np
 _CKPTR = None
 
 
+class CheckpointGeometryError(RuntimeError):
+    """A checkpoint restore hit a geometry/pytree mismatch: the on-disk
+    state and the restore target disagree on leaves, shapes or dtypes —
+    e.g. restoring a TP=4 save into a TP=2 step, or a checkpoint from a
+    differently-shaped model. Carries the per-leaf diff (`mismatches`)
+    instead of a raw Orbax traceback, so the fix (rebuild the step with
+    the save-time geometry) is visible from the message alone."""
+
+    def __init__(self, message: str, mismatches=None) -> None:
+        super().__init__(message)
+        self.mismatches = list(mismatches or [])
+
+
 def _checkpointer():
     """One cached AsyncCheckpointer per process: constructing one per
     call leaks its background thread/barrier resources over long runs."""
@@ -111,9 +124,70 @@ def restore_state(step, directory: str) -> Dict[str, Any]:
         lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
         template, shardings)
     ckptr = _checkpointer()
-    state = ckptr.restore(path, target)
+    # geometry check BEFORE touching device memory: orbax's own restore
+    # does not reliably reject a mismatched target (observed: a narrower
+    # model restores garbage silently), and when it does object the
+    # traceback buries which leaf disagreed
+    err = _geometry_error(ckptr, path, target, None)
+    if err is not None:
+        raise err
+    try:
+        state = ckptr.restore(path, target)
+    except Exception as e:  # noqa: BLE001 — diagnose, then re-raise typed
+        raise (_geometry_error(ckptr, path, target, e) or e) from e
     state["key"] = jax.random.wrap_key_data(state["key"], impl=key_impl)
     return state
+
+
+def _leaf_index(tree) -> Dict[str, Any]:
+    """Flatten a pytree to {keypath: leaf} with orbax-style key strings
+    (shared diff basis for the saved metadata and the restore target)."""
+    import jax.tree_util as jtu
+    out = {}
+    for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
+        out["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)] = leaf
+    return out
+
+
+def _geometry_error(ckptr, path: str, target, cause):
+    """Diff the SAVED tree metadata against the restore target; returns
+    a CheckpointGeometryError naming every leaf that exists on only one
+    side or disagrees on shape/dtype — or None/`cause` when the trees
+    agree (the failure, if any, is something else) or the metadata is
+    unreadable (not a checkpoint at all: not a geometry problem)."""
+    try:
+        saved = _leaf_index(ckptr.metadata(path))
+    except Exception:  # noqa: BLE001 — no metadata: not a geometry issue
+        return cause
+    want = _leaf_index(target)
+    mismatches = []
+    for k in sorted(set(saved) | set(want)):
+        if k not in want:
+            mismatches.append(f"{k}: in checkpoint only "
+                              f"(saved {_describe(saved[k])})")
+        elif k not in saved:
+            mismatches.append(f"{k}: in restore target only "
+                              f"(want {_describe(want[k])})")
+        elif _describe(saved[k]) != _describe(want[k]):
+            mismatches.append(f"{k}: saved {_describe(saved[k])} != "
+                              f"target {_describe(want[k])}")
+    if not mismatches:
+        return cause    # trees agree: the failure is something else
+    head = mismatches[:12]
+    more = len(mismatches) - len(head)
+    detail = "\n  ".join(head) + (f"\n  … and {more} more" if more else "")
+    return CheckpointGeometryError(
+        f"checkpoint at {path} does not match the step's state geometry "
+        f"({len(mismatches)} mismatched leaves) — rebuild the step with "
+        f"the save-time layer/mesh configuration or point at the right "
+        f"checkpoint:\n  {detail}", mismatches)
+
+
+def _describe(leaf) -> str:
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = getattr(leaf, "dtype", None)
+    return f"{shape}/{dtype}"
 
 
 def _target_shardings(step, template):

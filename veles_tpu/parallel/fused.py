@@ -47,6 +47,8 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu._compat import shard_map
+
 from veles_tpu import prng
 from veles_tpu.ops import optim
 from veles_tpu.ops import xla as ox
@@ -491,11 +493,42 @@ class FusedTrainStep:
 
         (_, (loss, n_err)), grads = jax.value_and_grad(
             lf, has_aux=True)(state["params"])
+        grads = self._reduce_grads(grads, axes)
         if axes:
             # partials with a global denominator: SUM to the global metric
             loss = lax.psum(loss, axes)
             n_err = lax.psum(n_err, axes)
         return self._apply_update(state, grads), loss, n_err
+
+    def _reduce_grads(self, grads, axes):
+        """Pre-vma jax only (see _compat.GRAD_TRANSPOSE_PSUM): perform
+        the gradient all-reduce that vma-era autodiff would have placed
+        as the transpose of the replicated params' broadcast. Per leaf,
+        psum over the mapped axes the param's spec does NOT shard on —
+        replicated params reduce over all of `axes`, EP expert tensors
+        (sharded over the data axis) and seq-TP megatron shards keep
+        their axis local (their grads arrive via all_to_all/ppermute
+        transposes, which the old shard_map does differentiate
+        correctly). No-op on vma-era jax: the psum would double-count."""
+        from veles_tpu import _compat
+        if not axes or _compat.GRAD_TRANSPOSE_PSUM:
+            return grads
+        specs = (self._seq_param_specs() if self.mode == "seq"
+                 else self._smap_param_specs())
+        out = []
+        for g_layer, sp_layer in zip(grads, specs):
+            red = {}
+            for k, g in g_layer.items():
+                sharded = set()
+                for part in sp_layer.get(k, P()):
+                    if isinstance(part, str):
+                        sharded.add(part)
+                    elif part is not None:
+                        sharded.update(part)
+                missing = tuple(a for a in axes if a not in sharded)
+                red[k] = lax.psum(g, missing) if missing else g
+            out.append(red)
+        return tuple(out)
 
     def _apply_update(self, state, grads):
         """One optimizer step from already-reduced grads; advances the
@@ -555,6 +588,8 @@ class FusedTrainStep:
         zero_s = ws.reshape(-1)[0].astype(jnp.float32) * 0.0
         (grads, loss, n_err, _), _ = lax.scan(
             micro, (zero, zero_s, zero_s, jnp.int32(0)), (xs, ys, ws))
+        # one reduce over the accumulated sum == per-micro reduces summed
+        grads = self._reduce_grads(grads, axes)
         if axes:
             loss = lax.psum(loss, axes)
             n_err = lax.psum(n_err, axes)
@@ -643,13 +678,13 @@ class FusedTrainStep:
             mesh = self.mesh
             ssp = self._smap_state_spec()
             wsp = P(DATA_AXIS)
-            train = jax.shard_map(
+            train = shard_map(
                 lambda s, x, y, w: self._train_body(s, x, y, w,
                                                     axis=DATA_AXIS),
                 mesh=mesh,
                 in_specs=(ssp, P(DATA_AXIS), P(DATA_AXIS), wsp),
                 out_specs=(ssp, P(), P()))
-            evalf = jax.shard_map(
+            evalf = shard_map(
                 lambda p, x, y, w: self._eval_body(p, x, y, w,
                                                    axis=DATA_AXIS),
                 mesh=mesh,
@@ -658,17 +693,20 @@ class FusedTrainStep:
             self._train_fn = jax.jit(train, donate_argnums=donate)
             self._eval_fn = jax.jit(evalf)
         elif self.mode == "seq":
+            if self.mesh.shape.get(MODEL_AXIS, 1) > 1:
+                from veles_tpu._compat import warn_pre_vma_numerics
+                warn_pre_vma_numerics("seq x TP (3-axis) fused step")
             mesh = self.mesh
             axes = (DATA_AXIS, SEQ_AXIS)
             xspec = P(DATA_AXIS, SEQ_AXIS)  # (N, S, ...) batch x sequence
             wsp = P(DATA_AXIS)              # weights stay per-SAMPLE
             ssp = self._seq_state_spec()    # TP-sharded when model axis
-            train = jax.shard_map(
+            train = shard_map(
                 lambda s, x, y, w: self._train_body(s, x, y, w, axis=axes),
                 mesh=mesh,
                 in_specs=(ssp, xspec, xspec, wsp),
                 out_specs=(ssp, P(), P()))
-            evalf = jax.shard_map(
+            evalf = shard_map(
                 lambda p, x, y, w: self._eval_body(p, x, y, w, axis=axes),
                 mesh=mesh,
                 in_specs=(ssp["params"], xspec, xspec, wsp),
@@ -886,7 +924,7 @@ class FusedTrainStep:
                         else P(DATA_AXIS))
                 ssp = (self._smap_state_spec() if self.mode == "dp"
                        else self._seq_state_spec())
-                sm = jax.shard_map(
+                sm = shard_map(
                     rep, mesh=self.mesh,
                     in_specs=(ssp, spec, spec, P(DATA_AXIS)),
                     out_specs=(ssp, (P(), P())))
@@ -940,7 +978,7 @@ class FusedTrainStep:
                         if self.mode == "seq" else P(None, DATA_AXIS))
                 ssp = (self._smap_state_spec() if self.mode == "dp"
                        else self._seq_state_spec())
-                sm = jax.shard_map(
+                sm = shard_map(
                     acc, mesh=self.mesh,
                     in_specs=(ssp, spec, spec, P(None, DATA_AXIS)),
                     out_specs=(ssp, (P(), P())))
@@ -990,7 +1028,7 @@ class FusedTrainStep:
                 wspec = P(None, DATA_AXIS)
                 ssp = (self._smap_state_spec() if self.mode == "dp"
                        else self._seq_state_spec())
-                sm = jax.shard_map(
+                sm = shard_map(
                     many, mesh=self.mesh,
                     in_specs=(ssp, spec, spec, wspec),
                     out_specs=(ssp, (P(), P())))

@@ -43,8 +43,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
+
+from veles_tpu._compat import pcast, shard_map
+from veles_tpu._compat import axis_size as _axis_size
 
 STAGE_AXIS = "stage"
 
@@ -57,7 +61,7 @@ def pipeline_apply(stage_fn: Callable, params, xs, axis_name: str = STAGE_AXIS):
     - `stage_fn(params, x) -> y` with y.shape == x.shape.
     Returns (M, mb, D) outputs (valid on every device after the final
     psum-broadcast from the last stage)."""
-    s = lax.axis_size(axis_name)
+    s = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m, mb, d = xs.shape
     ticks = m + s - 1
@@ -81,9 +85,9 @@ def pipeline_apply(stage_fn: Callable, params, xs, axis_name: str = STAGE_AXIS):
     # the scan carry mixes with device-varying values (idx, params), so
     # it must start varying over the stage axis (shard_map vma typing;
     # pcast is the non-deprecated spelling of pvary)
-    act0 = lax.pcast(jnp.zeros((mb, d), xs.dtype), (axis_name,),
-                     to="varying")
-    out0 = lax.pcast(jnp.zeros_like(xs), (axis_name,), to="varying")
+    act0 = pcast(jnp.zeros((mb, d), xs.dtype), (axis_name,),
+                 to="varying")
+    out0 = pcast(jnp.zeros_like(xs), (axis_name,), to="varying")
     (act, outputs), _ = lax.scan(tick, (act0, out0),
                                  jnp.arange(ticks))
     # broadcast the last stage's outputs to every device (simple v1
@@ -104,7 +108,7 @@ def make_pipeline(mesh: Mesh, stage_fn: Callable,
         return pipeline_apply(stage_fn, local, xs, axis_name)
 
     pspec = P(axis_name)   # prefix spec: applies to every params leaf
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         inner, mesh=mesh, in_specs=(pspec, P()), out_specs=P()))
 
 
@@ -425,6 +429,8 @@ class PipelineTrainStep:
         return xs, y, w
 
     def _build(self) -> None:
+        from veles_tpu._compat import warn_pre_vma_numerics
+        warn_pre_vma_numerics("GPipe pipeline step")
         tabs = jnp.asarray(self._coef_tabs)   # (4, G): lr/mom/wd/l1
 
         def train_body(state, gid, xs, y, w):
@@ -453,11 +459,11 @@ class PipelineTrainStep:
 
         ssp = {"params": P(STAGE_AXIS), "vel": P(STAGE_AXIS),
                "key": P(), "lr_scale": P()}
-        self._train_fn = jax.jit(jax.shard_map(
+        self._train_fn = jax.jit(shard_map(
             train_body, mesh=self.mesh,
             in_specs=(ssp, P(STAGE_AXIS), P(), P(), P()),
             out_specs=(ssp, P(), P())))
-        self._eval_fn = jax.jit(jax.shard_map(
+        self._eval_fn = jax.jit(shard_map(
             eval_body, mesh=self.mesh,
             in_specs=(P(STAGE_AXIS), P(), P(), P()),
             out_specs=(P(), P())))

@@ -1,0 +1,42 @@
+"""Resilience layer: automated checkpoint-restart for the SPMD fault model.
+
+The documented fault story (SURVEY.md §5.3) is "restart from the last
+snapshot" — this package turns that from a manual procedure into code:
+
+- `supervisor.py` — a `Supervisor` that spawns/monitors training
+  process(es), detects death AND hangs (heartbeat file touched every
+  epoch), and restarts from `Snapshotter.latest` with a bounded retry
+  budget, exponential backoff + jitter and a no-progress cutoff.
+- `faults.py` — deterministic fault injection (`VELES_FAULT_PLAN`):
+  `kill@epoch=K`, `hang@epoch=K`, `nan@step=K`,
+  `corrupt_snapshot@write=K` — so every recovery path is testable on
+  CPU in CI, zero-cost when no plan is set.
+- `hooks.py` — the process-wide epoch hook registry the Decision unit
+  fires at each epoch boundary (heartbeats + epoch-keyed faults ride
+  it; deliberately OUTSIDE the pickled workflow graph so snapshots
+  never capture a closure).
+
+This module is import-light (no jax, no units): the supervisor process
+must never initialize an XLA backend its children will also use.
+"""
+
+from __future__ import annotations
+
+#: the fused step's non-finite-loss guard tripped: the model state is
+#: poisoned, so the supervisor rolls back ONE snapshot (the newest one
+#: may already embed the divergence) before retrying.
+EXIT_NONFINITE = 81
+
+#: the supervisor gave up: retry budget exhausted, or no epoch progress
+#: across consecutive restarts (restart-crash loop).
+EXIT_GIVEUP = 82
+
+#: a child was killed by the supervisor after its heartbeat went stale.
+EXIT_STALLED = 83
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised by the fused training loop's optional non-finite-loss
+    guard (``run_fused(nonfinite_guard=True)`` / ``--nonfinite-guard``).
+    The Launcher maps it to :data:`EXIT_NONFINITE` so a supervising
+    process can distinguish "diverged" from "crashed"."""

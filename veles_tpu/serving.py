@@ -10,6 +10,19 @@ Endpoints:
 - POST /predict    {"inputs": [[...], ...]}  ->  {"outputs": [[...]]}
   (softmax heads also return "classes": argmax per row)
 - GET  /info       model metadata (model_info()) (input shape, layer types, n_classes)
+- GET  /healthz    liveness/readiness: 200 + uptime/dispatch stats while
+  serving, 503 while draining (load balancers stop routing before the
+  listener actually closes)
+
+Robustness (resilience layer):
+- **Bounded admission**: at most `queue_limit` requests in flight; the
+  next one gets an immediate 503 `{"error": "overloaded"}` instead of
+  unbounded queuing (fail fast beats collapse under a traffic spike).
+- **Per-request timeout**: a queued request that misses
+  `request_timeout_s` is abandoned (the batcher skips it) and answered
+  503, so one stuck dispatch cannot pin client threads forever.
+- **Graceful drain**: `stop()` first refuses new work (503), lets
+  in-flight batches finish (bounded by `drain_s`), THEN closes.
 
 Throughput design (static shapes — the jit contract — without paying
 max_batch compute per tiny request):
@@ -30,6 +43,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -38,18 +52,37 @@ import numpy as np
 from veles_tpu.logger import Logger
 
 
+class ServerOverloaded(RuntimeError):
+    """queue_limit requests already in flight — shed, don't queue."""
+
+
+class ServerDraining(RuntimeError):
+    """stop() has begun: no new work is admitted."""
+
+
+class RequestTimeout(RuntimeError):
+    """A queued request missed request_timeout_s."""
+
+
 class InferenceServer(Logger):
     """Serve a trained workflow's forward pass over HTTP."""
 
     def __init__(self, workflow, host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 64,
-                 batch_window_ms: float = 2.0) -> None:
+                 batch_window_ms: float = 2.0,
+                 queue_limit: int = 64,
+                 request_timeout_s: float = 30.0) -> None:
         super().__init__()
         self.workflow = workflow
         self.host = host
         self.port = port
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
+        #: admission bound: requests in flight (queued or dispatching)
+        #: beyond this are answered 503 immediately
+        self.queue_limit = queue_limit
+        #: per-request deadline for queued work (0 = wait forever)
+        self.request_timeout_s = request_timeout_s
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()   # jit dispatch is thread-safe but
@@ -58,8 +91,14 @@ class InferenceServer(Logger):
         self._pending: List[dict] = []      # micro-batch accumulation
         self._batcher: Optional[threading.Thread] = None
         self._stopping = False
+        self._draining = False
+        self._inflight = 0
+        self._started_at = time.time()
         #: forward dispatches actually issued (tests assert coalescing)
         self.n_dispatches = 0
+        #: requests shed with 503 (overload + drain) / timed out
+        self.n_rejected = 0
+        self.n_timeouts = 0
         self._build()
 
     def _build(self) -> None:
@@ -115,10 +154,27 @@ class InferenceServer(Logger):
             raise ValueError(f"batch {len(x)} exceeds max_batch "
                              f"{self.max_batch}")
         n = len(x)
-        if self.batch_window_ms > 0 and self._batcher is not None:
-            out = self._predict_batched(x)
-        else:
-            out = self._forward_rows(x)
+        # bounded admission: reject at the door — a server melting down
+        # under a spike must shed load, not grow an unbounded queue
+        with self._cv:
+            if self._draining or self._stopping:
+                self.n_rejected += 1
+                raise ServerDraining("server draining")
+            if self._inflight >= self.queue_limit:
+                self.n_rejected += 1
+                raise ServerOverloaded(
+                    f"overloaded: {self._inflight} requests in flight "
+                    f"(queue_limit {self.queue_limit})")
+            self._inflight += 1
+        try:
+            if self.batch_window_ms > 0 and self._batcher is not None:
+                out = self._predict_batched(x)
+            else:
+                out = self._forward_rows(x)
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()   # drain waiters watch this count
         out = out.reshape(n, -1)
         resp: Dict[str, Any] = {"outputs": out.tolist()}
         if self._softmax:
@@ -128,7 +184,7 @@ class InferenceServer(Logger):
     # -- micro-batching --------------------------------------------------------
 
     def _predict_batched(self, x: np.ndarray) -> np.ndarray:
-        item = {"x": x, "out": None, "err": None,
+        item = {"x": x, "out": None, "err": None, "abandoned": False,
                 "done": threading.Event()}
         with self._cv:
             # re-check under the lock: a batcher that already drained and
@@ -137,7 +193,25 @@ class InferenceServer(Logger):
                 raise RuntimeError("server stopping")
             self._pending.append(item)
             self._cv.notify()
-        item["done"].wait()
+        timeout = self.request_timeout_s or None
+        if not item["done"].wait(timeout):
+            # deadline missed: mark abandoned so the batcher drops it if
+            # still queued (already-dispatched rows compute but nobody
+            # reads them), and answer the client NOW. Re-check done
+            # under the lock first: a dispatch completing in the gap
+            # between the wait timing out and the lock acquisition has
+            # a full result — return it rather than 503 finished work.
+            with self._cv:
+                if not item["done"].is_set():
+                    item["abandoned"] = True
+                    try:
+                        self._pending.remove(item)
+                    except ValueError:
+                        pass    # already taken by the batcher
+                    self.n_timeouts += 1
+                    raise RequestTimeout(
+                        f"request timed out after {timeout:.1f}s in "
+                        f"queue")
         if item["err"] is not None:
             raise item["err"]
         return item["out"]
@@ -171,6 +245,8 @@ class InferenceServer(Logger):
                 take, rows = [], 0
                 rest = []
                 for it in self._pending:
+                    if it.get("abandoned"):
+                        continue    # timed out while queued: drop
                     if rows + len(it["x"]) <= self.max_batch:
                         take.append(it)
                         rows += len(it["x"])
@@ -193,6 +269,22 @@ class InferenceServer(Logger):
                     it["err"] = e
             for it in take:
                 it["done"].set()
+
+    def health(self) -> Dict[str, Any]:
+        """/healthz payload: liveness + the dispatch counters an
+        operator needs to see a batching/overload problem at a glance."""
+        with self._cv:
+            status = "draining" if (self._draining or self._stopping) \
+                else "ok"
+            return {"status": status,
+                    "uptime_s": round(time.time() - self._started_at, 3),
+                    "inflight": self._inflight,
+                    "pending": len(self._pending),
+                    "n_dispatches": self.n_dispatches,
+                    "n_rejected": self.n_rejected,
+                    "n_timeouts": self.n_timeouts,
+                    "queue_limit": self.queue_limit,
+                    "max_batch": self.max_batch}
 
     def model_info(self) -> Dict[str, Any]:
         wf = self.workflow
@@ -220,7 +312,13 @@ class InferenceServer(Logger):
                 self.wfile.write(body)
 
             def do_GET(self) -> None:  # noqa: N802
-                if self.path.startswith("/info"):
+                if self.path.startswith("/healthz"):
+                    payload = srv.health()
+                    # 503 while draining: balancers stop routing here
+                    # BEFORE the listener closes
+                    self._send(200 if payload["status"] == "ok" else 503,
+                               payload)
+                elif self.path.startswith("/info"):
                     self._send(200, srv.model_info())
                 else:
                     self._send(404, {"error": "unknown endpoint"})
@@ -237,8 +335,9 @@ class InferenceServer(Logger):
                     self._send(400, {"error": str(e)[:300]})
                     return
                 except RuntimeError as e:
-                    # batcher failing in-flight waiters at stop(): a
-                    # clean 503, not a dropped connection
+                    # overload / drain / timeout / batcher stop: a clean
+                    # 503 the client can retry against another replica,
+                    # not a dropped connection or an unbounded wait
                     self._send(503, {"error": str(e)[:300]})
                     return
                 self._send(200, resp)
@@ -248,6 +347,8 @@ class InferenceServer(Logger):
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        self._draining = False      # restart after a drained stop()
+        self._started_at = time.time()
         if self.batch_window_ms > 0:
             if self._batcher is not None and not self._batcher.is_alive():
                 # a previous stop() timed out its join but the thread has
@@ -265,7 +366,20 @@ class InferenceServer(Logger):
         self.info("inference %s (POST /predict, GET /info)", self.info_log)
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Graceful shutdown: refuse new requests (503), let in-flight
+        batches finish (bounded by `drain_s`), then close the listener
+        and stop the batcher. `drain_s=0` is the old hard stop."""
+        with self._cv:
+            self._draining = True
+            deadline = time.time() + drain_s
+            while self._inflight > 0 and drain_s > 0:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    self.warning("drain timed out with %d request(s) "
+                                 "in flight", self._inflight)
+                    break
+                self._cv.wait(remaining)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -27,11 +27,13 @@ from __future__ import annotations
 
 import bz2
 import gzip
+import hashlib
+import logging
 import lzma
 import os
 import pickle
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 from veles_tpu.distributable import IDistributable
 from veles_tpu.units import Unit
@@ -51,6 +53,36 @@ def _open_codec(compression: str):
     except KeyError:
         raise ValueError(
             f"unknown compression {compression!r}; one of {sorted(_CODECS)}")
+
+
+def _opener_for_magic(head: bytes):
+    """Codec opener sniffed from a file's first bytes (renamed files
+    still load; shared by import_ and integrity verification)."""
+    if head[:2] == b"\x1f\x8b":
+        return gzip.open
+    if head[:3] == b"BZh":
+        return bz2.open
+    if head[:6] == b"\xfd7zXZ\x00":
+        return lzma.open
+    return open
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
 
 
 class SnapshotterBase(Unit, IDistributable):
@@ -125,6 +157,10 @@ class SnapshotterBase(Unit, IDistributable):
             return      # worker process: bookkeeping only, no file
         self.destination = self.export()
         self.info("snapshot -> %s", self.destination)
+        from veles_tpu.resilience.faults import active_plan
+        plan = active_plan()
+        if plan is not None:    # deterministic torn-write injection
+            plan.maybe_corrupt_snapshot(self.destination)
         if self.upload_url:
             try:
                 self._upload(self.destination)
@@ -135,10 +171,11 @@ class SnapshotterBase(Unit, IDistributable):
         if self.keep_last:
             while len(self._written) > self.keep_last:
                 stale = self._written.pop(0)
-                try:
-                    os.remove(stale)
-                except OSError:
-                    pass
+                for victim in (stale, stale + ".sha256"):
+                    try:
+                        os.remove(victim)
+                    except OSError:
+                        pass
 
     def export(self) -> str:
         raise NotImplementedError
@@ -191,32 +228,106 @@ class Snapshotter(SnapshotterBase):
         # never try to pickle ourselves mid-write via the workflow's
         # unit list: Snapshotter state is tiny and picklable, so no
         # special-casing needed — but a half-written file must not be
-        # importable, hence write-to-temp + atomic rename.
+        # importable, hence write-to-temp + fsync + atomic rename, with
+        # a sha256 sidecar published AFTER the data rename: every crash
+        # window leaves either no new file, or intact data without a
+        # sidecar (verify() then falls back to the codec stream check) —
+        # never a fresh digest beside stale data or vice versa. The
+        # pre-existing sidecar (same stamp from an earlier run) is
+        # removed first for the same reason.
         tmp = path + ".tmp"
         with opener(tmp, "wb") as f:
             pickle.dump({"__veles_snapshot__": 2, "workflow": wf,
                          "prng": prng.snapshot_registry()}, f,
                         protocol=pickle.HIGHEST_PROTOCOL)
+        digest = _sha256_file(tmp)
+        _fsync_path(tmp)
+        try:
+            os.remove(path + ".sha256")
+        except OSError:
+            pass
         os.replace(tmp, path)
+        sidecar_tmp = path + ".sha256.tmp"
+        with open(sidecar_tmp, "w") as f:
+            f.write(f"{digest}  {os.path.basename(path)}\n")
+        _fsync_path(sidecar_tmp)
+        os.replace(sidecar_tmp, path + ".sha256")
+        # rename durability: fsync the directory or a power cut can
+        # resurrect the pre-rename state
+        try:
+            _fsync_path(self.directory or ".")
+        except OSError:
+            pass    # non-fsyncable directory (network fs): best effort
         return path
 
     @staticmethod
-    def latest(directory: str, prefix: str = "") -> Optional[str]:
-        """Newest snapshot file in `directory` (restart-from-snapshot
-        recovery, SURVEY.md §5.3: the SPMD fault model is resume, not
-        mid-step elasticity)."""
+    def verify(path: str) -> bool:
+        """Integrity check for one snapshot file. With a `.sha256`
+        sidecar (everything written since sidecars existed) the check is
+        a digest comparison; legacy files fall back to streaming the
+        compression codec to EOF, which catches truncation for gz/bz2/xz
+        (raw pickles predate the hardening and pass by default)."""
+        sidecar = path + ".sha256"
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar) as f:
+                    expected = f.read().split()[0]
+            except (OSError, IndexError):
+                return False
+            try:
+                return _sha256_file(path) == expected
+            except OSError:
+                return False
+        try:
+            with open(path, "rb") as f:
+                head = f.read(6)
+            opener = _opener_for_magic(head)
+            if opener is open:
+                return True     # uncompressed legacy: no cheap check
+            with opener(path, "rb") as f:
+                while f.read(1 << 20):
+                    pass
+            return True
+        except Exception:       # noqa: BLE001 — any decode error = bad
+            return False
+
+    @staticmethod
+    def latest(directory: str, prefix: str = "", verify: bool = True,
+               skip: int = 0) -> Optional[str]:
+        """Newest VALID snapshot file in `directory` (restart-from-
+        snapshot recovery, SURVEY.md §5.3: the SPMD fault model is
+        resume, not mid-step elasticity). Corrupt/partial files — bad
+        sha256, truncated stream — are skipped with a warning naming the
+        fallback. `skip=N` returns the (N+1)-th newest valid snapshot
+        (the supervisor's roll-back-one on a non-finite abort)."""
+        log = logging.getLogger("veles.Snapshotter")
         try:
             # exclude in-flight ".tmp" files: a crash mid-export leaves a
             # truncated newest-mtime .tmp that would poison the resume
             names = [n for n in os.listdir(directory)
                      if ".pickle" in n and n.startswith(prefix)
-                     and not n.endswith(".tmp")]
+                     and not n.endswith(".tmp")
+                     and not n.endswith(".sha256")]
         except FileNotFoundError:
             return None
-        if not names:
+        paths = sorted((os.path.join(directory, n) for n in names),
+                       key=os.path.getmtime, reverse=True)
+        valid: List[str] = []
+        rejected = None
+        for p in paths:
+            if verify and not Snapshotter.verify(p):
+                log.warning("snapshot %s failed integrity check — "
+                            "skipping", p)
+                rejected = rejected or p
+                continue
+            valid.append(p)
+            if len(valid) > skip:
+                break
+        if len(valid) <= skip:
             return None
-        paths = [os.path.join(directory, n) for n in names]
-        return max(paths, key=os.path.getmtime)
+        if rejected is not None or skip:
+            log.warning("falling back to %s", valid[skip])
+        return valid[skip]
 
     @staticmethod
     def import_(path: str):
@@ -224,14 +335,7 @@ class Snapshotter(SnapshotterBase):
         sniffed by magic bytes, so renamed files still load)."""
         with open(path, "rb") as f:
             head = f.read(6)
-        if head[:2] == b"\x1f\x8b":
-            opener = gzip.open
-        elif head[:3] == b"BZh":
-            opener = bz2.open
-        elif head[:6] == b"\xfd7zXZ\x00":
-            opener = lzma.open
-        else:
-            opener = open
+        opener = _opener_for_magic(head)
         with opener(path, "rb") as f:
             obj = pickle.load(f)
         if isinstance(obj, dict) and "__veles_snapshot__" in obj:

@@ -18,6 +18,7 @@ from typing import Any, Optional
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
 from veles_tpu.mutable import Bool
+from veles_tpu.resilience.hooks import fire_epoch
 
 
 class DecisionBase(AcceleratedUnit):
@@ -48,6 +49,9 @@ class DecisionEpochs(DecisionBase):
             if (self.max_epochs is not None
                     and self.epoch_number >= self.max_epochs):
                 self.complete <<= True
+            # process-level epoch boundary: heartbeats + epoch-keyed
+            # fault injection (resilience.hooks; no-op when empty)
+            fire_epoch(self.epoch_number)
 
 
 class DecisionGD(DecisionBase):
@@ -112,3 +116,6 @@ class DecisionGD(DecisionBase):
                     or self._epochs_since_improvement
                     >= self.fail_iterations):
                 self.complete <<= True
+            # process-level epoch boundary: heartbeats + epoch-keyed
+            # fault injection (resilience.hooks; no-op when empty)
+            fire_epoch(self.epoch_number)

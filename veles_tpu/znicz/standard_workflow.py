@@ -292,7 +292,8 @@ class StandardWorkflow(Workflow):
     def run_fused(self, epochs: Optional[int] = None, device=None,
                   mesh=None, mode: str = "auto", compute_dtype=None,
                   ep: bool = False,
-                  accum_steps: Optional[int] = None) -> None:
+                  accum_steps: Optional[int] = None,
+                  nonfinite_guard: bool = False) -> None:
         """Train with the fused step while keeping the graph semantics:
         the real Loader drives minibatches and the real Decision unit does
         the epoch/stop bookkeeping (so snapshot gating, best-error tracking
@@ -301,18 +302,26 @@ class StandardWorkflow(Workflow):
         `accum_steps=K` computes each minibatch's gradient as K scanned
         microbatches before the single update (train_accum) — activation
         memory O(minibatch/K), numerics equal to the plain step (the
-        reference's gradient_accumulation slot, SURVEY.md §2.8)."""
+        reference's gradient_accumulation slot, SURVEY.md §2.8).
+
+        `nonfinite_guard=True` aborts with NonFiniteLossError the moment
+        a class pass's loss goes NaN/inf — checked only at the class-pass
+        boundary where the loss is already host-synced, so the guard adds
+        no device syncs (resilience layer; the Launcher maps the error to
+        a distinct exit code the Supervisor rolls back a snapshot on)."""
         if epochs is not None:
             self.decision.max_epochs = epochs
         if not self.is_initialized:
             self.initialize(device=device)
         step = self.build_fused_step(mesh=mesh, mode=mode,
                                      compute_dtype=compute_dtype, ep=ep)
-        self._run_with_step(step, accum_steps=accum_steps)
+        self._run_with_step(step, accum_steps=accum_steps,
+                            nonfinite_guard=nonfinite_guard)
 
     def run_pipelined(self, mesh=None, n_microbatches: int = 4,
                       epochs: Optional[int] = None, device=None,
-                      boundaries=None, compute_dtype=None) -> None:
+                      boundaries=None, compute_dtype=None,
+                      nonfinite_guard: bool = False) -> None:
         """Train as a GPipe pipeline over `mesh`'s "stage" axis (default:
         one stage per device) with the same Loader/Decision/Snapshotter
         semantics as run_fused. The CLI exposes this as `--pp M`
@@ -331,9 +340,10 @@ class StandardWorkflow(Workflow):
         step = self.build_pipeline_step(mesh, n_microbatches,
                                         boundaries=boundaries,
                                         compute_dtype=compute_dtype)
-        self._run_with_step(step)
+        self._run_with_step(step, nonfinite_guard=nonfinite_guard)
 
-    def _run_with_step(self, step, accum_steps: Optional[int] = None) -> None:
+    def _run_with_step(self, step, accum_steps: Optional[int] = None,
+                       nonfinite_guard: bool = False) -> None:
         """Drive any train/evaluate/write_back step object through the
         Loader + Decision bookkeeping (shared by run_fused /
         run_pipelined)."""
@@ -352,6 +362,8 @@ class StandardWorkflow(Workflow):
                 mesh=getattr(base, "mesh", None))
         from veles_tpu.config import root as _root
         from veles_tpu.loader.base import TRAIN
+        from veles_tpu.resilience.faults import active_plan
+        fault_plan = active_plan()   # None in production: zero per-step cost
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
         # the fused step uploads (sharded) itself; the loader's granular-path
@@ -382,6 +394,8 @@ class StandardWorkflow(Workflow):
                 w = loader.minibatch_valid.mem  # pad mask: exact metrics
                 if loader.minibatch_class == TRAIN:
                     state, (loss, n_err) = step.train(state, x, y, w)
+                    if fault_plan is not None and fault_plan.nan_at_step():
+                        loss = float("nan")   # deterministic divergence
                 else:
                     loss, n_err = step.evaluate(state, x, y, w)
                     # fused-mode confusion accumulation (the granular
@@ -416,6 +430,16 @@ class StandardWorkflow(Workflow):
                     # value here (zeros in between) preserves its
                     # semantics.
                     ev.loss = float(acc_loss) / max(acc_w, 1.0)
+                    if nonfinite_guard and not np.isfinite(ev.loss):
+                        # raised BEFORE dec.run()/the snapshot branch: a
+                        # poisoned state must never be snapshotted. The
+                        # check rides the boundary's existing host sync,
+                        # so the guard costs no extra device round-trips.
+                        from veles_tpu.resilience import NonFiniteLossError
+                        raise NonFiniteLossError(
+                            f"non-finite loss {ev.loss!r} at epoch "
+                            f"{dec.epoch_number} (class "
+                            f"{int(loader.minibatch_class)} pass)")
                     ev.n_err = (int(acc_err) if self.loss == "softmax"
                                 else float(acc_err))
                     if acc_conf is not None:
