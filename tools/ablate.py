@@ -6,6 +6,10 @@ Usage (on a machine with the TPU visible):
 Each variant builds the AlexNet fused train step with a layer family
 removed and reports samples/s via train_repeat — the deltas attribute
 step time to layer families (the measurement behind ROOFLINE.md).
+Lowering-choice variants (s2d-stem, slicepool) are thin wrappers over
+the ops.variants registry now — `tools/autotune.py` measures the same
+candidates systematically and persists the winner; this script remains
+for layer-family REMOVAL attribution, which the registry can't express.
 Do NOT enable the persistent compilation cache here (hangs on the axon
 backend — see the r3 session notes)."""
 
@@ -59,15 +63,18 @@ def measure(layers, name: str) -> float:
 
 
 def variant(name: str):
+    """Layer list + registry selections for one ablation variant. EVERY
+    variant derives from `full`, which pins the registry to the r3
+    lowering table (direct stem, reduce_window pooling), so the
+    layer-family deltas stay internally consistent against the
+    documented r3 baseline (MEASURED.json "full_r3_lowering") and a
+    removal delta never conflates with a lowering rewrite; "s2d-stem"
+    and "slicepool" are the variants that flip ONE registry entry."""
+    from veles_tpu.ops import variants
     from veles_tpu.samples.alexnet import alexnet_layers
-    # Conv's s2d default flipped to "auto" in r4 (it won the A/B below).
-    # EVERY variant here pins s2d OFF (they all derive from `full`), so
-    # the table stays internally consistent against the documented r3
-    # baseline (MEASURED.json "full_r3_lowering") and a layer-family
-    # delta never conflates with the stem rewrite; "s2d-stem" is the one
-    # variant that turns the rewrite on.
-    full = [dict(l, s2d="off") if l["type"].startswith("conv") else l
-            for l in alexnet_layers(64, 1.0, 4096)]
+    variants.select("conv_stem", "direct")
+    variants.select("maxpool", "reduce_window")
+    full = list(alexnet_layers(64, 1.0, 4096))
     if name == "full":
         return full
     if name == "no-LRN":
@@ -76,12 +83,9 @@ def variant(name: str):
         return [l for l in full if l["type"] != "dropout"]
     if name == "s2d-stem":
         # the space-to-depth entry-conv rewrite (exact numerics; WON its
-        # on-chip A/B 8,656 -> 9,377 in r4 -> now the Conv default)
-        out = [dict(l) for l in full]
-        for l in out:
-            if l["type"].startswith("conv"):
-                l["s2d"] = "auto"
-        return out
+        # on-chip A/B 8,656 -> 9,377 in r4 -> now the registry default)
+        variants.select("conv_stem", "s2d")
+        return full
     if name == "avgpool":
         # same geometry, max→avg: bounds the cost of maxpool's backward
         # (XLA lowers it to select-and-scatter; avg is reduce+broadcast).
@@ -95,10 +99,8 @@ def variant(name: str):
     if name == "slicepool":
         # maxpool lowered as a max-fold over shifted strided slices:
         # backward = selects + pads instead of select_and_scatter
-        out = [dict(l, lowering="slices")
-               if l["type"] == "max_pooling" else l for l in full]
-        assert any(l.get("lowering") == "slices" for l in out)
-        return out
+        variants.select("maxpool", "slices")
+        return full
     if name == "no-bigFC":
         return [l for l in full
                 if not l["type"].startswith("all2all")

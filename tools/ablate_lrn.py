@@ -1,9 +1,15 @@
-"""On-chip A/B/C: fused AlexNet step with
-  A. the XLA banded-matmul LRN, backward recomputing s/d from x;
-  B. the same lowering with the forward's d and s CACHED as residuals
-     (bwd: one window dot, zero pow — ROOFLINE.md r4 attack);
-  C. the Pallas one-pass LRN (ops.pallas_kernels.lrn_pallas after the
-     r4 rewrite: native-dtype HBM I/O, sqrt/rsqrt pow, static scalars).
+"""On-chip A/B/C over the LRN lowering-variant registry (ops.variants):
+  banded_matmul    — XLA banded-matmul window sum, bwd recomputes s/d;
+  cached_residual  — same lowering, forward d and s CACHED as residuals
+                     (bwd: one window dot, zero pow — ROOFLINE.md r4);
+  pallas_one_pass  — the Pallas one-pass LRN (native-dtype HBM I/O,
+                     sqrt/rsqrt pow, static scalars).
+
+Thin wrapper over the registry: each measurement is one
+`variants.select("lrn", <name>)` + the shared fused-step microbench.
+`tools/autotune.py` supersedes this for routine tuning (it times the
+same candidates AND persists the winner); this script remains for
+printing the explicit three-way ratio on a chip.
 
 Usage: python tools/ablate_lrn.py [batch]
 """
@@ -21,19 +27,17 @@ BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 K = 8
 
 
-def measure(name: str, prefer_pallas: bool,
-            cache_bwd: bool = False) -> float:
+def measure(variant_name: str) -> float:
     import jax
     import jax.numpy as jnp
 
     from veles_tpu import prng
     from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.ops import variants
     from veles_tpu.samples.alexnet import alexnet_layers
-    from veles_tpu.znicz.normalization import LRNormalizerForward
     from veles_tpu.znicz.standard_workflow import StandardWorkflow
 
-    LRNormalizerForward.prefer_pallas = prefer_pallas
-    LRNormalizerForward.cache_bwd = cache_bwd
+    variants.select("lrn", variant_name)
     prng.seed_all(1)
     loader = SyntheticClassifierLoader(
         n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
@@ -43,9 +47,11 @@ def measure(name: str, prefer_pallas: bool,
         n_classes=64,
         decision_config={"max_epochs": 1, "fail_iterations": 9},
         gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
-        name=name)
+        name=variant_name)
     wf.initialize(device=None)
     step = wf.build_fused_step(compute_dtype="bfloat16")
+    assert step.variant_table().get("lrn") == variant_name, \
+        "selection did not reach the step (pallas unavailable?)"
     state = step.init_state()
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     x = jax.jit(lambda k: jax.random.normal(
@@ -60,17 +66,17 @@ def measure(name: str, prefer_pallas: bool,
         np.asarray(state["params"][-1]["bias"][:1])
         best = min(best, time.perf_counter() - t0)
     rate = BATCH * K / best
-    print(f"ABLATE {name}: {rate:.0f} samples/s", flush=True)
+    print(f"ABLATE lrn={variant_name}: {rate:.0f} samples/s", flush=True)
     return rate
 
 
 if __name__ == "__main__":
     from veles_tpu.ops import pallas_kernels as pk
     assert pk.available(), (
-        "no TPU visible: prefer_pallas would silently fall back to the "
-        "XLA path and the A/B would compare XLA against itself")
-    a = measure("xla-lrn", False)
-    c = measure("xla-lrn-cached-bwd", False, cache_bwd=True)
-    b = measure("pallas-lrn", True)
+        "no TPU visible: the pallas_one_pass variant would resolve to "
+        "its XLA fallback and the A/B would compare XLA against itself")
+    a = measure("banded_matmul")
+    c = measure("cached_residual")
+    b = measure("pallas_one_pass")
     print(f"cached/xla = {c / a:.3f}  pallas/xla = {b / a:.3f}",
           flush=True)

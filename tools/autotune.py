@@ -1,0 +1,109 @@
+"""Autotune the lowering-variant registry for the flagship AlexNet step.
+
+The systematic replacement for the hand-flipped one-offs (tools/ablate.py
+variant flags, tools/ablate_lrn.py): every tunable op the step contains
+(LRN fwd/bwd lowering, max-pooling backward shape, s2d stem, dropout RNG)
+is timed candidate-by-candidate in-graph — the same donated train_repeat
+protocol bench.py measures — and the winner is selected AND persisted in
+the on-disk decision cache, so the next run (bench, training, a second
+autotune) is a pure cache hit. See docs/AUTOTUNE.md.
+
+Usage (TPU, full geometry):
+    python tools/autotune.py
+CPU smoke (tiny geometry, Pallas candidates in interpret mode):
+    JAX_PLATFORMS=cpu python tools/autotune.py
+
+The last stdout line is one JSON record: chosen variant per op, timings
+for freshly tuned ops, and the cache path.
+Do NOT enable the persistent XLA compilation cache here (hangs on the
+axon backend — r3 session notes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch", type=int, default=None,
+                   help="microbench batch (default: 512 on TPU, 8 on CPU)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="train_repeat steps per timing window "
+                        "(default: 8 on TPU, 2 on CPU)")
+    p.add_argument("--repeats", type=int, default=2,
+                   help="timed windows per candidate (min wins)")
+    p.add_argument("--width", type=float, default=None,
+                   help="AlexNet width multiplier (default: 1.0 on TPU, "
+                        "0.125 on CPU)")
+    p.add_argument("--hw", type=int, default=None,
+                   help="input resolution (default: 227 on TPU, 67 on CPU)")
+    p.add_argument("--cache", default=None, metavar="PATH",
+                   help="decision cache path (default: "
+                        "$VELES_AUTOTUNE_CACHE or "
+                        "~/.cache/veles_tpu/autotune.json)")
+    p.add_argument("--ops", default="", metavar="OP[,OP...]",
+                   help="restrict tuning to these ops (default: all)")
+    p.add_argument("--force", action="store_true",
+                   help="re-time even on a cache hit")
+    args = p.parse_args(argv)
+
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        # the baked sitecustomize pins the axon TPU platform via
+        # jax.config, which outranks the env var — honor it here so CPU
+        # smoke runs work (same dance as bench.py)
+        jax.config.update("jax_platforms", plat)
+    on_cpu = jax.default_backend() == "cpu"
+
+    from veles_tpu import prng
+    from veles_tpu.ops import variants
+    from veles_tpu.ops.autotune import AutotuneCache, autotune_workflow
+    from veles_tpu.samples.alexnet import create_workflow
+
+    batch = args.batch or (8 if on_cpu else 512)
+    steps = args.steps or (2 if on_cpu else 8)
+    width = args.width if args.width is not None \
+        else (0.125 if on_cpu else 1.0)
+    hw = args.hw or (67 if on_cpu else 227)
+    kw = {}
+    if width != 1.0:
+        kw = dict(width_mult=width, fc_width=int(4096 * width) or 64,
+                  input_hw=hw)
+    elif hw != 227:
+        kw = dict(input_hw=hw)
+    prng.seed_all(1234)
+    wf = create_workflow(minibatch_size=batch, n_train=2 * batch,
+                         n_validation=batch, **kw)
+    wf.initialize(device=None)
+    cache = AutotuneCache(args.cache)
+    report = autotune_workflow(
+        wf, steps=steps, repeats=args.repeats, batch=batch, cache=cache,
+        force=args.force, compute_dtype=None if on_cpu else "bfloat16",
+        ops=[o for o in args.ops.split(",") if o] or None)
+    for op, rec in sorted(report.items()):
+        line = f"AUTOTUNE {op}: {rec['variant']} ({rec['source']})"
+        if rec.get("timings_s"):
+            line += "  " + "  ".join(
+                f"{k}={v if isinstance(v, str) else f'{v * 1e3:.2f}ms'}"
+                for k, v in sorted(rec["timings_s"].items()))
+        print(line, flush=True)
+    print(json.dumps({
+        "autotune": report,
+        "variants": variants.selection_table(include_defaults=True),
+        "device_kind": jax.devices()[0].device_kind,
+        "batch": batch,
+        "cache": cache.path,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused", action="store_true",
                    help="train via the fused one-dispatch-per-minibatch "
                         "XLA step instead of the granular unit graph")
+    p.add_argument("--autotune", action="store_true",
+                   help="before training, time every registered lowering "
+                        "variant of the workflow's tunable ops (LRN, "
+                        "pooling backward, s2d stem, dropout RNG) via a "
+                        "short fused microbench and train with the "
+                        "winners; decisions persist in the on-disk "
+                        "autotune cache, so reruns are pure cache hits "
+                        "(docs/AUTOTUNE.md)")
     p.add_argument("--tp", type=int, default=None, metavar="K",
                    help="tensor-parallel degree for distributed runs: "
                         "global mesh (data x model=K), megatron gspmd "
@@ -280,7 +288,8 @@ def main(argv=None) -> int:
         device=device, stats=not args.no_stats,
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
-        fused=args.fused, manhole=args.manhole, pp=args.pp,
+        fused=args.fused, autotune=args.autotune,
+        manhole=args.manhole, pp=args.pp,
         serve=args.serve, accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,
         compile_cache=not args.no_compile_cache,

@@ -45,11 +45,18 @@ class Ensemble(Logger):
 
     def __init__(self, factory: Callable[[int], Any],
                  seeds: Sequence[int] = (1, 2, 3),
-                 max_workers: Optional[int] = None) -> None:
+                 max_workers: Optional[int] = None,
+                 queue_timeout_s: float = 8 * 3600.0) -> None:
         super().__init__()
         self.factory = factory
         self.seeds = list(seeds)
         self.max_workers = max_workers
+        #: finite cluster-training deadline: a wedged worker renewing a
+        #: member's lease while hung must surface as a TimeoutError, not
+        #: block train() forever (ADVICE r5; the queue server also caps
+        #: renewals per lease). Members are full training runs — the
+        #: default is generous but FINITE.
+        self.queue_timeout_s = queue_timeout_s
         self.members: List[Any] = []
 
     def train(self, parallel: bool = False,
@@ -69,7 +76,8 @@ class Ensemble(Logger):
             self.info("training %d members over the cluster queue",
                       len(self.seeds))
             results = queue_server.submit(
-                [{"seed": s} for s in self.seeds], with_artifacts=True)
+                [{"seed": s} for s in self.seeds], with_artifacts=True,
+                timeout_s=self.queue_timeout_s)
             members = []
             for s, (_fitness, artifact) in zip(self.seeds, results):
                 if not artifact:

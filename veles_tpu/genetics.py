@@ -65,10 +65,16 @@ class Population(Logger):
                  mutation_scale: float = 0.2,
                  max_workers: int = 1,
                  queue_server: Any = None,
+                 queue_timeout_s: float = 4 * 3600.0,
                  rng_name: str = "genetics") -> None:
         super().__init__()
         self.tunables = list(tunables)
         self.fitness_fn = fitness_fn
+        #: finite cluster-evaluation deadline per generation: a wedged
+        #: worker (renewing its lease while hung) must surface as a
+        #: TimeoutError, not block the GA forever (ADVICE r5; the server
+        #: additionally caps renewals per lease)
+        self.queue_timeout_s = queue_timeout_s
         self.size = size
         self.elite = elite
         self.mutation_rate = mutation_rate
@@ -124,7 +130,8 @@ class Population(Logger):
             return
         if self.queue_server is not None:
             fitnesses = self.queue_server.submit(
-                [m.overrides(self.tunables) for m in todo])
+                [m.overrides(self.tunables) for m in todo],
+                timeout_s=self.queue_timeout_s)
             for m, f in zip(todo, fitnesses):
                 m.fitness = float(f)
         elif self.max_workers > 1:

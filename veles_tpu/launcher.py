@@ -35,7 +35,8 @@ class Launcher(Logger):
                  device: Any = None, stats: bool = True,
                  web_status: bool = False, web_port: int = 8090,
                  profile_dir: str = "", debug_nans: bool = False,
-                 fused: bool = False, manhole: Optional[int] = None,
+                 fused: bool = False, autotune: bool = False,
+                 manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
                  accum: Optional[int] = None, report: str = "",
                  tp: Optional[int] = None, sp: Optional[int] = None,
@@ -52,6 +53,27 @@ class Launcher(Logger):
         #: run via the one-dispatch-per-minibatch fused XLA step instead
         #: of the granular unit graph (same Decision/Snapshotter behavior)
         self.fused = fused
+        #: time every registered lowering variant of the workflow's
+        #: tunable ops before training and train with the winners
+        #: (ops.autotune; decisions persist in the on-disk cache)
+        if autotune and serve is not None:
+            raise SystemExit("--autotune tunes a training step; it "
+                             "conflicts with --serve")
+        if autotune and (listen or master):
+            # per-process timing noise could elect DIFFERENT winners on
+            # different processes -> diverged SPMD programs -> deadlock.
+            raise SystemExit(
+                "--autotune is single-process: tune standalone first "
+                "(tools/autotune.py), then run distributed with "
+                "VELES_AUTOTUNE_CACHE pointing every process at the "
+                "SAME cache file to inherit the decisions")
+        if autotune and not (fused or pp):
+            # the granular per-unit graph (xla_init paths) does not
+            # consult the variants registry: tuning would burn minutes
+            # and then be ignored by the run
+            raise SystemExit("--autotune tunes the fused-step lowerings: "
+                             "combine with --fused or --pp")
+        self.autotune = autotune
         #: serve-only mode: skip training, expose the (typically
         #: snapshot-restored) model over HTTP on this port (0 = auto)
         if serve is not None and (pp or fused or listen or master):
@@ -319,6 +341,32 @@ class Launcher(Logger):
                 except KeyboardInterrupt:
                     srv.stop()
                 return 0
+            if self.autotune:
+                if not hasattr(self.workflow, "autotune"):
+                    raise SystemExit(
+                        f"--autotune: {type(self.workflow).__name__} has "
+                        "no fused step (StandardWorkflow-family only)")
+                self.workflow.initialize(device=self.device, **kwargs)
+                tune_rep = self.workflow.autotune()
+                self.info("autotune: %s", {
+                    op: f"{r['variant']} ({r['source']})"
+                    for op, r in sorted(tune_rep.items())})
+            elif hasattr(self.workflow, "autotune") \
+                    and (self.fused or self.pp
+                         or self.mode != "standalone"):
+                # inherit a past tuning session's persisted winners
+                # (cache hits only, zero timing). Standalone always;
+                # distributed only when the operator points every
+                # process at the SAME cache file explicitly — per-host
+                # default caches could diverge and desync the SPMD
+                # programs.
+                if self.mode == "standalone" \
+                        or os.environ.get("VELES_AUTOTUNE_CACHE"):
+                    from veles_tpu.ops.autotune import apply_cached
+                    self.workflow.initialize(device=self.device, **kwargs)
+                    applied = apply_cached(self.workflow)
+                    if applied:
+                        self.info("autotune cache applied: %s", applied)
             if self.mode != "standalone":
                 # distributed run: every process executes the same SPMD
                 # program over the GLOBAL device mesh; gradient averaging

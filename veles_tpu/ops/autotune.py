@@ -1,0 +1,285 @@
+"""Persistent autotuner over the lowering-variant registry.
+
+For each tunable op a workflow actually contains, time every registered
+candidate lowering IN-GRAPH — a short donated `train_repeat` microbench of
+the whole fused step, the same scanned hot loop bench.py measures — pick
+the fastest, `variants.select()` it, and persist the decision in an
+on-disk JSON cache keyed by (device_kind, op, shapes, dtypes,
+params-hash, compute_dtype). A cache hit selects the stored winner with
+ZERO tuning cost; corrupt or missing cache files degrade to re-tuning,
+never to an error. On CPU the pallas candidates run in interpret mode, so
+the whole subsystem is tier-1-testable without a chip.
+
+Entry points: `autotune_workflow(wf)` (also exposed as
+`StandardWorkflow.autotune()` and the CLI's `--autotune`), and
+`tools/autotune.py` for the flagship AlexNet step — the systematic
+replacement for the hand-flipped `tools/ablate.py` / `ablate_lrn.py`
+one-offs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from veles_tpu.logger import Logger
+from veles_tpu.ops import variants
+
+__all__ = ["AutotuneCache", "autotune_workflow", "discover_tunables",
+           "op_cache_key", "default_cache_path"]
+
+
+def default_cache_path() -> str:
+    return (os.environ.get("VELES_AUTOTUNE_CACHE")
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "veles_tpu", "autotune.json"))
+
+
+class AutotuneCache(Logger):
+    """On-disk JSON decision cache. Flat {key: record} mapping; records
+    carry the winning variant plus the timings that chose it. A corrupt
+    or unreadable file behaves as empty (the tuner re-times and the next
+    `put` rewrites it atomically)."""
+
+    VERSION = 1
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        super().__init__()
+        self.path = path or default_cache_path()
+        self._data: Optional[Dict[str, Any]] = None
+
+    def _load(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            entries = raw.get("entries")
+            if raw.get("version") != self.VERSION \
+                    or not isinstance(entries, dict):
+                raise ValueError("unrecognized cache layout")
+            self._data = entries
+        except FileNotFoundError:
+            self._data = {}
+        except (OSError, ValueError, AttributeError) as e:
+            self.warning("autotune cache %s unreadable (%s): re-tuning",
+                         self.path, e)
+            self._data = {}
+        return self._data
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        rec = self._load().get(key)
+        return dict(rec) if isinstance(rec, dict) else None
+
+    def put(self, key: str, record: Dict[str, Any]) -> None:
+        data = self._load()
+        data[key] = record
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"version": self.VERSION, "entries": data}, f,
+                      indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)   # atomic: readers never see a torn file
+
+
+def _resolve_compute_dtype(compute_dtype: Any) -> Any:
+    """None means 'whatever the fused step would use' — resolve it the
+    same way FusedTrainStep does (root.common.precision_type), so cache
+    keys agree between a tuner passing None and a run passing None."""
+    if compute_dtype is not None:
+        return compute_dtype
+    try:
+        from veles_tpu.config import root
+        pt = getattr(root.common, "precision_type", None)
+    except Exception:  # noqa: BLE001
+        pt = None
+    return pt if pt and pt != "float32" else None
+
+
+def op_cache_key(device_kind: str, op: str, signatures: List[Dict],
+                 compute_dtype: Any = None) -> str:
+    """One key per (device, op, workflow-op-configuration). The signature
+    list covers EVERY instance of the op in the workflow (two LRN layers
+    with different shapes are one joint decision — the registry selection
+    is global per op), canonicalized so dict ordering can't split keys."""
+    blob = json.dumps(signatures, sort_keys=True, default=str)
+    h = hashlib.sha256(blob.encode()).hexdigest()[:16]
+    cd = str(compute_dtype) if compute_dtype is not None else "f32"
+    return f"{device_kind}|{op}|{cd}|{h}"
+
+
+def discover_tunables(wf) -> Dict[str, List[Dict]]:
+    """{op: [signature, ...]} for every tunable op present in the
+    workflow. Units opt in by exposing `variant_signature()` (returning
+    None when not tunable in this configuration — e.g. an explicit
+    per-layer override, or a conv the s2d rewrite can't apply to)."""
+    found: Dict[str, List[Dict]] = {}
+    for u in getattr(wf, "forwards", ()):
+        op = getattr(u, "variant_op", None)
+        sig_fn = getattr(u, "variant_signature", None)
+        if op is None or sig_fn is None:
+            continue
+        sig = sig_fn()
+        if sig is not None:
+            found.setdefault(op, []).append(sig)
+    return found
+
+
+def _sync(state) -> None:
+    """Device barrier that works through the remote PJRT tunnel: fetch one
+    scalar (block_until_ready is not a reliable barrier there — bench.py
+    protocol)."""
+    import numpy as np
+    for layer in state["params"]:
+        for a in layer.values():
+            np.asarray(a[(0,) * getattr(a, "ndim", 0)])
+            return
+
+
+def _time_variant(wf, mesh, compute_dtype, steps: int, repeats: int,
+                  batch: Optional[int]) -> float:
+    """Seconds per training step for the CURRENT registry selection:
+    build a fresh fused step (the selection is read at trace time), warm
+    it, then time `train_repeat` — one dispatch per window, donated
+    state, synthetic device-resident batch (nothing host-side in the
+    measurement)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    loader = wf.loader
+    b = int(batch or loader.minibatch_data.shape[0])
+    in_shape = (b,) + tuple(loader.minibatch_data.shape[1:])
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.jit(lambda k: jax.random.normal(k, in_shape, jnp.float32))(k1)
+    lbl = np.asarray(loader.minibatch_labels.mem)
+    # flat (N*S,) sequence labels reveal tokens-per-sample as the row
+    # blow-up over the loader's minibatch
+    tokens = max(1, lbl.shape[0] // loader.minibatch_data.shape[0])
+    if np.issubdtype(lbl.dtype, np.integer):
+        hi = max(2, int(getattr(wf, "n_classes", 0) or lbl.max() + 1))
+        y = jax.jit(lambda k: jax.random.randint(
+            k, (b * tokens,), 0, hi))(k2)
+    else:
+        y = jax.jit(lambda k: jax.random.normal(
+            k, (b,) + lbl.shape[1:], jnp.float32))(k2)
+
+    step = wf.build_fused_step(mesh=mesh, compute_dtype=compute_dtype)
+    state = step.init_state()
+    state, _ = step.train_repeat(state, x, y, steps)   # compile + warm
+    _sync(state)
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        state, _ = step.train_repeat(state, x, y, steps)
+        _sync(state)
+        best = min(best, time.perf_counter() - t0)
+    return best / steps
+
+
+def apply_cached(wf, *, compute_dtype=None,
+                 cache: Optional[AutotuneCache] = None,
+                 cache_path: Optional[str] = None) -> Dict[str, str]:
+    """Select previously persisted winners for this workflow's tunable
+    ops WITHOUT any timing (cache hits only; misses keep the current
+    selection). The cheap way for bench/serving runs to inherit a
+    tuning session's decisions. Returns {op: variant} of what applied."""
+    import jax
+
+    if not getattr(wf, "is_initialized", False):
+        wf.initialize(device=None)
+    cache = cache or AutotuneCache(cache_path)
+    device_kind = jax.devices()[0].device_kind
+    compute_dtype = _resolve_compute_dtype(compute_dtype)
+    applied: Dict[str, str] = {}
+    for op, sigs in discover_tunables(wf).items():
+        hit = cache.get(op_cache_key(device_kind, op, sigs, compute_dtype))
+        if hit is not None and variants.has(op, hit.get("variant")):
+            variants.select(op, hit["variant"])
+            applied[op] = hit["variant"]
+    return applied
+
+
+def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
+                      steps: int = 4, repeats: int = 2,
+                      batch: Optional[int] = None,
+                      cache: Optional[AutotuneCache] = None,
+                      cache_path: Optional[str] = None,
+                      force: bool = False,
+                      ops: Optional[List[str]] = None
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Tune every tunable op the workflow contains; leave the winners
+    selected in the registry; return a per-op report:
+
+        {op: {"variant": name, "source": "cache"|"tuned",
+              "timings_s": {...}(tuned only), "key": cache-key}}
+
+    Ops are tuned sequentially, each candidate timed with every OTHER op
+    held at its current selection. `force=True` re-times cache hits.
+    """
+    import jax
+
+    if not getattr(wf, "is_initialized", False):
+        wf.initialize(device=None)
+    cache = cache or AutotuneCache(cache_path)
+    device_kind = jax.devices()[0].device_kind
+    compute_dtype = _resolve_compute_dtype(compute_dtype)
+    on_cpu = jax.default_backend() == "cpu"
+    tunables = discover_tunables(wf)
+    if ops:
+        tunables = {k: v for k, v in tunables.items() if k in ops}
+    report: Dict[str, Dict[str, Any]] = {}
+    ctx = variants.pallas_interpret() if on_cpu \
+        else contextlib.nullcontext()
+    with ctx:
+        for op in sorted(tunables):
+            key = op_cache_key(device_kind, op, tunables[op],
+                               compute_dtype)
+            hit = None if force else cache.get(key)
+            if hit is not None and variants.has(op, hit.get("variant")):
+                variants.select(op, hit["variant"])
+                report[op] = {"variant": hit["variant"],
+                              "source": "cache", "key": key}
+                continue
+            cands = [v.name for v in variants.variants_for(op)
+                     if v.tunable
+                     and (not v.pallas or variants.pallas_ok())]
+            prev = variants.selected(op)
+            timings: Dict[str, Any] = {}
+            for name in cands:
+                variants.select(op, name)
+                try:
+                    timings[name] = _time_variant(
+                        wf, mesh, compute_dtype, steps, repeats, batch)
+                except Exception as e:  # noqa: BLE001 — one broken
+                    # candidate (e.g. a pallas kernel a backend rejects)
+                    # must not abort the whole tune
+                    timings[name] = f"error: {e!s:.200}"
+            ok = {k: v for k, v in timings.items()
+                  if isinstance(v, float)}
+            if not ok:
+                # nothing measurable: restore the pre-tune state
+                if prev is None:
+                    variants.clear_selection(op)
+                else:
+                    variants.select(op, prev)
+                report[op] = {"variant": variants.effective(op),
+                              "source": "error", "timings_s": timings,
+                              "key": key}
+                continue
+            winner = min(ok, key=ok.get)
+            variants.select(op, winner)
+            rounded = {k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in timings.items()}
+            cache.put(key, {"variant": winner, "timings_s": rounded,
+                            "device_kind": device_kind,
+                            "steps": steps, "tuned_at": time.time()})
+            report[op] = {"variant": winner, "source": "tuned",
+                          "timings_s": rounded, "key": key}
+    return report

@@ -1,0 +1,348 @@
+"""Lowering-variant registry: every tunable op's candidate lowerings.
+
+The round-4 headline (+43–51% samples/s) came entirely from swapping op
+lowerings — banded-matmul LRN, the s2d conv stem — yet each variant was a
+hand-flipped class attribute (`LRNormalizerForward.prefer_pallas`,
+`MaxPooling.lowering`, conv `s2d`) exercised only by one-off scripts when
+a chip happened to be up. This module makes the choice systematic, the
+same way VELES solved kernel selection with its per-backend unit registry
+(SURVEY.md §4) and TorchInductor solves it with autotuned lowering choice
+plus a persistent cache (Ansel et al., PAPERS.md):
+
+- every tunable op registers its NAMED candidate lowerings here, each
+  carrying an equivalence contract against `ops.reference` (enforced by
+  tests/test_variants_autotune.py: fwd AND bwd, Pallas via interpret
+  mode on CPU);
+- units consult `resolve()` at fused-step trace time instead of reading
+  scattered class attributes (those attributes survive as deprecation
+  shims that write through to `select()`);
+- the autotuner (`ops.autotune`, `tools/autotune.py`, `--autotune`)
+  times candidates in-graph and persists the winner; `selection_table()`
+  is embedded into bench records and the supervisor's exit report so a
+  measured number always names the lowerings that produced it.
+
+Adding a variant is ONE `register()` call (see docs/AUTOTUNE.md) — it is
+then automatically equivalence-tested, tunable, cacheable and reported.
+
+This module imports no jax at module scope on purpose: the resilience
+supervisor (import-light by design) reads `selection_table()` for its
+exit report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "Variant", "register_op", "register", "ops", "variants_for", "get",
+    "has", "select", "selected", "effective", "clear_selection",
+    "selection_table", "resolve", "pallas_ok", "pallas_interpret",
+    "warn_deprecated_knob",
+]
+
+
+@dataclass(frozen=True)
+class Variant:
+    """One candidate lowering for a tunable op.
+
+    `apply` is the canonical callable for the op's documented signature
+    (see the per-op sections below); `pallas` marks lowerings that need a
+    compiled Pallas path (gated by `pallas_ok()`, interpret mode on CPU);
+    `tunable=False` marks resolution-only pseudo-variants (e.g. dropout
+    "auto") the autotuner must not time as candidates."""
+
+    op: str
+    name: str
+    apply: Callable[..., Any]
+    pallas: bool = False
+    tunable: bool = True
+    doc: str = ""
+
+
+@dataclass
+class _OpSpec:
+    op: str
+    default: str
+    fallback: str           # non-pallas stand-in when pallas is unusable
+    doc: str = ""
+    variants: Dict[str, Variant] = field(default_factory=dict)
+
+
+_OPS: Dict[str, _OpSpec] = {}
+#: global op -> variant-name selection (autotuner / tools / shims write it)
+_selection: Dict[str, str] = {}
+_lock = threading.Lock()
+#: tests and the CPU autotune path set this so pallas variants resolve in
+#: interpret mode where no TPU is attached (tier-1 testability)
+_PALLAS_INTERPRET = False
+
+
+def register_op(op: str, default: str, fallback: Optional[str] = None,
+                doc: str = "") -> None:
+    _OPS[op] = _OpSpec(op=op, default=default,
+                       fallback=fallback or default, doc=doc)
+
+
+def register(variant: Variant) -> Variant:
+    spec = _OPS.get(variant.op)
+    if spec is None:
+        raise KeyError(f"unknown tunable op {variant.op!r}; register_op "
+                       f"first (known: {sorted(_OPS)})")
+    spec.variants[variant.name] = variant
+    return variant
+
+
+def ops() -> List[str]:
+    return sorted(_OPS)
+
+
+def variants_for(op: str) -> List[Variant]:
+    return list(_spec(op).variants.values())
+
+
+def _spec(op: str) -> _OpSpec:
+    try:
+        return _OPS[op]
+    except KeyError:
+        raise KeyError(f"unknown tunable op {op!r} "
+                       f"(registered: {sorted(_OPS)})") from None
+
+
+def get(op: str, name: str) -> Variant:
+    spec = _spec(op)
+    try:
+        return spec.variants[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown variant {name!r} for op {op!r} "
+            f"(registered: {sorted(spec.variants)})") from None
+
+
+def has(op: str, name: Any) -> bool:
+    return op in _OPS and name in _OPS[op].variants
+
+
+def select(op: str, name: str) -> None:
+    """Pin op's lowering globally (validates both names)."""
+    get(op, name)
+    with _lock:
+        _selection[op] = name
+
+
+def selected(op: str) -> Optional[str]:
+    return _selection.get(op)
+
+
+def effective(op: str) -> str:
+    """The variant name resolve() would use absent per-unit overrides."""
+    return _selection.get(op, _spec(op).default)
+
+
+def clear_selection(op: Optional[str] = None) -> None:
+    with _lock:
+        if op is None:
+            _selection.clear()
+        else:
+            _selection.pop(op, None)
+
+
+def selection_table(include_defaults: bool = False) -> Dict[str, str]:
+    """{op: variant-name} snapshot — what a record should report. With
+    `include_defaults`, ops without an explicit selection report their
+    default, so the table always names every tunable op."""
+    if not include_defaults:
+        return dict(_selection)
+    return {op: effective(op) for op in _OPS}
+
+
+def pallas_ok() -> bool:
+    """Can a pallas variant actually run here? True on a TPU backend, or
+    anywhere while `pallas_interpret()` is active."""
+    if _PALLAS_INTERPRET:
+        return True
+    try:
+        from veles_tpu.ops import pallas_kernels as pk
+        return pk.available()
+    except Exception:  # noqa: BLE001 — no jax / broken backend: no pallas
+        return False
+
+
+@contextlib.contextmanager
+def pallas_interpret():
+    """Resolve (and run) pallas variants in interpret mode — the CPU
+    autotune/tier-1-test path. pallas_kernels._interpret() already
+    interprets whenever no TPU is attached; this flag only lifts the
+    resolve()-time gating."""
+    global _PALLAS_INTERPRET
+    prev = _PALLAS_INTERPRET
+    _PALLAS_INTERPRET = True
+    try:
+        yield
+    finally:
+        _PALLAS_INTERPRET = prev
+
+
+def resolve(op: str, unit: Any = None) -> Variant:
+    """The variant a unit must trace NOW. Precedence:
+    1. the unit's explicit per-instance `variant_override` (constructor
+       knobs like MaxPooling(lowering=...));
+    2. the global selection (autotuner cache / tools / legacy shims);
+    3. the op's registered default.
+    Pallas variants additionally need `pallas_ok()` AND the unit's
+    `allow_pallas` (FusedTrainStep clears it under GSPMD
+    auto-partitioning — a pallas_call cannot be auto-partitioned);
+    otherwise the op's non-pallas fallback is traced instead.
+    """
+    spec = _spec(op)
+    name = getattr(unit, "variant_override", None) if unit is not None \
+        else None
+    if name is None:
+        name = _selection.get(op, spec.default)
+    v = get(op, name)
+    if v.pallas and not (pallas_ok()
+                         and getattr(unit, "allow_pallas", True)):
+        v = get(op, spec.fallback)
+    return v
+
+
+def warn_deprecated_knob(old: str, new: str) -> None:
+    warnings.warn(
+        f"{old} is deprecated: the fused-step build path no longer reads "
+        f"it; this write is shimmed onto the lowering-variant registry "
+        f"({new}). See docs/AUTOTUNE.md.",
+        DeprecationWarning, stacklevel=3)
+
+
+# ===========================================================================
+# Registered ops. apply() bodies lazy-import jax-bearing modules so this
+# module stays importable from jax-free processes (resilience supervisor).
+# ===========================================================================
+
+# -- LRN forward+backward (one op: fwd and bwd ride one custom_vjp) ---------
+#    apply(x, *, k, alpha, beta, n) -> y; differentiable.
+
+def _lrn_banded(x, *, k, alpha, beta, n):
+    from veles_tpu.ops import xla as ox
+    return ox.lrn_forward(x, k, alpha, beta, n, cache_bwd=False)
+
+
+def _lrn_cached(x, *, k, alpha, beta, n):
+    from veles_tpu.ops import xla as ox
+    return ox.lrn_forward(x, k, alpha, beta, n, cache_bwd=True)
+
+
+def _lrn_pallas(x, *, k, alpha, beta, n):
+    from veles_tpu.ops import pallas_kernels as pk
+    return pk.lrn_pallas(x, k, alpha, beta, n)
+
+
+register_op(
+    "lrn", default="banded_matmul", fallback="banded_matmul",
+    doc="AlexNet across-channel LRN, forward + custom-VJP backward "
+        "(~24% of the AlexNet step after the r4 banded-matmul rewrite)")
+register(Variant("lrn", "banded_matmul", _lrn_banded,
+                 doc="XLA banded-matmul window sum; bwd recomputes s/d"))
+register(Variant("lrn", "cached_residual", _lrn_cached,
+                 doc="same lowering, forward d=s^(-beta) and s stashed as "
+                     "residuals: bwd drops one window dot + the pow chain "
+                     "for two activation-sized residuals"))
+register(Variant("lrn", "pallas_one_pass", _lrn_pallas, pallas=True,
+                 doc="one-VMEM-pass Pallas kernel pair (native-dtype HBM "
+                     "I/O, sqrt/rsqrt pow)"))
+
+
+# -- max pooling (fused-step lowering; the knob is the BACKWARD shape) ------
+#    apply(x, ksize, stride, use_abs) -> y; differentiable.
+
+def _maxpool_reduce_window(x, ksize, stride, use_abs):
+    from veles_tpu.ops import xla as ox
+    if use_abs:
+        # the custom-comparator reduce_window has no reverse-mode rule;
+        # the patches/argmax formulation differentiates (gather vjp)
+        return ox.maxpool_forward_with_idx(x, ksize, stride,
+                                           use_abs=True)[0]
+    return ox.maxpool_forward(x, ksize, stride, False)
+
+
+def _maxpool_slices(x, ksize, stride, use_abs):
+    from veles_tpu.ops import xla as ox
+    return ox.maxpool_forward_slices(x, ksize, stride, use_abs)
+
+
+register_op(
+    "maxpool", default="reduce_window",
+    doc="max/maxabs pooling in the fused step; the variants differ in "
+        "what the BACKWARD lowers to")
+register(Variant("maxpool", "reduce_window", _maxpool_reduce_window,
+                 doc="lax.reduce_window; backward = select_and_scatter"))
+register(Variant("maxpool", "slices", _maxpool_slices,
+                 doc="max-fold over ky*kx shifted strided slices; "
+                     "backward = selects + zero-pads (fusion-friendly)"))
+
+
+# -- conv stem: strided thin-channel entry conv -----------------------------
+#    apply(x, w, b, stride, padding, activation) -> y; differentiable.
+#    Units with s2d="auto" consult resolve("conv_stem") for the decision;
+#    explicit s2d="on"/"off" stays a per-layer override.
+
+def _conv_direct(x, w, b, stride, padding, activation):
+    from veles_tpu.ops import xla as ox
+    return ox.conv2d_forward(x, w, b, stride, padding, activation,
+                             s2d=False)
+
+
+def _conv_s2d(x, w, b, stride, padding, activation):
+    from veles_tpu.ops import xla as ox
+    return ox.conv2d_forward(x, w, b, stride, padding, activation,
+                             s2d=True)
+
+
+register_op(
+    "conv_stem", default="s2d",
+    doc="strided thin-channel (cin<8) entry conv: direct vs the exact "
+        "space-to-depth rewrite (r4 on-chip winner, 8656 -> 9377)")
+register(Variant("conv_stem", "direct", _conv_direct,
+                 doc="plain lax.conv_general_dilated"))
+register(Variant("conv_stem", "s2d", _conv_s2d,
+                 doc="space-to-depth repack: stride-1 conv on full MXU "
+                     "tiles, numerics identical"))
+
+
+# -- dropout mask RNG -------------------------------------------------------
+#    apply(key, shape, drop_prob, dtype) -> pre-scaled mask (0 or 1/keep).
+#    Streams differ between impls (counter-based either way); equivalence
+#    is structural/statistical, like the reference's xorshift-vs-numpy
+#    split. "auto" (default) keeps the device-dependent legacy behavior:
+#    hardware RBG on accelerators, threefry on CPU (impl-stable goldens).
+
+def _dropout_auto(key, shape, drop_prob, dtype):
+    from veles_tpu.ops import xla as ox
+    return ox.make_dropout_mask(key, shape, drop_prob, dtype, impl="auto")
+
+
+def _dropout_threefry(key, shape, drop_prob, dtype):
+    from veles_tpu.ops import xla as ox
+    return ox.make_dropout_mask(key, shape, drop_prob, dtype,
+                                impl="threefry")
+
+
+def _dropout_rbg(key, shape, drop_prob, dtype):
+    from veles_tpu.ops import xla as ox
+    return ox.make_dropout_mask(key, shape, drop_prob, dtype, impl="rbg")
+
+
+register_op(
+    "dropout", default="auto",
+    doc="dropout mask bit source (~7% of the AlexNet step under "
+        "threefry on v5e; RBG measured 4x less wall-clock per mask)")
+register(Variant("dropout", "auto", _dropout_auto, tunable=False,
+                 doc="backend-dependent default: rbg on accelerators, "
+                     "threefry on CPU"))
+register(Variant("dropout", "threefry", _dropout_threefry,
+                 doc="jax.random counter-based threefry"))
+register(Variant("dropout", "rbg", _dropout_rbg,
+                 doc="hardware rng_bit_generator (XLA RBG)"))

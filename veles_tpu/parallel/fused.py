@@ -158,12 +158,10 @@ class FusedTrainStep:
                         "silently stay shard-local (causality restarts "
                         "at every shard). Set parallel_mode='ring' or "
                         "'ulysses'.")
-        if mode == "gspmd":
-            # GSPMD auto-partitioning cannot shard a pallas_call; units
-            # with a pallas fast path must fall back to their XLA form
-            for u in self.forwards:
-                if hasattr(u, "prefer_pallas"):
-                    u.prefer_pallas = False
+        # GSPMD auto-partitioning cannot shard a pallas_call: _forward
+        # clears each unit's `allow_pallas` at trace time, and
+        # variants.resolve() then substitutes the op's non-pallas
+        # fallback (the registry replaces the old prefer_pallas flip)
         self.mode = mode
         #: cached identity-jit that gathers cross-process shards to a
         #: replicated array (write_back's host() path); built lazily
@@ -376,6 +374,13 @@ class FusedTrainStep:
                     MODEL_AXIS if self._seq_tp_active(u) else None)
             if hasattr(u, "ep_axis_name"):
                 u.ep_axis_name = ep_axis
+            if getattr(u, "variant_op", None) is not None:
+                # registry-consulting units: pallas lowerings are legal
+                # everywhere except under GSPMD auto-partitioning (a
+                # pallas_call cannot be partitioned); set at trace time
+                # so several step objects over one workflow each trace
+                # the right lowering (same pattern as seq_axis_name)
+                u.allow_pallas = self.mode != "gspmd"
             k = jax.random.fold_in(key, i) if u.fused_needs_key else None
             x = u.fused_apply(params[i], x, key=k, train=train)
             x = self._constrain_tp_act(x, i)
@@ -716,9 +721,18 @@ class FusedTrainStep:
         elif self.mode == "gspmd":
             mesh = self.mesh
             xsh = NamedSharding(mesh, P(DATA_AXIS))
+            ssh = self._state_shardings()
+            repl = NamedSharding(mesh, P())
+            # out_shardings pins the NEW state to the same TP plan the
+            # inputs carry: without it the partitioner is free to return
+            # updated params under propagated shardings that drift from
+            # the plan (observed: a small replicated bias coming back
+            # P("model")), and the eval jit's in_shardings then rejects
+            # the trained state with a sharding-mismatch ValueError
             self._train_fn = jax.jit(
                 lambda s, x, y, w: self._train_body(s, x, y, w, axis=None),
-                in_shardings=(self._state_shardings(), xsh, xsh, xsh),
+                in_shardings=(ssh, xsh, xsh, xsh),
+                out_shardings=(ssh, repl, repl),
                 donate_argnums=donate)
             self._eval_fn = jax.jit(
                 lambda p, x, y, w: self._eval_body(p, x, y, w, axis=None),
@@ -883,6 +897,29 @@ class FusedTrainStep:
     def _last_fwd(self):
         return self.forwards[-1] if self.forwards else None
 
+    def variant_table(self) -> Dict[str, str]:
+        """{op: variant-name} this step would trace right now, for every
+        tunable op its forward chain contains — what bench records and
+        the supervisor's exit report embed so a measured number always
+        names the lowerings that produced it."""
+        from veles_tpu.ops import variants
+        table: Dict[str, str] = {}
+        for u in self.forwards:
+            op = getattr(u, "variant_op", None)
+            if op is None:
+                continue
+            u.allow_pallas = self.mode != "gspmd"   # mirror _forward
+            # units whose traced lowering can diverge from the raw
+            # registry resolution (conv per-layer s2d override /
+            # inapplicable auto stems) report through variant_effective;
+            # None = no decision traced for this layer, don't report it
+            eff = getattr(u, "variant_effective", None)
+            name = eff() if eff is not None \
+                else variants.resolve(op, unit=u).name
+            if name is not None:
+                table[op] = name
+        return table
+
     def evaluate(self, state, x, y, w=None):
         """Forward-only metrics (validation/test minibatches)."""
         if self._eval_fn is None:
@@ -931,9 +968,12 @@ class FusedTrainStep:
                 cache[k] = jax.jit(sm, donate_argnums=donate)
             elif self.mode == "gspmd":
                 xsh = NamedSharding(self.mesh, P(DATA_AXIS))
+                ssh = self._state_shardings()
+                repl = NamedSharding(self.mesh, P())
                 cache[k] = jax.jit(
-                    rep, in_shardings=(self._state_shardings(),
-                                       xsh, xsh, xsh),
+                    rep, in_shardings=(ssh, xsh, xsh, xsh),
+                    out_shardings=(ssh, (repl, repl)),  # see _build: pin
+                    # the returned state to the plan, not propagation
                     donate_argnums=donate)
             else:
                 raise ValueError(f"unknown mode {self.mode!r}")
@@ -985,9 +1025,11 @@ class FusedTrainStep:
                 cache[k] = jax.jit(sm, donate_argnums=donate)
             elif self.mode == "gspmd":
                 xsh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+                ssh = self._state_shardings()
+                repl = NamedSharding(self.mesh, P())
                 cache[k] = jax.jit(
-                    acc, in_shardings=(self._state_shardings(),
-                                       xsh, xsh, xsh),
+                    acc, in_shardings=(ssh, xsh, xsh, xsh),
+                    out_shardings=(ssh, (repl, repl)),  # see _build
                     donate_argnums=donate)
             else:
                 raise ValueError(f"unknown mode {self.mode!r}")
@@ -1035,9 +1077,11 @@ class FusedTrainStep:
                 self._train_many_fn = jax.jit(sm, donate_argnums=donate)
             elif self.mode == "gspmd":
                 xsh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+                ssh = self._state_shardings()
+                repl = NamedSharding(self.mesh, P())
                 self._train_many_fn = jax.jit(
-                    many, in_shardings=(self._state_shardings(),
-                                        xsh, xsh, xsh),
+                    many, in_shardings=(ssh, xsh, xsh, xsh),
+                    out_shardings=(ssh, (repl, repl)),  # see _build
                     donate_argnums=donate)
             else:
                 raise ValueError(f"unknown mode {self.mode!r}")

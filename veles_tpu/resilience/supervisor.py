@@ -327,7 +327,29 @@ class Supervisor(Logger):
         (self.info if code == 0 else self.error)("%s", report)
         print(report, file=sys.stderr, flush=True)
         if self.report_path:
+            report_obj = {"outcome": outcome, "exit_code": code,
+                          "attempts": self.attempts}
+            try:
+                # which op lowerings the run was configured to trace.
+                # PROVENANCE: this is the supervisor process's view
+                # (registry defaults + selections visible here) — a
+                # child that ran --autotune or applied a populated
+                # VELES_AUTOTUNE_CACHE may have traced cached winners
+                # instead; the note keeps the record from misattributing
+                # a measured outcome to the wrong lowerings. Guarded
+                # import: the variants module itself is jax-free, but
+                # its package __init__ is not, and the supervisor must
+                # never die on report cosmetics at exit time.
+                from veles_tpu.ops.variants import selection_table
+                report_obj["variants"] = selection_table(
+                    include_defaults=True)
+                report_obj["variants_provenance"] = (
+                    "supervisor-process registry view (defaults + local "
+                    "selections); children using --autotune or "
+                    "VELES_AUTOTUNE_CACHE may have traced persisted "
+                    "winners not reflected here")
+            except Exception:  # noqa: BLE001
+                pass
             with open(self.report_path, "w") as f:
-                json.dump({"outcome": outcome, "exit_code": code,
-                           "attempts": self.attempts}, f, indent=2)
+                json.dump(report_obj, f, indent=2)
         return code

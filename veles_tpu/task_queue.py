@@ -32,6 +32,7 @@ an open non-loopback port.
 from __future__ import annotations
 
 import base64
+import ipaddress
 import json
 import threading
 import time
@@ -43,6 +44,18 @@ from veles_tpu.distributable import IDistributable
 from veles_tpu.logger import Logger
 
 _QUEUED, _LEASED, _DONE = "queued", "leased", "done"
+
+
+def _is_loopback(host: str) -> bool:
+    """True for 127.0.0.0/8, ::1 AND the IPv4-mapped ::ffff:127.x forms an
+    AF_INET6-bound server reports — the old `"127."` prefix check
+    misclassified both IPv6 spellings (ADVICE r5)."""
+    try:
+        addr = ipaddress.ip_address(host.split("%")[0])
+    except ValueError:
+        return False
+    mapped = getattr(addr, "ipv4_mapped", None)
+    return (mapped or addr).is_loopback
 
 
 class FitnessQueueServer(Logger, IDistributable):
@@ -59,12 +72,25 @@ class FitnessQueueServer(Logger, IDistributable):
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  token: Optional[str] = None,
                  lease_s: float = 120.0,
+                 max_renewals: int = 720,
                  max_body: int = 64 * 1024) -> None:
         super().__init__()
         self.host = host
         self.port = port
         self.token = token
         self.lease_s = lease_s
+        #: renewal budget PER LEASE: a wedged (hung, not dead) worker's
+        #: renew loop must not extend its lease forever — past the cap
+        #: renewals are refused, the lease expires and the task
+        #: re-issues; combined with the callers' finite submit timeouts
+        #: (Population.evaluate / Ensemble.train) a wedged worker
+        #: surfaces as an error instead of an eternal hang (ADVICE r5).
+        #: The default is sized for HEALTHY long evaluations: workers
+        #: renew every lease_s/3 (40s at the default lease), so 720
+        #: renewals ≈ 8 h — Ensemble members are full training runs and
+        #: must not lose a live lease mid-train (a wedged worker is
+        #: bounded by the submit timeout long before this cap)
+        self.max_renewals = max_renewals
         #: result-body size cap; ensemble raises it so trained-workflow
         #: pickles (base64 in the result body) fit
         self.max_body = max_body
@@ -96,6 +122,7 @@ class FitnessQueueServer(Logger, IDistributable):
                 t["state"] = _LEASED
                 t["lease_expiry"] = now + self.lease_s
                 t["worker"] = worker
+                t["renewals"] = 0       # fresh budget per lease
                 # lease_s rides along so the worker can renew at the
                 # right cadence for long-running individuals
                 return {"id": tid, "payload": t["payload"],
@@ -141,8 +168,32 @@ class FitnessQueueServer(Logger, IDistributable):
         t = self._tasks.get(tid)
         if t is None or t["state"] != _LEASED:
             return False
+        if t.get("renewals", 0) >= self.max_renewals:
+            self.warning(
+                "task %s exhausted its %d-renewal budget (worker %s "
+                "wedged?): lease will expire and re-issue",
+                tid, self.max_renewals, t.get("worker") or "<unknown>")
+            return False
+        t["renewals"] = t.get("renewals", 0) + 1
         t["lease_expiry"] = time.monotonic() + self.lease_s
         return True
+
+    def fail_if_leased_to(self, tid: str, worker: str) -> bool:
+        """Permanently fail task `tid` (inf fitness, no artifact) — but
+        ONLY if it is currently leased to `worker`. Task ids are
+        predictable (g{epoch}-{i}), so an unconditional fail would let
+        any client kill arbitrary queued/leased tasks with one oversized
+        POST (ADVICE r5); scoping to the recorded lease holder means a
+        client can only fail work it was actually issued."""
+        with self._lock:
+            t = self._tasks.get(tid)
+            if (not worker or t is None or t["state"] != _LEASED
+                    or t.get("worker") != worker):
+                return False
+            t["state"] = _DONE
+            t["fitness"] = float("inf")
+            t["artifact"] = None
+            return True
 
     def _post_result(self, tid: str, fitness: float,
                      artifact: Optional[bytes] = None) -> bool:
@@ -174,14 +225,14 @@ class FitnessQueueServer(Logger, IDistributable):
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _fail_task(self, tid: str) -> None:
-                """Permanently fail a task (inf fitness, no artifact) so
-                the coordinator surfaces an error instead of re-leasing
-                the same doomed work forever."""
+            def _fail_task(self, tid: str, worker: str) -> None:
+                """Permanently fail a task so the coordinator surfaces an
+                error instead of re-leasing the same doomed work forever
+                — scoped to the posting worker's own lease (see
+                fail_if_leased_to); anyone else's refusal just lets the
+                lease expire."""
                 if tid:
-                    outer.apply_data_from_slave(
-                        {"id": tid, "fitness": float("inf"),
-                         "artifact": None})
+                    outer.fail_if_leased_to(tid[:128], worker[:128])
 
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 if not self.path.startswith("/task"):
@@ -230,9 +281,12 @@ class FitnessQueueServer(Logger, IDistributable):
                     # truncated body parses as garbage and 400s) — and
                     # like the artifact-auth refusal below, the task is
                     # FAILED so the coordinator surfaces an error
-                    # instead of re-training the same member forever
+                    # instead of re-training the same member forever.
+                    # id AND worker ride the query string (the body is
+                    # unreadably large); the fail is lease-holder-scoped
                     q = parse_qs(urlsplit(self.path).query)
-                    self._fail_task((q.get("id") or [""])[0])
+                    self._fail_task((q.get("id") or [""])[0],
+                                    (q.get("worker") or [""])[0])
                     self.send_response(413)
                     self.end_headers()
                     return
@@ -249,10 +303,10 @@ class FitnessQueueServer(Logger, IDistributable):
                         # FAILED (inf fitness, no artifact): the
                         # coordinator's Ensemble.train raises with a
                         # clear message instead of looping forever.
-                        if not token and \
-                                not self.client_address[0].startswith(
-                                    "127."):
-                            self._fail_task(str(raw.get("id", "")))
+                        if not token and not _is_loopback(
+                                self.client_address[0]):
+                            self._fail_task(str(raw.get("id", "")),
+                                            str(raw.get("worker", "")))
                             self.send_response(403)
                             self.end_headers()
                             return
@@ -444,7 +498,7 @@ class FitnessQueueWorker(Logger):
 
             renewer = threading.Thread(target=_renew_loop, daemon=True)
             renewer.start()
-            body = {"id": task["id"]}
+            body = {"id": task["id"], "worker": self.worker_id}
             try:
                 out = self.fitness_fn(task["payload"])
                 if isinstance(out, tuple):  # (fitness, artifact bytes)
@@ -464,13 +518,15 @@ class FitnessQueueWorker(Logger):
                 body["fitness"] = float("inf")
             posted = None
             try:
-                # id rides in the query string too: a 413 refusal can't
-                # read the body, but must still fail the right task.
+                # id AND worker ride in the query string too: a 413
+                # refusal can't read the body, but must still fail the
+                # right task — and only for its own lease holder.
                 # The renewer keeps running THROUGH the post: a slow
                 # multi-MB artifact upload must not lose its lease
                 # mid-transfer.
                 posted = self._request(
-                    "POST", f"/result?id={quote(task['id'])}", body)
+                    "POST", f"/result?id={quote(task['id'])}"
+                            f"&worker={quote(self.worker_id)}", body)
                 if posted is None:
                     self.warning(
                         "result post for %s rejected: oversized results "

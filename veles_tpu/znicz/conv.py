@@ -18,6 +18,7 @@ from typing import Any, Tuple
 import numpy as np
 
 from veles_tpu.ops import reference as ref
+from veles_tpu.ops import variants
 from veles_tpu.ops import xla as ox
 from veles_tpu.znicz.nn_units import Forward
 
@@ -26,6 +27,12 @@ class Conv(Forward):
     """y = act(conv2d(x, W) + b); x: (N,H,W,C), W: (ky,kx,C,n_kernels)."""
 
     activation = "linear"
+
+    #: lowering-variant registry op for the strided thin-channel stem
+    #: decision (candidates "direct" | "s2d"); consulted only when the
+    #: layer's s2d knob is "auto" — explicit "on"/"off" stays a
+    #: per-layer override, exactly like MaxPooling's `lowering` key.
+    variant_op = "conv_stem"
 
     def __init__(self, workflow=None, n_kernels: int = 16,
                  kx: int = 3, ky: int = 3,
@@ -54,13 +61,52 @@ class Conv(Forward):
                 f"{self.stride}): the rewrite repacks stride blocks")
         self.s2d = s2d
 
+    def _s2d_applicable(self, cin: int) -> bool:
+        """The auto heuristic's applicability test: a square-strided
+        thin-channel stem (cin < 8 fills under 8/128 of an MXU tile)."""
+        sy, sx = self.stride
+        return sy == sx and sy > 1 and cin < 8
+
     def _use_s2d(self, cin: int) -> bool:
         if self.s2d == "on":
             return True         # applicability validated in __init__
         if self.s2d == "off":
             return False
-        sy, sx = self.stride
-        return sy == sx and sy > 1 and cin < 8
+        # "auto": the registry owns the decision for applicable stems
+        # (default "s2d" — the r4 on-chip winner; tools/autotune.py can
+        # re-measure and flip it per device/shape)
+        return (self._s2d_applicable(cin)
+                and variants.resolve("conv_stem", unit=self).name == "s2d")
+
+    def variant_effective(self):
+        """The conv_stem lowering THIS layer actually traces, for
+        variant_table() reporting: the per-layer s2d="on"/"off" override
+        bypasses the registry, and an auto layer the rewrite can't apply
+        to (stride 1 / wide cin) traces direct regardless of the
+        selection — reporting the raw registry resolution for those
+        would name a variant the step never traced. None = this layer
+        carries no stem decision worth reporting."""
+        if self.s2d == "on":
+            return "s2d"
+        if self.s2d == "off":
+            return "direct"
+        if not self.input or not self._s2d_applicable(self.input.shape[-1]):
+            return None
+        return variants.resolve("conv_stem", unit=self).name
+
+    def variant_signature(self):
+        """Tunable only when s2d='auto' AND the rewrite applies here."""
+        if self.s2d != "auto" or not self.input \
+                or not self._s2d_applicable(self.input.shape[-1]):
+            return None
+        # batch dim excluded: tune-then-inherit across batch sizes
+        return {"sample_shape": list(self.input.shape[1:]),
+                "dtype": str(np.asarray(self.input.mem).dtype),
+                "params": {"n_kernels": self.n_kernels,
+                           "kx": self.kx, "ky": self.ky,
+                           "stride": list(self.stride),
+                           "padding": list(self.padding),
+                           "activation": self.activation}}
 
     def output_hw(self) -> Tuple[int, int]:
         _, h, w, _ = self.input.shape

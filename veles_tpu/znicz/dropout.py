@@ -21,6 +21,7 @@ from veles_tpu import prng
 from veles_tpu.loader.base import TRAIN
 from veles_tpu.memory import Array
 from veles_tpu.ops import reference as ref
+from veles_tpu.ops import variants
 from veles_tpu.ops import xla as ox
 from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
 
@@ -56,11 +57,25 @@ class DropoutForward(Forward):
 
     fused_needs_key = True
 
+    #: lowering-variant registry op for the mask bit source (candidates
+    #: "threefry" | "rbg"; default "auto" keeps the legacy backend-
+    #: dependent pick — hardware RBG on accelerators, threefry on CPU)
+    variant_op = "dropout"
+
+    def variant_signature(self):
+        # batch dim excluded: tune-then-inherit across batch sizes
+        if getattr(self, "variant_override", None) is not None \
+                or not self.input:
+            return None
+        return {"sample_shape": list(self.input.shape[1:]),
+                "dtype": str(np.asarray(self.input.mem).dtype),
+                "params": {"dropout_ratio": self.dropout_ratio}}
+
     def fused_apply(self, params, x, *, key=None, train=True):
         if not train:
             return x
-        mask = ox.make_dropout_mask(key, x.shape, self.dropout_ratio, x.dtype)
-        return x * mask
+        v = variants.resolve("dropout", unit=self)
+        return x * v.apply(key, x.shape, self.dropout_ratio, x.dtype)
 
     def xla_init(self):
         ratio = self.dropout_ratio

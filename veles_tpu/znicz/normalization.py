@@ -18,12 +18,59 @@ import jax
 import numpy as np
 
 from veles_tpu.ops import reference as ref
+from veles_tpu.ops import variants
 from veles_tpu.ops import xla as ox
 from veles_tpu.znicz.nn_units import Forward, GradientDescentBase, register_gd
 
 
-class LRNormalizerForward(Forward):
+def _lrn_shim_select() -> None:
+    """Map the legacy two-bool knob state onto ONE registry selection."""
+    variants.select(
+        "lrn",
+        "pallas_one_pass" if LRNormalizerForward._shim_prefer_pallas
+        else ("cached_residual" if LRNormalizerForward._shim_cache_bwd
+              else "banded_matmul"))
+
+
+class _LRNShimMeta(type):
+    """Deprecation shims: `LRNormalizerForward.prefer_pallas = x` /
+    `.cache_bwd = x` (the r4/r5 hand-flip knobs) write through to the
+    lowering-variant registry — the fused-step build path no longer
+    reads these attributes (it consults `variants.resolve("lrn")` at
+    trace time)."""
+
+    @property
+    def prefer_pallas(cls) -> bool:
+        return cls._shim_prefer_pallas
+
+    @prefer_pallas.setter
+    def prefer_pallas(cls, value) -> None:
+        variants.warn_deprecated_knob(
+            "LRNormalizerForward.prefer_pallas",
+            'variants.select("lrn", "pallas_one_pass")')
+        cls._shim_prefer_pallas = bool(value)
+        _lrn_shim_select()
+
+    @property
+    def cache_bwd(cls) -> bool:
+        return cls._shim_cache_bwd
+
+    @cache_bwd.setter
+    def cache_bwd(cls, value) -> None:
+        variants.warn_deprecated_knob(
+            "LRNormalizerForward.cache_bwd",
+            'variants.select("lrn", "cached_residual")')
+        cls._shim_cache_bwd = bool(value)
+        _lrn_shim_select()
+
+
+class LRNormalizerForward(Forward, metaclass=_LRNShimMeta):
     """y = x · (k + α·Σ_window x²)^(−β), window of n channels."""
+
+    #: lowering-variant registry op this unit consults at fused trace
+    #: time (candidates: banded_matmul | cached_residual |
+    #: pallas_one_pass; tools/autotune.py picks and persists the winner)
+    variant_op = "lrn"
 
     def __init__(self, workflow=None, k: float = 2.0, alpha: float = 1e-4,
                  beta: float = 0.75, n: int = 5, **kwargs: Any) -> None:
@@ -53,30 +100,35 @@ class LRNormalizerForward(Forward):
                                     n=self.n))
         return None
 
-    #: opt-in: the Pallas LRN (custom_vjp, ops.pallas_kernels.lrn_pallas).
-    #: The ORIGINAL kernel measured slower inside the fused AlexNet step
-    #: on v5e (6.5k vs 9.5k samples/s, 2026-07-29: forced-f32 HBM I/O +
-    #: fusion barrier). Rewritten 2026-07-31 (native-dtype bf16 I/O,
-    #: sqrt/rsqrt pow, 1MB tiles) after the banded-matmul XLA path still
-    #: measured ~24% of the step; the fused-step A/B
-    #: (tools/ablate_lrn.py) decides whether this default flips.
-    #: (FusedTrainStep clears it under GSPMD auto-partitioning either
-    #: way — a pallas_call cannot be auto-partitioned.)
-    prefer_pallas = False
+    #: DEPRECATED shim state (see _LRNShimMeta): the variant choice lives
+    #: in the registry now; these only back the legacy attribute reads.
+    _shim_prefer_pallas = False
+    _shim_cache_bwd = False
 
-    #: opt-in: stash the forward's d=s^(−β) and s as residuals so the
-    #: custom-VJP backward drops one window dot and the whole pow chain
-    #: (ROOFLINE.md r4 "cache the forward window-dot" attack) at the
-    #: cost of two activation-sized residuals. On-chip A/B
-    #: (tools/ablate_lrn.py) decides the default.
-    cache_bwd = False
+    @property
+    def prefer_pallas(self) -> bool:
+        return type(self)._shim_prefer_pallas
+
+    @property
+    def cache_bwd(self) -> bool:
+        return type(self)._shim_cache_bwd
+
+    def variant_signature(self):
+        """Autotune cache-key payload (None = not tunable as configured).
+        Batch dim excluded ON PURPOSE: winners tuned at one batch must
+        apply when bench/training runs at another (tune-then-inherit)."""
+        if getattr(self, "variant_override", None) is not None \
+                or not self.input:
+            return None
+        return {"sample_shape": list(self.input.shape[1:]),
+                "dtype": str(np.asarray(self.input.mem).dtype),
+                "params": {"k": self.k, "alpha": self.alpha,
+                           "beta": self.beta, "n": self.n}}
 
     def fused_apply(self, params, x, *, key=None, train=True):
-        from veles_tpu.ops import pallas_kernels as pk
-        if self.prefer_pallas and pk.available():
-            return pk.lrn_pallas(x, self.k, self.alpha, self.beta, self.n)
-        return ox.lrn_forward(x, self.k, self.alpha, self.beta, self.n,
-                              cache_bwd=self.cache_bwd)
+        v = variants.resolve("lrn", unit=self)
+        return v.apply(x, k=self.k, alpha=self.alpha, beta=self.beta,
+                       n=self.n)
 
     def numpy_run(self) -> None:
         self.output.mem = ref.lrn_forward(self.input.mem, self.k, self.alpha,

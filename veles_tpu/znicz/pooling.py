@@ -21,6 +21,7 @@ import numpy as np
 from veles_tpu import prng
 from veles_tpu.memory import Array
 from veles_tpu.ops import reference as ref
+from veles_tpu.ops import variants
 from veles_tpu.ops import xla as ox
 from veles_tpu.znicz.nn_units import Forward
 
@@ -54,24 +55,61 @@ class Pooling(Forward):
         return super().initialize(device=device, **kwargs)
 
 
-class MaxPooling(Pooling):
+class _PoolShimMeta(type):
+    """Deprecation shim: `MaxPooling.lowering = "slices"` (the hand-flip
+    knob) writes through to the lowering-variant registry; the fused
+    build path consults `variants.resolve("maxpool")` at trace time."""
+
+    @property
+    def lowering(cls) -> str:
+        return variants.effective("maxpool")
+
+    @lowering.setter
+    def lowering(cls, value) -> None:
+        variants.warn_deprecated_knob(
+            "MaxPooling.lowering", f'variants.select("maxpool", {value!r})')
+        variants.select("maxpool", value)   # validates the name
+
+
+class MaxPooling(Pooling, metaclass=_PoolShimMeta):
     use_abs = False
 
-    #: fused-step lowering: "reduce_window" (backward = select_and_scatter)
-    #: or "slices" (max-fold over shifted strided slices; backward =
-    #: selects + pads). Layer dict key "lowering" overrides per layer;
-    #: measured on chip via tools/ablate.py "slicepool" variant.
-    lowering = "reduce_window"
+    #: lowering-variant registry op (candidates: "reduce_window" —
+    #: backward = select_and_scatter — or "slices" — max-fold over
+    #: shifted strided slices, backward = selects + pads). The layer
+    #: dict key "lowering" stays a per-layer override; the global
+    #: choice is the registry's (tools/autotune.py).
+    variant_op = "maxpool"
+
+    #: class-level default so instances restored from PRE-registry
+    #: pickled snapshots (whose __dict__ lacks the attribute) still
+    #: resolve/report instead of raising AttributeError
+    variant_override = None
 
     def __init__(self, workflow=None,
                  lowering: Optional[str] = None, **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
+        #: explicit per-layer lowering (wins over the registry selection)
+        self.variant_override = None
         if lowering is not None:
-            if lowering not in ("reduce_window", "slices"):
-                raise ValueError(f"unknown maxpool lowering {lowering!r}")
-            self.lowering = lowering
+            variants.get("maxpool", lowering)   # validates
+            self.variant_override = lowering
         #: flat winner offsets into input (numpy path; backward scatter)
         self.input_offset = Array()
+
+    @property
+    def lowering(self) -> str:
+        return self.variant_override or variants.effective("maxpool")
+
+    def variant_signature(self):
+        # batch dim excluded: tune-then-inherit across batch sizes
+        if self.variant_override is not None or not self.input:
+            return None
+        return {"sample_shape": list(self.input.shape[1:]),
+                "dtype": str(np.asarray(self.input.mem).dtype),
+                "params": {"ksize": list(self.ksize),
+                           "stride": list(self.stride),
+                           "use_abs": bool(self.use_abs)}}
 
     def xla_init(self):
         self._fn = self.jit(partial(ox.maxpool_forward_with_idx,
@@ -80,17 +118,8 @@ class MaxPooling(Pooling):
         return None
 
     def fused_apply(self, params, x, *, key=None, train=True):
-        if self.lowering == "slices":
-            # differentiable for max AND maxabs (selects + pads backward)
-            return ox.maxpool_forward_slices(x, self.ksize, self.stride,
-                                             self.use_abs)
-        if self.use_abs:
-            # the custom-comparator reduce_window has no reverse-mode rule;
-            # the patches/argmax formulation differentiates (gather vjp)
-            return ox.maxpool_forward_with_idx(x, self.ksize, self.stride,
-                                               use_abs=True)[0]
-        # reduce_window flavor: differentiable, no offsets materialized
-        return ox.maxpool_forward(x, self.ksize, self.stride, False)
+        v = variants.resolve("maxpool", unit=self)
+        return v.apply(x, self.ksize, self.stride, self.use_abs)
 
     def numpy_run(self) -> None:
         y, idx = ref.maxpool_forward(self.input.mem, self.ksize, self.stride,

@@ -278,6 +278,17 @@ class StandardWorkflow(Workflow):
         return FusedTrainStep(self, mesh=mesh, mode=mode,
                               compute_dtype=compute_dtype, ep=ep)
 
+    def autotune(self, mesh=None, compute_dtype=None, **kwargs: Any):
+        """Pick the fastest registered lowering for every tunable op this
+        workflow contains (LRN, max-pooling, s2d stem, dropout RNG, and
+        anything registered since) by timing candidates in-graph, and
+        persist the decisions (ops.autotune cache). Selections are left
+        in the registry, so the next build_fused_step/run_fused traces
+        the winners. Returns the per-op report. CLI: `--autotune`."""
+        from veles_tpu.ops.autotune import autotune_workflow
+        return autotune_workflow(self, mesh=mesh,
+                                 compute_dtype=compute_dtype, **kwargs)
+
     def build_pipeline_step(self, mesh, n_microbatches: int = 4,
                             boundaries=None, compute_dtype=None):
         """Compile the chain as an S-stage GPipe pipeline over `mesh`'s
