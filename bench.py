@@ -75,6 +75,23 @@ PEAK_TFLOPS = {
 }
 
 
+def _audit_record(step, x_shape, y_shape=None, state=None) -> dict:
+    """Jaxpr-audit summary (analysis/trace.py) embedded in the record
+    next to `variants`: the measured number ships with the auditor's
+    verdict on the step that produced it (dtype leaks, host syncs,
+    dropped donation, sharding drift). Host-side trace only — values are
+    zeros, no device transfer — and guarded: analysis must never cost
+    the measured value."""
+    try:
+        from veles_tpu.analysis.findings import summarize
+        from veles_tpu.analysis.trace import audit_fused_step
+        x = np.zeros(x_shape, np.float32)
+        y = np.zeros(y_shape or (x_shape[0],), np.int32)
+        return summarize(audit_fused_step(step, x, y, state=state))
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def analytic_flops_per_sample(step) -> tuple:
     """(train_flops, per-layer forward GFLOPs) from the fused step's
     forward units. Counts MXU work (conv + matmul MACs) over EVERY
@@ -282,6 +299,9 @@ def child_main() -> None:
         # the lowerings that produced this number (ops.variants): the
         # driver finally sees WHICH variant table was measured
         "variants": step.variant_table(),
+        # the jaxpr auditor's verdict on the step that was measured
+        # (analysis pass 2; docs/ANALYSIS.md)
+        "analysis": _audit_record(step, in_shape, state=state),
         "train_gflops_per_sample": round(train_flops / 1e9, 3),
         "fwd_layer_gflops_per_sample": layer_gflops,
         "scaling_prediction_v5e64": scaling_rec,
@@ -529,6 +549,11 @@ def _compact(rec, record_path) -> dict:
     when the file write FAILED — the line must then not point the
     driver at a stale file from a previous run."""
     out = {k: rec[k] for k in _COMPACT_KEYS if k in rec}
+    ana = rec.get("analysis")
+    if isinstance(ana, dict) and "errors" in ana:
+        # counts only: the per-finding detail lives in the record file
+        out["analysis"] = {"errors": ana["errors"],
+                           "warnings": ana["warnings"]}
     if rec.get("error"):
         out["error"] = str(rec["error"])[:200]
     e2e = rec.get("e2e")

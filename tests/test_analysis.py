@@ -1,0 +1,592 @@
+"""ISSUE 3: the static-analysis subsystem (veles_tpu/analysis/).
+
+Three passes, each proven both ways: a seeded defect every rule must
+catch, and a clean build that must produce zero errors.
+
+- graph verifier: dangling/shadowed aliases, AND-gate cycles,
+  unreachable units, endpoint reachability, read-before-write flows;
+- jaxpr auditor: f64 promotion, host syncs, dropped donation, retrace
+  hazards, sharding mismatch — all on CPU via jax.make_jaxpr (no
+  compile);
+- velint: the AST lint rules + suppression + the ratchet baseline, and
+  the repo-wide `tools/velint.py --ci` gate itself (tier-1 CI smoke).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.analysis import lint, verify_workflow
+from veles_tpu.analysis.findings import SEV_ERROR
+from veles_tpu.analysis.graph import WorkflowVerifyError
+from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+from veles_tpu.units import LinkError, TrivialUnit, Unit
+from veles_tpu.workflow import Repeater, Workflow
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def build_standard(minibatch_size=32, layers=None, max_epochs=1):
+    prng.seed_all(1234)
+    loader = SyntheticClassifierLoader(
+        n_classes=10, sample_shape=(6, 6), n_validation=64, n_train=128,
+        minibatch_size=minibatch_size)
+    return StandardWorkflow(
+        layers=layers or [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "weights_stddev": 0.05},
+            {"type": "softmax", "output_sample_shape": 10,
+             "weights_stddev": 0.05},
+        ],
+        loader=loader, loss="softmax", n_classes=10,
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1}, name="AnalysisFixture")
+
+
+# == pass 1: graph verifier ===================================================
+
+def test_clean_standard_workflow_has_zero_findings():
+    assert verify_workflow(build_standard()) == []
+
+
+def test_link_attrs_validates_eagerly_naming_both_units():
+    wf = Workflow(name="w")
+    a = TrivialUnit(wf, name="alpha")
+    b = TrivialUnit(wf, name="beta")
+    with pytest.raises(LinkError) as ei:
+        b.link_attrs(a, "missing_attr")
+    msg = str(ei.value)
+    assert "alpha" in msg and "beta" in msg and "missing_attr" in msg
+    # LinkError subclasses AttributeError: legacy handlers keep working
+    assert isinstance(ei.value, AttributeError)
+
+
+def test_link_attrs_late_opt_out_and_dangling_alias_finding():
+    wf = Workflow(name="w")
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    b.link_attrs(a, "lazy", late=True)      # opt-out: no raise
+    b.link_from(wf.start_point)
+    wf.end_point.link_from(b)
+    findings = verify_workflow(wf)
+    assert rules(findings) == ["dangling-alias"]
+    # declared late-bound: pre-initialize verification only warns (the
+    # attribute is EXPECTED to appear at the source's initialize());
+    # initialize(verify="error") must stay usable with late links
+    assert findings[0].severity == "warn"
+    wf.initialize(verify="error")
+    a.lazy = 1                              # source appears -> clean
+    assert verify_workflow(wf) == []
+    # the same dangle WITHOUT the late marker is an error
+    c = TrivialUnit(wf, name="c")
+    c.__dict__["_linked_attrs"]["ghost"] = (a, "ghost")  # bypass eager
+    c.link_from(b)
+    findings2 = [f for f in verify_workflow(wf)
+                 if f.rule == "dangling-alias"]
+    assert findings2 and findings2[0].severity == SEV_ERROR
+
+
+def test_shadowed_alias_warns():
+    class Shadowed(TrivialUnit):
+        marker = "class-attr"
+
+    wf = Workflow(name="w")
+    src = TrivialUnit(wf, name="src")
+    src.marker = 7
+    u = Shadowed(wf, name="u")
+    u.link_attrs(src, "marker")
+    found = [f for f in verify_workflow(wf) if f.rule == "shadowed-alias"]
+    assert found and found[0].severity == "warn"
+
+
+def test_and_gate_cycle_is_error_and_repeater_breaks_it():
+    wf = Workflow(name="w")
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    a.link_from(b)                          # AND-gate loop: deadlock
+    wf.end_point.link_from(b)
+    assert "control-cycle" in rules(verify_workflow(wf))
+
+    wf2 = Workflow(name="w2")
+    r = Repeater(wf2, name="rep")
+    c = TrivialUnit(wf2, name="c")
+    r.link_from(wf2.start_point)
+    c.link_from(r)
+    r.link_from(c)                          # same loop through an OR gate
+    wf2.end_point.link_from(c)
+    assert verify_workflow(wf2) == []
+
+
+def test_unreachable_and_endpoint_unreachable():
+    wf = Workflow(name="w")
+    a = TrivialUnit(wf, name="a")
+    a.link_from(wf.start_point)             # end_point never linked
+    stranded = TrivialUnit(wf, name="stranded")
+    feeder = TrivialUnit(wf, name="feeder")
+    stranded.link_from(feeder)              # island: no path from start
+    findings = verify_workflow(wf)
+    got = rules(findings)
+    assert "unreachable" in got and "endpoint-unreachable" in got
+    names = {f.unit for f in findings if f.rule == "unreachable"}
+    assert any("stranded" in n for n in names)
+
+
+def test_read_before_write_warns_only_without_a_producer_path():
+    wf = Workflow(name="w")
+    prod = TrivialUnit(wf, name="prod")
+    prod.value = 0
+    cons = TrivialUnit(wf, name="cons")
+    cons.link_attrs(prod, "value")
+    cons.link_from(wf.start_point)
+    prod.link_from(cons)                    # producer fires AFTER consumer
+    wf.end_point.link_from(prod)
+    findings = verify_workflow(wf)
+    assert rules(findings) == ["read-before-write"]
+    assert all(f.severity == "warn" for f in findings)
+    # reverse the order: producer upstream -> clean
+    wf2 = Workflow(name="w2")
+    p2 = TrivialUnit(wf2, name="p2")
+    p2.value = 0
+    c2 = TrivialUnit(wf2, name="c2")
+    c2.link_attrs(p2, "value")
+    p2.link_from(wf2.start_point)
+    c2.link_from(p2)
+    wf2.end_point.link_from(c2)
+    assert verify_workflow(wf2) == []
+
+
+def test_unwired_container_skips_reachability_rules():
+    wf = Workflow(name="bare")             # fused-only style container
+    TrivialUnit(wf, name="floating")
+    assert verify_workflow(wf) == []
+
+
+def test_initialize_verify_modes():
+    wf = Workflow(name="w")
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    a.link_from(b)
+    wf.end_point.link_from(b)
+    with pytest.raises(WorkflowVerifyError) as ei:
+        wf.initialize(verify="error")
+    assert any(f.rule == "control-cycle" for f in ei.value.findings)
+    wf.initialize(verify="warn")            # default policy: log only
+    wf.initialize(verify="off")
+    with pytest.raises(ValueError):
+        wf.initialize(verify="nonsense")
+
+
+# == pass 2: jaxpr auditor ====================================================
+
+def audit(step, wf, **kw):
+    from veles_tpu.analysis.trace import audit_fused_step
+    x = wf.loader.minibatch_data.mem
+    y = wf.loader.minibatch_labels.mem
+    return audit_fused_step(step, x, y, **kw)
+
+
+@pytest.fixture
+def fused_wf():
+    wf = build_standard()
+    wf.initialize(device=None, verify="off")
+    return wf
+
+
+def test_audit_clean_local_step_zero_findings(fused_wf):
+    step = fused_wf.build_fused_step()
+    assert audit(step, fused_wf) == []
+
+
+def test_audit_clean_dp_and_gspmd_steps(fused_wf, eight_devices):
+    from veles_tpu.parallel import make_mesh
+    for kw in (dict(mesh=make_mesh(eight_devices), mode="dp"),
+               dict(mesh=make_mesh(eight_devices, model=2),
+                    mode="gspmd")):
+        step = fused_wf.build_fused_step(**kw)
+        assert audit(step, fused_wf) == [], kw
+
+
+def test_audit_flags_f64_promotion(fused_wf, monkeypatch):
+    from veles_tpu._compat import enable_x64
+    from veles_tpu.znicz.all2all import All2AllTanh
+    orig = All2AllTanh.fused_apply
+
+    def leaky(self, params, x, *, key=None, train=True):
+        # np.float64 scalar * array promotes under x64 — the classic
+        # weak-type leak the auditor exists to catch pre-compile
+        return orig(self, params, x, key=key, train=train) \
+            * np.float64(1.0)
+
+    monkeypatch.setattr(All2AllTanh, "fused_apply", leaky)
+    step = fused_wf.build_fused_step()
+    with enable_x64():
+        findings = audit(step, fused_wf)
+    assert "f64-promotion" in rules(findings)
+    assert any(f.severity == SEV_ERROR for f in findings)
+
+
+def test_audit_flags_host_sync(fused_wf, monkeypatch):
+    from veles_tpu.znicz.all2all import All2AllTanh
+    orig = All2AllTanh.fused_apply
+
+    def chatty(self, params, x, *, key=None, train=True):
+        jax.debug.print("x sum {}", x.sum())
+        return orig(self, params, x, key=key, train=train)
+
+    monkeypatch.setattr(All2AllTanh, "fused_apply", chatty)
+    step = fused_wf.build_fused_step()
+    assert "host-sync" in rules(audit(step, fused_wf))
+
+
+def test_audit_flags_dropped_donation(fused_wf, monkeypatch):
+    import jax.numpy as jnp
+
+    from veles_tpu.znicz.all2all import All2AllTanh
+    orig = All2AllTanh.fused_apply
+    u0 = fused_wf.forwards[0]
+    captured = jnp.asarray(u0.weights.mem)   # unit reads its own Array
+
+    def const_reader(self, params, x, *, key=None, train=True):
+        if self is u0:
+            params = dict(params, weights=captured)
+        return orig(self, params, x, key=key, train=train)
+
+    monkeypatch.setattr(All2AllTanh, "fused_apply", const_reader)
+    step = fused_wf.build_fused_step()
+    assert "donation-dropped" in rules(audit(step, fused_wf))
+
+
+def test_audit_flags_retrace_hazard(fused_wf):
+    step = fused_wf.build_fused_step()
+    state = step.init_state()
+    state["lr_scale"] = 1.0                  # python float in carry
+    findings = audit(step, fused_wf, state=state)
+    assert "retrace-hazard" in rules(findings)
+    assert any("lr_scale" in f.unit for f in findings)
+
+
+def test_audit_flags_sharding_mismatch(fused_wf, eight_devices):
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.parallel import make_mesh
+    mesh = make_mesh(eight_devices, model=4)
+    step = fused_wf.build_fused_step(mesh=mesh, mode="gspmd")
+    plan, flags = step._tp_plan()
+    bad = [dict(d) for d in plan]
+    bad[1]["weights"] = P(None, "model")     # (16, 10): 10 % 4 != 0
+    step._tp_plan = lambda: (tuple(bad), flags)
+    findings = audit(step, fused_wf)
+    assert rules(findings) == ["sharding-mismatch"]
+    assert all(f.severity == SEV_ERROR for f in findings)
+
+
+def test_audit_nonfinite_guard_warning(fused_wf):
+    step = fused_wf.build_fused_step()
+    findings = audit(step, fused_wf, nonfinite_guard=False)
+    assert rules(findings) == ["nonfinite-guard-off"]
+    assert audit(step, fused_wf, nonfinite_guard=True) == []
+
+
+def test_audit_pipeline_step(fused_wf, eight_devices):
+    from veles_tpu._compat import GRAD_TRANSPOSE_PSUM
+    from veles_tpu.parallel.pipeline import make_stage_mesh
+    mesh = make_stage_mesh(eight_devices[:2])
+    step = fused_wf.build_pipeline_step(mesh, n_microbatches=2)
+    findings = audit(step, fused_wf)
+    got = rules(findings)
+    if GRAD_TRANSPOSE_PSUM:
+        assert "pre-vma-numerics" not in got
+    else:
+        # the structured twin of warn_pre_vma_numerics' log line
+        assert "pre-vma-numerics" in got
+    assert not [f for f in findings if f.severity == SEV_ERROR]
+
+
+def test_environment_findings_parse_child_argv():
+    from veles_tpu._compat import GRAD_TRANSPOSE_PSUM
+    from veles_tpu.analysis.trace import environment_findings
+    fs = environment_findings(argv=["wf.py", "--pp", "4"])
+    got = rules(fs)
+    assert "nonfinite-guard-off" in got
+    assert ("pre-vma-numerics" in got) == (not GRAD_TRANSPOSE_PSUM)
+    fs2 = environment_findings(
+        argv=["wf.py", "--sp=2", "--tp=2", "--nonfinite-guard"])
+    assert ("pre-vma-numerics" in rules(fs2)) \
+        == (not GRAD_TRANSPOSE_PSUM)
+    assert "nonfinite-guard-off" not in rules(fs2)
+    # --debug-nans counts as a guard for the granular path
+    fs3 = environment_findings(argv=["wf.py", "--debug-nans"])
+    assert "nonfinite-guard-off" not in rules(fs3)
+
+
+def test_supervisor_exit_report_embeds_analysis(tmp_path):
+    from veles_tpu.resilience.supervisor import Supervisor
+    report = tmp_path / "report.json"
+    sup = Supervisor(
+        [[sys.executable, "-c", "pass", "--pp", "2"]],
+        snapshot_dir=str(tmp_path), report_path=str(report),
+        max_restarts=0)
+    assert sup.run() == 0
+    data = json.loads(report.read_text())
+    assert "analysis" in data
+    got = {f["rule"] for f in data["analysis"]}
+    assert "nonfinite-guard-off" in got
+    from veles_tpu._compat import GRAD_TRANSPOSE_PSUM
+    if not GRAD_TRANSPOSE_PSUM:
+        assert "pre-vma-numerics" in got
+
+
+# == granular non-finite guard (ROADMAP gap closed) ===========================
+
+def test_granular_nonfinite_guard_raises(monkeypatch):
+    from veles_tpu.resilience import NonFiniteLossError
+    from veles_tpu.znicz.evaluator import EvaluatorSoftmax
+    wf = build_standard(max_epochs=3)
+    wf.decision.nonfinite_guard = True
+    wf.initialize(device=None)
+    orig = EvaluatorSoftmax.xla_run
+
+    def poisoned(self):
+        orig(self)
+        self.loss = float("nan")
+
+    monkeypatch.setattr(EvaluatorSoftmax, "xla_run", poisoned)
+    with pytest.raises(NonFiniteLossError):
+        wf.run()
+
+
+def test_granular_guard_never_rides_into_snapshots():
+    import pickle
+    wf = build_standard()
+    wf.decision.nonfinite_guard = True       # Launcher-armed form
+    restored = pickle.loads(pickle.dumps(wf.decision))
+    # class attribute default again: a restored run re-opts-in via its
+    # own CLI flags, never inherits the snapshot writer's
+    assert restored.nonfinite_guard is False
+    assert "nonfinite_guard" not in restored.__dict__
+
+
+def test_granular_guard_off_trains_through(monkeypatch):
+    # same poison, guard off: legacy behavior (trains on) is preserved
+    from veles_tpu.znicz.evaluator import EvaluatorSoftmax
+    wf = build_standard(max_epochs=1)
+    wf.initialize(device=None)
+    orig = EvaluatorSoftmax.xla_run
+
+    def poisoned(self):
+        orig(self)
+        self.loss = float("nan")
+
+    monkeypatch.setattr(EvaluatorSoftmax, "xla_run", poisoned)
+    wf.run()                                 # completes epoch 1
+
+
+# == pass 3: velint ===========================================================
+
+def lint_rules(src):
+    return sorted({f.rule for f in lint.lint_source(src)})
+
+
+def test_velint_hot_sync_in_run_and_xla_run():
+    src = (
+        "import numpy as np\n"
+        "import jax\n"
+        "class U:\n"
+        "    def run(self):\n"
+        "        a = np.asarray(self.output.devmem())\n"
+        "    def xla_run(self):\n"
+        "        b = jax.device_get(self.x)\n"
+        "        c = self.loss.item()\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["hot-sync"] * 3
+    assert sorted(f.line for f in findings) == [5, 7, 8]
+
+
+def test_velint_numpy_run_is_exempt_and_module_level_clean():
+    src = (
+        "import numpy as np\n"
+        "class U:\n"
+        "    def numpy_run(self):\n"
+        "        return np.asarray(self.input.mem)\n"
+        "x = np.asarray([1])\n"
+    )
+    assert lint.lint_source(src) == []
+
+
+def test_velint_jit_in_loop():
+    src = (
+        "import jax\n"
+        "def build(fns):\n"
+        "    out = []\n"
+        "    for f in fns:\n"
+        "        out.append(jax.jit(f))\n"
+        "    return out\n"
+        "hoisted = jax.jit(len)\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["jit-in-loop"]
+    assert findings[0].line == 5
+
+
+def test_velint_trace_time_rules():
+    src = (
+        "import jax, time, random\n"
+        "class U:\n"
+        "    def fused_apply(self, params, x):\n"
+        "        return x * random.random()\n"
+        "def outer(self):\n"
+        "    def step(s):\n"
+        "        return s + time.time()\n"
+        "    return jax.jit(step)\n"
+        "def host_path():\n"
+        "    return time.time()\n"          # untraced: fine
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["trace-time"] * 2
+    assert sorted(f.line for f in findings) == [4, 7]
+
+
+def test_velint_trace_time_in_jitted_lambda_and_while_test():
+    src = (
+        "import jax, time\n"
+        "class U:\n"
+        "    def xla_init(self):\n"
+        "        self._fn = self.jit(lambda x: x * time.time())\n"
+        "def spin(x):\n"
+        "    while jax.jit(len)(x) > 0:\n"
+        "        x = x[1:]\n"
+    )
+    findings = lint.lint_source(src)
+    assert sorted((f.rule, f.line) for f in findings) == [
+        ("jit-in-loop", 6),       # While tests re-run every iteration
+        ("trace-time", 4),        # lambda passed to self.jit IS traced
+    ]
+
+
+def test_velint_lock_no_with():
+    src = (
+        "def bad(self):\n"
+        "    self._lock.acquire()\n"
+        "    self.n += 1\n"
+        "    self._lock.release()\n"
+        "def good(self):\n"
+        "    with self._lock:\n"
+        "        self.n += 1\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["lock-no-with"]
+    assert findings[0].line == 2
+
+
+def test_velint_suppression_same_line_and_line_above():
+    src = (
+        "import numpy as np\n"
+        "class U:\n"
+        "    def run(self):\n"
+        "        a = np.asarray(self.x)  # velint: disable=hot-sync\n"
+        "        # velint: disable=hot-sync\n"
+        "        b = np.asarray(self.y)\n"
+        "        c = np.asarray(self.z)  # velint: disable=jit-in-loop\n"
+    )
+    findings = lint.lint_source(src)
+    # only the mismatched suppression still fires
+    assert len(findings) == 1 and findings[0].line == 7
+    src_all = src.replace("disable=jit-in-loop", "disable=all")
+    assert lint.lint_source(src_all) == []
+
+
+def test_velint_baseline_is_ratchet_only():
+    src = (
+        "import numpy as np\n"
+        "class U:\n"
+        "    def run(self):\n"
+        "        a = np.asarray(self.x)\n"
+    )
+    old = lint.lint_source(src, path="m.py")
+    baseline = lint.baseline_counts(old)
+    fresh, over = lint.new_findings(old, baseline)
+    assert fresh == [] and over == {}        # same tree: gate passes
+    worse = src + "        b = np.asarray(self.y)\n"
+    fresh2, over2 = lint.new_findings(
+        lint.lint_source(worse, path="m.py"), baseline)
+    assert len(fresh2) == 1                  # only the NEW one fails CI
+    assert over2 == {"m.py::hot-sync": 1}
+
+
+def test_lazy_trace_reexports_do_not_recurse():
+    # `from veles_tpu.analysis import audit_workflow` goes through the
+    # package __getattr__; a from-import inside that hook recursed
+    # (caught by the verify drive, not the direct-import tests)
+    import veles_tpu.analysis as ana
+    assert callable(ana.audit_workflow)
+    assert callable(ana.audit_fused_step)
+    assert callable(ana.environment_findings)
+    assert hasattr(ana.trace, "iter_eqns")
+    with pytest.raises(AttributeError):
+        ana.no_such_symbol
+
+
+# == CI gates (tier-1 smoke) ==================================================
+
+def test_velint_ci_runs_clean_on_this_repo():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "velint.py"),
+         "--ci"], capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_verify_workflow_cli_clean_sample():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "--verify-workflow",
+         os.path.join(REPO, "veles_tpu", "samples", "mnist_simple.py")],
+        capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "verify-workflow: 0 error(s)" in out.stdout
+
+
+def test_verify_workflow_cli_broken_module_exits_nonzero(tmp_path):
+    broken = tmp_path / "broken_wf.py"
+    broken.write_text(
+        "from veles_tpu.units import TrivialUnit\n"
+        "from veles_tpu.workflow import Workflow\n\n\n"
+        "def create():\n"
+        "    wf = Workflow(name='Broken')\n"
+        "    a = TrivialUnit(wf, name='a')\n"
+        "    b = TrivialUnit(wf, name='b')\n"
+        "    a.link_from(wf.start_point)\n"
+        "    b.link_from(a)\n"
+        "    a.link_from(b)        # AND-gate cycle\n"
+        "    wf.end_point.link_from(b)\n"
+        "    b.link_attrs(a, 'ghost', late=True)   # dangling alias\n"
+        "    return wf\n\n\n"
+        "def run(load, main):\n"
+        "    load(create)\n"
+        "    main()\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "--verify-workflow",
+         str(broken)],
+        capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "dangling-alias" in out.stdout
+    assert "control-cycle" in out.stdout

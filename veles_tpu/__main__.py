@@ -80,6 +80,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
+    p.add_argument("--verify-workflow", action="store_true",
+                   help="statically verify the constructed workflow "
+                        "(analysis pass: dangling/shadowed link_attrs "
+                        "aliases, AND-gate control cycles, unreachable "
+                        "units, read-before-write flows, plus "
+                        "environment findings like pre-vma numerics), "
+                        "print the findings and exit nonzero on errors "
+                        "WITHOUT training — docs/ANALYSIS.md")
     p.add_argument("--serve", nargs="?", const=0, default=None, type=int,
                    metavar="PORT",
                    help="serve the (snapshot-restored) model over HTTP "
@@ -267,7 +275,10 @@ def main(argv=None) -> int:
         _import_file(args.config, "veles_config")
     apply_overrides(args.overrides)
 
-    if (args.listen or args.master) and not args.optimize:
+    if (args.listen or args.master) and not args.optimize \
+            and not args.verify_workflow:
+        # verify-only runs never touch the backend: joining the SPMD job
+        # would block on peers for a static check
         # MUST run before make_device: jax.distributed.initialize rejects
         # any call after the XLA backend is touched (found by live drive;
         # the Launcher's boot_distributed is idempotent and will no-op).
@@ -293,7 +304,13 @@ def main(argv=None) -> int:
         serve=args.serve, accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,
         compile_cache=not args.no_compile_cache,
-        nonfinite_guard=args.nonfinite_guard)
+        nonfinite_guard=args.nonfinite_guard,
+        verify_workflow=args.verify_workflow)
+    if args.verify_workflow:
+        # takes precedence over every execution mode (incl. --optimize,
+        # which otherwise bypasses Launcher.main entirely): the flag
+        # promises "exit nonzero on errors WITHOUT training"
+        return launcher.run_module(module)
     if args.optimize:
         if args.serve is not None:
             raise SystemExit("--serve and --optimize are exclusive modes")

@@ -1,0 +1,41 @@
+"""Static analysis for veles_tpu: make wiring, tracing and hot-path
+mistakes checkable BEFORE anything runs — on CPU, in CI.
+
+Three passes (docs/ANALYSIS.md has the full rule catalogue):
+
+- `graph`  — workflow-graph verifier over a constructed `Workflow`
+  (dangling/shadowed aliases, AND-gate cycles, unreachable units,
+  read-before-write alias flows). Runs at `Workflow.initialize(verify=)`
+  and via `python -m veles_tpu --verify-workflow`.
+- `trace`  — jaxpr auditor over the fused/pipelined train step
+  (dtype promotion, host syncs, dropped donation, sharding drift,
+  retrace hazards). `jax.make_jaxpr` only: no compile, no devices.
+- `lint`   — `velint`, the project AST lint (`tools/velint.py --ci` is
+  the ratchet-only CI gate).
+
+`findings.Finding` is the shared record all passes emit. `graph`/`lint`
+import without jax; `trace` is loaded lazily so import-light consumers
+(the supervisor's exit report) can guard it.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.analysis.findings import (SEV_ERROR, SEV_WARN,  # noqa: F401
+                                         Finding, errors, summarize)
+from veles_tpu.analysis.graph import (WorkflowVerifyError,  # noqa: F401
+                                      verify_workflow)
+from veles_tpu.analysis.lint import lint_paths, lint_source  # noqa: F401
+
+
+def __getattr__(name: str):
+    # trace imports jax; load it only when actually used. importlib, not
+    # `from ... import trace`: the from-import re-enters THIS hook while
+    # the submodule is still unimported and recurses.
+    if name in ("audit_fused_step", "audit_workflow",
+                "environment_findings", "trace"):
+        import importlib
+        trace = importlib.import_module("veles_tpu.analysis.trace")
+        if name == "trace":
+            return trace
+        return getattr(trace, name)
+    raise AttributeError(name)

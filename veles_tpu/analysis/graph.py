@@ -1,0 +1,260 @@
+"""Static workflow-graph verifier (analysis pass 1 of 3).
+
+Walks a CONSTRUCTED `Workflow` — no initialize, no run — and reports the
+wiring mistakes that today surface as deep `AttributeError`s or hangs in
+the middle of `Workflow.run()`:
+
+- `dangling-alias` (error; warn for `late=True` links): a `link_attrs`
+  alias whose target attribute does not exist on the source unit (first
+  read inside run() would raise a bare AttributeError far from the
+  wiring site). Links declared `late=True` expect their attribute to
+  appear at initialize(), so pre-init verification only warns;
+- `shadowed-alias` (warn): a linked name that a class attribute (or a
+  stray instance attribute) shadows — `Unit.__getattr__` only resolves
+  aliases when NORMAL lookup fails, so the alias silently never fires;
+- `control-cycle` (error): a control-link cycle containing no OR-gate
+  unit (`Repeater`): every member AND-waits on its in-links, including
+  the cycle's own back-edge, so no pulse can ever complete the loop —
+  the workflow hangs on first entry;
+- `unreachable` (error): a unit wired into the control graph that no
+  pulse path from `StartPoint` reaches (it never fires, and anything
+  AND-gated on it never fires either);
+- `endpoint-unreachable` (error): no pulse path from `StartPoint` to
+  `EndPoint` — `run()` can only terminate via an explicit `stop()`;
+- `read-before-write` (warn): a pulse-driven unit consumes an alias
+  whose source unit participates in the control graph but can never
+  fire before the consumer — the consumer reads whatever initialization
+  left behind.
+
+Workflows whose pulse graph is entirely unwired (fused-only containers,
+bare test fixtures) skip the reachability rules: there is no schedule to
+verify. The alias rules always run.
+
+Entry points: `verify_workflow(workflow)` returns the findings;
+`Workflow.initialize(verify="error"|"warn"|"off")` (default "warn") runs
+the pass at initialization; `python -m veles_tpu --verify-workflow`
+runs it from the CLI and exits nonzero on errors without training.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Set
+
+from veles_tpu.analysis.findings import SEV_ERROR, SEV_WARN, Finding
+from veles_tpu.units import Unit
+
+
+class WorkflowVerifyError(RuntimeError):
+    """Raised by `Workflow.initialize(verify="error")` when the graph
+    verifier reports error-severity findings."""
+
+    def __init__(self, findings: List[Finding]) -> None:
+        self.findings = findings
+        lines = "\n".join("  " + f.format() for f in findings)
+        super().__init__(
+            f"workflow verification failed with {len(findings)} "
+            f"error(s):\n{lines}")
+
+
+def _links_from(u) -> Dict:
+    return u.__dict__.get("_links_from") or {}
+
+
+def _links_to(u) -> Dict:
+    return u.__dict__.get("_links_to") or {}
+
+
+def _linked_attrs(u) -> Dict:
+    return u.__dict__.get("_linked_attrs") or {}
+
+
+def _participates(u) -> bool:
+    """Unit is wired into the pulse graph (has any control link)."""
+    return bool(_links_from(u)) or bool(_links_to(u))
+
+
+def verify_workflow(workflow) -> List[Finding]:
+    """Run every graph rule over `workflow`'s direct units; returns the
+    findings (possibly empty). Pure inspection: never mutates the graph,
+    never initializes or fires a unit."""
+    units: List[Unit] = [u for u in getattr(workflow, "units", [])
+                         if isinstance(u, Unit)]
+    findings: List[Finding] = []
+    findings += _check_aliases(units)
+    if any(_participates(u) for u in units):
+        findings += _check_reachability(workflow, units)
+        findings += _check_cycles(units)
+        findings += _check_read_before_write(units)
+    return findings
+
+
+# -- alias rules --------------------------------------------------------------
+
+def _check_aliases(units: List[Unit]) -> List[Finding]:
+    out: List[Finding] = []
+    for u in units:
+        for own, (src, remote) in _linked_attrs(u).items():
+            site = (f"{getattr(u, 'name', u)}.{own} -> "
+                    f"{getattr(src, 'name', src)}.{remote}")
+            try:
+                exists = hasattr(src, remote)
+            except Exception:   # noqa: BLE001 — alias chains may cycle
+                exists = False
+            if not exists:
+                late = own in (u.__dict__.get("_late_attrs") or ())
+                out.append(Finding(
+                    "dangling-alias",
+                    SEV_WARN if late else SEV_ERROR, repr(u),
+                    (f"late-bound alias {own!r} "
+                     f"({type(src).__name__}.{remote}) not materialized "
+                     "yet — fine before initialize(), stale if it "
+                     "persists" if late else
+                     f"linked attribute {own!r} aliases "
+                     f"{type(src).__name__}.{remote}, which does not "
+                     "exist on the source unit"), site))
+            if own in u.__dict__ or hasattr(type(u), own):
+                kind = ("class" if hasattr(type(u), own)
+                        else "stray instance")
+                out.append(Finding(
+                    "shadowed-alias", SEV_WARN, repr(u),
+                    f"linked attribute {own!r} is shadowed by a {kind} "
+                    "attribute: normal lookup wins and the alias never "
+                    "resolves", site))
+    return out
+
+
+# -- reachability / cycle rules ----------------------------------------------
+
+def _reachable(roots) -> Set[Unit]:
+    seen: Set[Unit] = set()
+    stack = list(roots)
+    while stack:
+        u = stack.pop()
+        if u in seen:
+            continue
+        seen.add(u)
+        stack.extend(_links_to(u))
+    return seen
+
+
+def _check_reachability(workflow, units: List[Unit]) -> List[Finding]:
+    out: List[Finding] = []
+    start = getattr(workflow, "start_point", None)
+    end = getattr(workflow, "end_point", None)
+    if start is None:
+        return out
+    reach = _reachable([start])
+    for u in units:
+        if u is start or not _participates(u):
+            continue
+        if u not in reach:
+            out.append(Finding(
+                "unreachable", SEV_ERROR, repr(u),
+                "wired into the control graph but no pulse path from "
+                "StartPoint reaches it: it never fires, and every unit "
+                "AND-gated on it is dead too"))
+    if end is not None and end not in reach:
+        out.append(Finding(
+            "endpoint-unreachable", SEV_ERROR, repr(end),
+            "no pulse path from StartPoint can ever fire EndPoint: "
+            "run() only terminates via an explicit stop()"))
+    return out
+
+
+def _check_cycles(units: List[Unit]) -> List[Finding]:
+    """Tarjan SCC (iterative) over the participating units; a cycle with
+    no OR-gate member is an AND-gate deadlock."""
+    nodes = [u for u in units if _participates(u)]
+    index: Dict[Unit, int] = {}
+    low: Dict[Unit, int] = {}
+    on_stack: Set[Unit] = set()
+    stack: List[Unit] = []
+    sccs: List[List[Unit]] = []
+    counter = [0]
+
+    def strongconnect(root: Unit) -> None:
+        work = [(root, iter(_links_to(root)))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(_links_to(w))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w is v:
+                        break
+                sccs.append(scc)
+
+    for u in nodes:
+        if u not in index:
+            strongconnect(u)
+
+    out: List[Finding] = []
+    for scc in sccs:
+        cyclic = len(scc) > 1 or (scc and scc[0] in _links_to(scc[0]))
+        if not cyclic:
+            continue
+        if any(getattr(u, "or_gate", False) for u in scc):
+            continue    # a Repeater-style merge point breaks the wait
+        members = ", ".join(sorted(str(getattr(u, "name", u))
+                                   for u in scc))
+        out.append(Finding(
+            "control-cycle", SEV_ERROR, repr(scc[0]),
+            "control-link cycle with no OR-gate (Repeater) member: "
+            "every unit AND-waits on the cycle's own back-edge, so the "
+            "loop can never complete a pulse", f"cycle: {members}"))
+    return out
+
+
+# -- data-flow rule -----------------------------------------------------------
+
+def _check_read_before_write(units: List[Unit]) -> List[Finding]:
+    out: List[Finding] = []
+    memo: Dict[Unit, FrozenSet[Unit]] = {}
+
+    def descendants(src: Unit) -> FrozenSet[Unit]:
+        if src not in memo:
+            memo[src] = frozenset(_reachable(list(_links_to(src))))
+        return memo[src]
+
+    for u in units:
+        if not _links_from(u):
+            continue    # not pulse-driven: scheduling is caller-defined
+        for own, (src, remote) in _linked_attrs(u).items():
+            if src is u or not isinstance(src, Unit):
+                continue
+            if not _participates(src):
+                continue    # init-time data holder, written before run()
+            if u not in descendants(src):
+                out.append(Finding(
+                    "read-before-write", SEV_WARN, repr(u),
+                    f"consumes alias {own!r} from "
+                    f"{getattr(src, 'name', src)}, but no pulse path "
+                    "lets the source fire before this unit: the first "
+                    "read sees initialization leftovers",
+                    f"{getattr(u, 'name', u)}.{own} <- "
+                    f"{getattr(src, 'name', src)}.{remote}"))
+    return out

@@ -1,0 +1,356 @@
+"""velint (analysis pass 3 of 3): project-specific AST lint.
+
+Generic linters don't know this codebase's contracts; velint encodes
+them (rule catalogue + one-line triggering examples in docs/ANALYSIS.md):
+
+- `hot-sync` (error): `jax.device_get(...)`, `.item()` or
+  `np.asarray(...)` inside a unit's `run()` / `xla_run()` — the pulse
+  graph's per-minibatch hot path. Each one is a device->host sync that
+  stalls the dispatch pipeline. (`numpy_run` is the golden HOST path by
+  design and is exempt.)
+- `jit-in-loop` (error): `jax.jit(...)` constructed lexically inside a
+  `for`/`while` body — a fresh jit wrapper per iteration defeats the
+  trace cache (re-trace every pass even when shapes repeat).
+- `trace-time` (error): `time.time()`/`time.perf_counter()`/
+  `time.monotonic()`, `random.*` or `np.random.*` inside a TRACED
+  function (a `fused_apply`/`_apply` method, or a local function passed
+  to `jax.jit`/`self.jit`/`shard_map`/`jax.grad`/...). The call runs
+  once at trace time and freezes into the jaxpr as a constant — the
+  step silently stops varying.
+- `lock-no-with` (error): a bare `<x>.acquire()` call statement on a
+  lock-named attribute: an exception between acquire and release wedges
+  every later caller. Use `with lock:`.
+
+Suppression: append `# velint: disable=RULE[,RULE2]` (or `disable=all`)
+to the offending line. CI gate: `tools/velint.py --ci` compares against
+the checked-in baseline (`tools/velint_baseline.json`) and fails only on
+NEW findings — ratchet-only, never a flag day.
+
+Pure stdlib `ast` — importable (and fast) without jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import asdict, dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+RULES: Dict[str, str] = {
+    "hot-sync": "device->host sync (jax.device_get/.item()/np.asarray) "
+                "inside a unit run()/xla_run() hot path",
+    "jit-in-loop": "jax.jit constructed inside a for/while loop body",
+    "trace-time": "time.time()/random.* inside a traced function "
+                  "(freezes into the jaxpr at trace time)",
+    "lock-no-with": "lock .acquire() outside a with statement",
+}
+
+#: method names that ARE the per-minibatch hot path of a unit
+_HOT_METHODS = ("run", "xla_run")
+
+#: method names that are traced by construction (pure jnp model fns)
+_TRACED_METHODS = ("fused_apply", "_apply", "_backward_model")
+
+#: call names that take a function argument and trace it
+_TRACING_CALLS = ("jit", "shard_map", "make_jaxpr", "grad",
+                  "value_and_grad", "vjp", "checkpoint", "remat",
+                  "eval_shape", "scan", "pmap", "vmap")
+
+_SUPPRESS_RE = re.compile(r"#\s*velint:\s*disable=([\w\-,]+)")
+
+
+@dataclass
+class LintFinding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    severity: str = "error"
+
+    def as_dict(self) -> Dict[str, object]:
+        return asdict(self)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}: {self.message}")
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name expression ('' when dynamic)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module) -> None:
+        self.path = path
+        self.findings: List[LintFinding] = []
+        self._class_depth = 0
+        self._hot_depth = 0       # inside a run()/xla_run() method body
+        self._traced_depth = 0    # inside a traced function body
+        self._loop_depth = 0
+        #: local function names passed into tracing calls, plus the ids
+        #: of lambda nodes passed directly (`self.jit(lambda ...)`, the
+        #: codebase's dominant traced idiom) — one pre-pass collects
+        #: them so use-before-def order is fine
+        self._traced_names, self._traced_lambdas = \
+            self._collect_traced(tree)
+
+    # -- pre-pass: which local defs / lambdas get traced ----------------------
+
+    @staticmethod
+    def _collect_traced(tree: ast.Module):
+        names: set = set()
+        lambdas: set = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            leaf = chain.rsplit(".", 1)[-1] if chain else ""
+            if leaf not in _TRACING_CALLS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    lambdas.add(id(arg))
+        return names, lambdas
+
+    # -- scope tracking -------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_depth += 1
+        self.generic_visit(node)
+        self._class_depth -= 1
+
+    def _visit_function(self, node) -> None:
+        name = getattr(node, "name", "<lambda>")
+        hot = (self._class_depth > 0 and name in _HOT_METHODS)
+        traced = (name in _TRACED_METHODS or name in self._traced_names)
+        self._hot_depth += hot
+        self._traced_depth += traced
+        # a nested def is a NEW hot/traced scope only via its own match;
+        # but code inside an enclosing hot/traced body stays flagged
+        # (closures run where their caller runs)
+        self.generic_visit(node)
+        self._hot_depth -= hot
+        self._traced_depth -= traced
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        traced = id(node) in self._traced_lambdas
+        self._traced_depth += traced
+        self.generic_visit(node)
+        self._traced_depth -= traced
+
+    def _visit_loop(self, node) -> None:
+        # a For's iter evaluates ONCE — visit it outside the loop
+        # context (other rules still see it); a While's test re-runs
+        # every iteration, so it IS loop context
+        it = getattr(node, "iter", None)
+        if it is not None:
+            self.visit(it)
+        self._loop_depth += 1
+        test = getattr(node, "test", None)
+        if test is not None:
+            self.visit(test)
+        for child in node.body:
+            self.visit(child)
+        self._loop_depth -= 1
+        for child in node.orelse:
+            self.visit(child)
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    # -- the rules ------------------------------------------------------------
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(LintFinding(
+            self.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), rule, message))
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        # bare statement `x.acquire()` — a with-statement never parses to
+        # this, so every hit is an unguarded acquire
+        call = node.value
+        if isinstance(call, ast.Call) \
+                and isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "acquire" \
+                and "lock" in _attr_chain(call.func.value).lower():
+            self._emit(node, "lock-no-with",
+                       f"`{_attr_chain(call.func)}()` outside a `with` "
+                       "statement: an exception before release() wedges "
+                       "every later caller")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+        leaf = chain.rsplit(".", 1)[-1] if chain else ""
+
+        if chain == "jax.jit" and self._loop_depth:
+            self._emit(node, "jit-in-loop",
+                       "jax.jit constructed inside a loop: a fresh "
+                       "wrapper per iteration re-traces every pass — "
+                       "hoist the jit out of the loop")
+
+        if self._hot_depth:
+            if chain == "jax.device_get":
+                self._emit(node, "hot-sync",
+                           "jax.device_get in a unit hot path blocks on "
+                           "the device: keep results device-side "
+                           "(set_devmem) until a boundary")
+            elif leaf == "item" and not node.args and not node.keywords \
+                    and isinstance(node.func, ast.Attribute):
+                self._emit(node, "hot-sync",
+                           ".item() in a unit hot path is a scalar "
+                           "device sync per call")
+            elif chain.startswith(("np.asarray", "numpy.asarray")):
+                self._emit(node, "hot-sync",
+                           "np.asarray in a unit hot path forces a "
+                           "device->host transfer: keep results "
+                           "device-side (set_devmem) until a boundary")
+
+        if self._traced_depth:
+            if chain in ("time.time", "time.perf_counter",
+                         "time.monotonic", "time.time_ns"):
+                self._emit(node, "trace-time",
+                           f"{chain}() inside a traced function runs "
+                           "ONCE at trace time and freezes into the "
+                           "jaxpr as a constant")
+            elif chain.startswith(("random.", "np.random.",
+                                   "numpy.random.")):
+                self._emit(node, "trace-time",
+                           f"{chain}() inside a traced function draws "
+                           "ONCE at trace time (a frozen constant): use "
+                           "jax.random with a carried key")
+        self.generic_visit(node)
+
+
+def _suppressed(finding: LintFinding, lines: Sequence[str]) -> bool:
+    """True when the finding's line (or a comment-only line directly
+    above it) carries a matching `# velint: disable=` marker."""
+    if not 1 <= finding.line <= len(lines):
+        return False
+    candidates = [lines[finding.line - 1]]
+    if finding.line >= 2 and lines[finding.line - 2].lstrip() \
+            .startswith("#"):
+        candidates.append(lines[finding.line - 2])
+    for text in candidates:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",")}
+            if "all" in rules or finding.rule in rules:
+                return True
+    return False
+
+
+def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
+    """Lint one source text; returns unsuppressed findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [LintFinding(path, e.lineno or 0, e.offset or 0,
+                            "syntax-error", str(e))]
+    linter = _Linter(path, tree)
+    linter.visit(tree)
+    lines = source.splitlines()
+    return [f for f in linter.findings if not _suppressed(f, lines)]
+
+
+def lint_file(path: str) -> List[LintFinding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> List[LintFinding]:
+    """Lint every .py under `paths` (files or directories). Reported
+    paths are relative to `root` when given, so baselines are stable
+    across checkouts."""
+    findings: List[LintFinding] = []
+    for p in paths:
+        files: List[str] = []
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files += [os.path.join(dirpath, fn)
+                          for fn in sorted(filenames)
+                          if fn.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+        for fn in sorted(files):
+            rel = os.path.relpath(fn, root) if root else fn
+            for f in lint_file(fn):
+                f.path = rel
+                findings.append(f)
+    return findings
+
+
+# -- ratchet baseline ---------------------------------------------------------
+
+def baseline_counts(findings: Iterable[LintFinding]
+                    ) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        key = f"{f.path}::{f.rule}"
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """{"path::rule": count} — missing/corrupt baselines read as empty
+    (the strictest gate), never as a crash."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return {str(k): int(v)
+                for k, v in data.get("counts", {}).items()}
+    except (OSError, ValueError, AttributeError):
+        return {}
+
+
+def write_baseline(path: str,
+                   findings: Iterable[LintFinding]) -> None:
+    payload = {"comment": "velint ratchet baseline: pre-existing "
+                          "finding counts per file::rule. The --ci gate "
+                          "fails only when a count EXCEEDS its entry "
+                          "here. Shrink it over time; never grow it.",
+               "counts": baseline_counts(findings)}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def new_findings(findings: Sequence[LintFinding],
+                 baseline: Dict[str, int]
+                 ) -> Tuple[List[LintFinding], Dict[str, int]]:
+    """Findings beyond the baseline's per-(file, rule) budget, plus the
+    over-budget counts. Within a budget, which individual lines are
+    'old' is unknowable (line numbers drift) — the ratchet is on
+    counts."""
+    budget = dict(baseline)
+    fresh: List[LintFinding] = []
+    over: Dict[str, int] = {}
+    for f in findings:
+        key = f"{f.path}::{f.rule}"
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            fresh.append(f)
+            over[key] = over.get(key, 0) + 1
+    return fresh, over

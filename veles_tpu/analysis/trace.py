@@ -1,0 +1,397 @@
+"""Jaxpr auditor (analysis pass 2 of 3): audit the fused train step by
+ABSTRACT tracing — `jax.make_jaxpr` over the unjitted step callable — so
+every property checks on CPU in CI with no compile and no devices.
+
+Rules (docs/ANALYSIS.md):
+
+- `f64-promotion` (error): an op in the traced step produces float64 —
+  a weak-type leak above the configured compute dtype that doubles HBM
+  traffic and silently de-optimizes the whole chain;
+- `precision-above-compute` (warn): matmul/conv ops run in float32 while
+  the step is configured for a sub-f32 compute dtype (bf16/f16) — the
+  MXU-feeding flops are not actually in the cheap dtype;
+- `host-sync` (error): a callback/infeed/outfeed primitive inside the
+  hot step (jax.debug.print, pure_callback, ...) forces a host
+  round-trip per dispatch;
+- `donation-dropped` (error): the step donates its input state, but a
+  buffer shaped like a donated state leaf is ALSO captured as a trace
+  constant (e.g. a unit reading `self.weights` instead of the `params`
+  argument) — XLA keeps the constant copy alive and the donation is
+  silently worthless;
+- `large-trace-constant` (warn): a large array rides the jaxpr as a
+  closure constant — it is re-hashed on every trace and duplicated in
+  every executable;
+- `retrace-hazard` (warn): the carried state contains Python scalars —
+  each step's new value becomes a fresh trace constant, recompiling the
+  step every call;
+- `sharding-mismatch` (error): a param PartitionSpec names a mesh axis
+  that does not exist or shards a dimension the axis size does not
+  divide — the exact drift class the PR-2 `out_shardings` pin fixed;
+- `pre-vma-numerics` (warn): the structured form of
+  `_compat.warn_pre_vma_numerics` — GPipe / seq×TP builds on pre-vma
+  jax have ~1e-3 trained-loss deviation;
+- `nonfinite-guard-off` (warn): the run is configured without the
+  non-finite loss guard, so the supervisor's snapshot rollback
+  (exit 81) can never trigger on divergence.
+
+Entry points: `audit_fused_step(step, x, y)` for a built
+FusedTrainStep / PipelineTrainStep, `audit_workflow(workflow)` to derive
+shapes from the workflow's loader, `environment_findings(...)` for the
+import-cheap checks the supervisor embeds in its exit report.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from veles_tpu.analysis.findings import SEV_ERROR, SEV_WARN, Finding
+
+#: substrings of primitive names that force a host round-trip per step
+_HOST_SYNC_MARKERS = ("callback", "infeed", "outfeed")
+
+#: primitives whose flops dominate — the ones `precision-above-compute`
+#: watches when a sub-f32 compute dtype is configured
+_MATMUL_PRIMS = ("dot_general", "conv_general_dilated")
+
+#: consts at least this many elements trigger `large-trace-constant`
+LARGE_CONST_ELEMS = 1 << 18
+
+#: consts smaller than this are ignored by the donation check (iota
+#: tables, one-hot templates — too small to matter, too common to flag)
+_DONATION_MIN_ELEMS = 32
+
+
+# -- jaxpr walking ------------------------------------------------------------
+
+def _sub_jaxprs(params):
+    from jax.core import ClosedJaxpr, Jaxpr
+    for v in params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            if isinstance(x, ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, Jaxpr):
+                yield x
+
+
+def iter_eqns(jaxpr):
+    """All equations of `jaxpr` including nested sub-jaxprs (scan/cond/
+    pjit bodies), each visited once."""
+    stack, seen = [jaxpr], set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            yield eqn
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+# -- individual checks --------------------------------------------------------
+
+def _dtype_findings(closed, compute_dtype) -> List[Finding]:
+    out: List[Finding] = []
+    f64_prims: dict = {}
+    f32_matmuls = 0
+    cd = np.dtype(compute_dtype) if compute_dtype is not None \
+        else np.dtype(np.float32)
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        for var in eqn.outvars:
+            dt = getattr(var.aval, "dtype", None)
+            if dt is None:
+                continue
+            if dt == np.float64:
+                f64_prims[name] = f64_prims.get(name, 0) + 1
+            elif (cd.itemsize < 4 and dt == np.float32
+                    and name in _MATMUL_PRIMS):
+                f32_matmuls += 1
+    for name, count in sorted(f64_prims.items()):
+        out.append(Finding(
+            "f64-promotion", SEV_ERROR, name,
+            f"{count} op(s) produce float64 above the configured "
+            f"compute dtype {cd.name}: a weak-type promotion leak "
+            "(2x HBM traffic, no MXU path)"))
+    if f32_matmuls:
+        out.append(Finding(
+            "precision-above-compute", SEV_WARN, "dot/conv",
+            f"{f32_matmuls} matmul/conv op(s) run in float32 while the "
+            f"step is configured for {cd.name}: the dominant flops are "
+            "not in the cheap dtype"))
+    return out
+
+
+def _host_sync_findings(closed) -> List[Finding]:
+    hits: dict = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if any(m in name for m in _HOST_SYNC_MARKERS):
+            hits[name] = hits.get(name, 0) + 1
+    return [Finding(
+        "host-sync", SEV_ERROR, name,
+        f"{count} {name} op(s) in the hot step force a host round-trip "
+        "per dispatch (debug_print/pure_callback do not belong in the "
+        "train step)") for name, count in sorted(hits.items())]
+
+
+def _const_findings(closed, state, donate: bool) -> List[Finding]:
+    out: List[Finding] = []
+    leaves = []
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(state)
+    except Exception:   # noqa: BLE001
+        pass
+    leaf_sigs = {(np.shape(a), np.dtype(getattr(a, "dtype", "f4")).name)
+                 for a in leaves if np.ndim(a) >= 1}
+    for c in closed.consts:
+        shape = np.shape(c)
+        if len(shape) < 1 or int(np.prod(shape)) < _DONATION_MIN_ELEMS:
+            continue
+        dt = np.dtype(getattr(c, "dtype", np.asarray(c).dtype)).name
+        site = f"const {dt}{list(shape)}"
+        identical = any(c is a for a in leaves)
+        if donate and (identical or (shape, dt) in leaf_sigs):
+            out.append(Finding(
+                "donation-dropped", SEV_ERROR, site,
+                "a buffer shaped like a donated state leaf is captured "
+                "as a trace constant (a unit reading its own Array "
+                "instead of the params argument?): XLA keeps the "
+                "constant copy alive and the donation is silently "
+                "dropped"))
+        elif int(np.prod(shape)) >= LARGE_CONST_ELEMS:
+            out.append(Finding(
+                "large-trace-constant", SEV_WARN, site,
+                "a large array rides the jaxpr as a closure constant: "
+                "duplicated per executable and re-hashed per trace — "
+                "pass it as an argument instead"))
+    return out
+
+
+def _state_findings(state) -> List[Finding]:
+    out: List[Finding] = []
+    try:
+        import jax
+        from jax.tree_util import keystr, tree_flatten_with_path
+        pairs = [(keystr(kp), v)
+                 for kp, v in tree_flatten_with_path(state)[0]]
+    except Exception:   # noqa: BLE001
+        import jax
+        pairs = [("", v) for v in jax.tree_util.tree_leaves(state)]
+    for name, v in pairs:
+        if isinstance(v, (bool, int, float)):
+            out.append(Finding(
+                "retrace-hazard", SEV_WARN, f"state{name}",
+                f"carried state leaf is a Python {type(v).__name__}: "
+                "every new value becomes a fresh trace constant and "
+                "recompiles the step (wrap it in jnp.asarray)"))
+    return out
+
+
+def _spec_axes(part) -> Sequence[str]:
+    if part is None:
+        return ()
+    return (part,) if isinstance(part, str) else tuple(part)
+
+
+def _sharding_findings(step) -> List[Finding]:
+    """Check the step's param PartitionSpecs against its mesh — the
+    static form of the PR-2 sharding-drift bug class."""
+    mesh = getattr(step, "mesh", None)
+    mode = getattr(step, "mode", None)
+    if mesh is None or mode not in ("gspmd", "dp", "seq"):
+        return []
+    if mode == "gspmd":
+        specs, _ = step._tp_plan()
+    elif mode == "dp":
+        specs = step._smap_param_specs()
+    else:
+        specs = step._seq_param_specs()
+    out: List[Finding] = []
+    for u, spec_d in zip(step.forwards, specs):
+        arrs = u.param_arrays()
+        for k, spec in spec_d.items():
+            shape = tuple(getattr(arrs.get(k), "shape", None) or ())
+            site = f"{getattr(u, 'name', u)}.{k} {tuple(spec)!r}"
+            for i, part in enumerate(tuple(spec)):
+                axes = _spec_axes(part)
+                if not axes:
+                    continue
+                if i >= len(shape):
+                    out.append(Finding(
+                        "sharding-mismatch", SEV_ERROR, repr(u),
+                        f"PartitionSpec for param {k!r} shards dim {i} "
+                        f"but the array has rank {len(shape)}", site))
+                    continue
+                for ax in axes:
+                    if ax not in mesh.shape:
+                        out.append(Finding(
+                            "sharding-mismatch", SEV_ERROR, repr(u),
+                            f"PartitionSpec for param {k!r} names mesh "
+                            f"axis {ax!r}, which the mesh "
+                            f"{dict(mesh.shape)} does not have", site))
+                    elif shape[i] % mesh.shape[ax]:
+                        out.append(Finding(
+                            "sharding-mismatch", SEV_ERROR, repr(u),
+                            f"param {k!r} dim {i} ({shape[i]}) is not "
+                            f"divisible by mesh axis {ax!r} "
+                            f"({mesh.shape[ax]} shards): XLA would "
+                            "pad-shard or reject it", site))
+    return out
+
+
+# -- entry points -------------------------------------------------------------
+
+def audit_fused_step(step, x, y, w=None, state=None,
+                     nonfinite_guard: Optional[bool] = None
+                     ) -> List[Finding]:
+    """Audit a built FusedTrainStep (any mode) or PipelineTrainStep by
+    tracing its unjitted train callable over the given minibatch. `x`/`y`
+    are host arrays with the real shapes (values are irrelevant); `state`
+    defaults to `step.init_state()`. No compile happens — `make_jaxpr`
+    only traces."""
+    import jax
+
+    from veles_tpu import _compat
+    from veles_tpu.parallel.mesh import MODEL_AXIS
+
+    findings: List[Finding] = []
+    sharding = _sharding_findings(step)
+    findings += sharding
+    if any(f.severity == SEV_ERROR for f in sharding):
+        # a broken partition plan: building state / tracing would crash
+        # on the very defect just reported — stop at the static verdict
+        return findings
+    mesh = getattr(step, "mesh", None)
+    is_pipeline = hasattr(step, "_microbatch")
+    if not _compat.GRAD_TRANSPOSE_PSUM:
+        if is_pipeline:
+            findings.append(_pre_vma_finding("GPipe pipeline step"))
+        elif (getattr(step, "mode", None) == "seq" and mesh is not None
+                and mesh.shape.get(MODEL_AXIS, 1) > 1):
+            findings.append(_pre_vma_finding("seq x TP (3-axis) "
+                                             "fused step"))
+    if nonfinite_guard is not None and not nonfinite_guard:
+        findings.append(_guard_off_finding())
+
+    if state is None:
+        state = step.init_state()
+    findings += _state_findings(state)
+
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if w is None:
+        w = np.ones(np.shape(x)[0], np.float32)
+    if is_pipeline:
+        xs, yb, wb = step._microbatch(x, y, w)
+        args = (state, step._gid, xs, yb, wb)
+    else:
+        xb, yb = step._seq_xy(x, y)
+        args = (state, xb, yb,
+                step._weights_or_ones(np.asarray(w, np.float32),
+                                      np.shape(x)[0]))
+    closed = jax.make_jaxpr(step.train_callable())(*args)
+    findings += _dtype_findings(closed, getattr(step, "compute_dtype",
+                                                None))
+    findings += _host_sync_findings(closed)
+    findings += _const_findings(closed, state,
+                                bool(getattr(step, "donate", False)))
+    return findings
+
+
+def audit_workflow(workflow, step=None,
+                   nonfinite_guard: Optional[bool] = None,
+                   **step_kwargs) -> List[Finding]:
+    """Build (or take) a fused step for `workflow` and audit it with the
+    loader's real minibatch shapes. Initializes the workflow on the
+    default backend when needed (host-side allocation only)."""
+    if not workflow.is_initialized:
+        workflow.initialize(device=None, verify="off")
+    if step is None:
+        step = workflow.build_fused_step(**step_kwargs)
+    loader = workflow.loader
+    x = np.asarray(loader.minibatch_data.mem)
+    y = np.asarray(loader.minibatch_labels.mem)
+    w = loader.minibatch_valid.mem
+    w = (np.asarray(w, np.float32) if w is not None
+         else np.ones(x.shape[0], np.float32))
+    return audit_fused_step(step, x, y, w=w,
+                            nonfinite_guard=nonfinite_guard)
+
+
+# -- environment findings (supervisor exit report, --verify-workflow) ---------
+
+def _pre_vma_finding(context: str) -> Finding:
+    from veles_tpu._compat import _jax_version
+    return Finding(
+        "pre-vma-numerics", SEV_WARN, context,
+        f"built on pre-vma jax {_jax_version()}: trained numerics may "
+        "deviate ~1e-3 relative from the single-device trajectory "
+        "(grad-transpose psum semantics); a jax upgrade clears it")
+
+
+def _guard_off_finding() -> Finding:
+    return Finding(
+        "nonfinite-guard-off", SEV_WARN, "training loop",
+        "running without --nonfinite-guard: a NaN/inf loss trains on "
+        "and the supervisor's snapshot rollback (exit 81) never "
+        "triggers")
+
+
+def _flag_value(argv: Sequence[str], flag: str) -> Optional[str]:
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def environment_findings(argv: Optional[Sequence[str]] = None,
+                         pp: Optional[int] = None,
+                         tp: Optional[int] = None,
+                         sp: Optional[int] = None,
+                         nonfinite_guard: Optional[bool] = None
+                         ) -> List[Finding]:
+    """Config-level findings derivable WITHOUT building a step: the
+    pre-vma numerics hazard for GPipe / seq×TP configurations and the
+    disabled non-finite guard. Accepts either explicit flag values or a
+    child argv to parse them from (the supervisor passes its child
+    command line)."""
+    argv = list(argv or ())
+
+    def parsed(flag: str) -> Optional[int]:
+        raw = _flag_value(argv, flag)
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return 1    # present but unparsable: treat as enabled
+
+    if argv:
+        if pp is None:
+            pp = parsed("--pp")
+        if tp is None:
+            tp = parsed("--tp")
+        if sp is None:
+            sp = parsed("--sp")
+        if nonfinite_guard is None:
+            nonfinite_guard = ("--nonfinite-guard" in argv
+                               or "--debug-nans" in argv)
+    out: List[Finding] = []
+    from veles_tpu import _compat
+    if not _compat.GRAD_TRANSPOSE_PSUM:
+        if pp:
+            out.append(_pre_vma_finding("GPipe pipeline step"))
+        if (sp or 1) > 1 and (tp or 1) > 1:
+            out.append(_pre_vma_finding("seq x TP (3-axis) fused step"))
+        for context in sorted(_compat._WARNED):
+            if not any(f.unit == context for f in out):
+                out.append(_pre_vma_finding(context))
+    if nonfinite_guard is not None and not nonfinite_guard:
+        out.append(_guard_off_finding())
+    return out

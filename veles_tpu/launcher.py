@@ -42,6 +42,7 @@ class Launcher(Logger):
                  tp: Optional[int] = None, sp: Optional[int] = None,
                  ep: bool = False, compile_cache: bool = True,
                  nonfinite_guard: bool = False,
+                 verify_workflow: bool = False,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -136,10 +137,14 @@ class Launcher(Logger):
                              "(single-process EP uses "
                              "build_fused_step(ep=True) directly)")
         self.ep = bool(ep)
-        #: abort fused/pipelined training with a distinct exit code the
-        #: moment a class pass's loss goes non-finite (resilience layer:
-        #: the Supervisor rolls back one snapshot before retrying)
+        #: abort training with a distinct exit code the moment a class
+        #: pass's loss goes non-finite — fused/pipelined AND granular
+        #: modes (resilience layer: the Supervisor rolls back one
+        #: snapshot before retrying)
         self.nonfinite_guard = nonfinite_guard
+        #: static-analysis-only mode: verify the constructed workflow
+        #: graph, print findings, exit nonzero on errors, never train
+        self.verify_workflow = verify_workflow
         #: opt-out for the persistent XLA compile cache (the cache is
         #: also auto-skipped on axon backends — see
         #: enable_compilation_cache)
@@ -235,10 +240,30 @@ class Launcher(Logger):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         return True
 
+    def _run_verify(self) -> int:
+        """--verify-workflow: run the static graph verifier plus the
+        config-level environment findings over the CONSTRUCTED (not
+        initialized) workflow, print every finding, and exit nonzero on
+        errors — no initialization, no training, no devices."""
+        from veles_tpu.analysis.graph import verify_workflow
+        from veles_tpu.analysis.trace import environment_findings
+        findings = list(verify_workflow(self.workflow))
+        findings += environment_findings(
+            pp=self.pp, tp=self.tp, sp=self.sp,
+            nonfinite_guard=(self.nonfinite_guard or self.debug_nans))
+        for f in findings:
+            print(f.format(), flush=True)
+        n_err = sum(1 for f in findings if f.severity == "error")
+        print(f"verify-workflow: {n_err} error(s), "
+              f"{len(findings) - n_err} warning(s)", flush=True)
+        return 1 if n_err else 0
+
     def main(self, **kwargs: Any) -> int:
         """Initialize + run the loaded workflow; returns an exit code."""
         if self.workflow is None:
             raise RuntimeError("Launcher.main() before load()")
+        if self.verify_workflow:
+            return self._run_verify()
         if self.compile_cache:
             self.enable_compilation_cache()
         self.boot_distributed()
@@ -456,6 +481,14 @@ class Launcher(Logger):
                     device=self.device, accum_steps=self.accum,
                     nonfinite_guard=self.nonfinite_guard, **kwargs)
             else:
+                if self.nonfinite_guard and hasattr(self.workflow,
+                                                    "decision"):
+                    # granular graph: the Decision unit raises at the
+                    # minibatch whose (already host-synced) loss goes
+                    # non-finite — closing the ROADMAP gap "granular
+                    # mode has no non-finite guard"; same exit-81 ->
+                    # supervisor-rollback contract as the fused path
+                    self.workflow.decision.nonfinite_guard = True
                 self.workflow.initialize(device=self.device, **kwargs)
                 self.workflow.run()
         except KeyboardInterrupt:

@@ -671,52 +671,70 @@ class FusedTrainStep:
 
     # -- compilation ---------------------------------------------------------
 
+    def train_callable(self):
+        """The UNJITTED (state, x, y, w) -> (state, loss, n_err)
+        callable `_build` wraps in jax.jit — shard_map-wrapped in
+        dp/seq modes so the jaxpr auditor (analysis/trace.py) abstractly
+        traces exactly what trains, with zero compile."""
+        if self.mode in ("local", "gspmd"):
+            return lambda s, x, y, w: self._train_body(s, x, y, w,
+                                                       axis=None)
+        if self.mode == "dp":
+            ssp = self._smap_state_spec()
+            return shard_map(
+                lambda s, x, y, w: self._train_body(s, x, y, w,
+                                                    axis=DATA_AXIS),
+                mesh=self.mesh,
+                in_specs=(ssp, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(ssp, P(), P()))
+        if self.mode == "seq":
+            if self.mesh.shape.get(MODEL_AXIS, 1) > 1:
+                from veles_tpu._compat import warn_pre_vma_numerics
+                warn_pre_vma_numerics("seq x TP (3-axis) fused step")
+            axes = (DATA_AXIS, SEQ_AXIS)
+            xspec = P(DATA_AXIS, SEQ_AXIS)  # (N, S, ...) batch x sequence
+            ssp = self._seq_state_spec()    # TP-sharded when model axis
+            return shard_map(
+                lambda s, x, y, w: self._train_body(s, x, y, w,
+                                                    axis=axes),
+                mesh=self.mesh,
+                in_specs=(ssp, xspec, xspec, P(DATA_AXIS)),
+                out_specs=(ssp, P(), P()))
+        raise ValueError(f"unknown mode {self.mode!r}")
+
     def _build(self) -> None:
         donate = (0,) if self.donate else ()
         if self.mode == "local":
-            self._train_fn = jax.jit(
-                lambda s, x, y, w: self._train_body(s, x, y, w, axis=None),
-                donate_argnums=donate)
+            self._train_fn = jax.jit(self.train_callable(),
+                                     donate_argnums=donate)
             self._eval_fn = jax.jit(
                 lambda p, x, y, w: self._eval_body(p, x, y, w, axis=None))
         elif self.mode == "dp":
             mesh = self.mesh
             ssp = self._smap_state_spec()
             wsp = P(DATA_AXIS)
-            train = shard_map(
-                lambda s, x, y, w: self._train_body(s, x, y, w,
-                                                    axis=DATA_AXIS),
-                mesh=mesh,
-                in_specs=(ssp, P(DATA_AXIS), P(DATA_AXIS), wsp),
-                out_specs=(ssp, P(), P()))
             evalf = shard_map(
                 lambda p, x, y, w: self._eval_body(p, x, y, w,
                                                    axis=DATA_AXIS),
                 mesh=mesh,
                 in_specs=(ssp["params"], P(DATA_AXIS), P(DATA_AXIS), wsp),
                 out_specs=(P(), P()))
-            self._train_fn = jax.jit(train, donate_argnums=donate)
+            self._train_fn = jax.jit(self.train_callable(),
+                                     donate_argnums=donate)
             self._eval_fn = jax.jit(evalf)
         elif self.mode == "seq":
-            if self.mesh.shape.get(MODEL_AXIS, 1) > 1:
-                from veles_tpu._compat import warn_pre_vma_numerics
-                warn_pre_vma_numerics("seq x TP (3-axis) fused step")
             mesh = self.mesh
             axes = (DATA_AXIS, SEQ_AXIS)
             xspec = P(DATA_AXIS, SEQ_AXIS)  # (N, S, ...) batch x sequence
             wsp = P(DATA_AXIS)              # weights stay per-SAMPLE
             ssp = self._seq_state_spec()    # TP-sharded when model axis
-            train = shard_map(
-                lambda s, x, y, w: self._train_body(s, x, y, w, axis=axes),
-                mesh=mesh,
-                in_specs=(ssp, xspec, xspec, wsp),
-                out_specs=(ssp, P(), P()))
             evalf = shard_map(
                 lambda p, x, y, w: self._eval_body(p, x, y, w, axis=axes),
                 mesh=mesh,
                 in_specs=(ssp["params"], xspec, xspec, wsp),
                 out_specs=(P(), P()))
-            self._train_fn = jax.jit(train, donate_argnums=donate)
+            self._train_fn = jax.jit(self.train_callable(),
+                                     donate_argnums=donate)
             self._eval_fn = jax.jit(evalf)
         elif self.mode == "gspmd":
             mesh = self.mesh
@@ -730,7 +748,7 @@ class FusedTrainStep:
             # P("model")), and the eval jit's in_shardings then rejects
             # the trained state with a sharding-mismatch ValueError
             self._train_fn = jax.jit(
-                lambda s, x, y, w: self._train_body(s, x, y, w, axis=None),
+                self.train_callable(),
                 in_shardings=(ssh, xsh, xsh, xsh),
                 out_shardings=(ssh, repl, repl),
                 donate_argnums=donate)

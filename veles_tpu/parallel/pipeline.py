@@ -428,9 +428,11 @@ class PipelineTrainStep:
         w = jnp.asarray(w, jnp.float32).reshape(m, mb)
         return xs, y, w
 
-    def _build(self) -> None:
-        from veles_tpu._compat import warn_pre_vma_numerics
-        warn_pre_vma_numerics("GPipe pipeline step")
+    def train_callable(self):
+        """The UNJITTED shard_map-wrapped train body (state, gid, xs, y,
+        w) -> (state, loss, n_err) that `_build` wraps in jax.jit —
+        exposed for the jaxpr auditor (analysis/trace.py), which traces
+        it abstractly without compiling."""
         tabs = jnp.asarray(self._coef_tabs)   # (4, G): lr/mom/wd/l1
 
         def train_body(state, gid, xs, y, w):
@@ -454,15 +456,21 @@ class PipelineTrainStep:
                          "lr_scale": state["lr_scale"]}
             return new_state, loss, n_err
 
+        ssp = {"params": P(STAGE_AXIS), "vel": P(STAGE_AXIS),
+               "key": P(), "lr_scale": P()}
+        return shard_map(
+            train_body, mesh=self.mesh,
+            in_specs=(ssp, P(STAGE_AXIS), P(), P(), P()),
+            out_specs=(ssp, P(), P()))
+
+    def _build(self) -> None:
+        from veles_tpu._compat import warn_pre_vma_numerics
+        warn_pre_vma_numerics("GPipe pipeline step")
+
         def eval_body(params, xs, y, w):
             return self._loss(params[0], xs, y, w)
 
-        ssp = {"params": P(STAGE_AXIS), "vel": P(STAGE_AXIS),
-               "key": P(), "lr_scale": P()}
-        self._train_fn = jax.jit(shard_map(
-            train_body, mesh=self.mesh,
-            in_specs=(ssp, P(STAGE_AXIS), P(), P(), P()),
-            out_specs=(ssp, P(), P())))
+        self._train_fn = jax.jit(self.train_callable())
         self._eval_fn = jax.jit(shard_map(
             eval_body, mesh=self.mesh,
             in_specs=(P(STAGE_AXIS), P(), P(), P()),

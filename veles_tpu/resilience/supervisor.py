@@ -350,6 +350,24 @@ class Supervisor(Logger):
                     "winners not reflected here")
             except Exception:  # noqa: BLE001
                 pass
+            try:
+                # structured analyzer findings for the supervised child
+                # config (pre-vma numerics for GPipe/seq×TP argvs, the
+                # non-finite guard left off) — the machine-readable twin
+                # of warn_pre_vma_numerics' log line, landing next to
+                # the variant table. Guarded import like `variants`
+                # above: analysis.trace pulls jax, and the supervisor
+                # must never die on report cosmetics at exit time.
+                from veles_tpu.analysis.trace import environment_findings
+                finds = []
+                for argv in self.commands:
+                    for f in environment_findings(argv=argv):
+                        if not any(g.rule == f.rule and g.unit == f.unit
+                                   for g in finds):
+                            finds.append(f)
+                report_obj["analysis"] = [f.as_dict() for f in finds]
+            except Exception:  # noqa: BLE001
+                pass
             with open(self.report_path, "w") as f:
                 json.dump(report_obj, f, indent=2)
         return code

@@ -22,6 +22,14 @@ from veles_tpu.logger import Logger
 from veles_tpu.mutable import Bool
 
 
+class LinkError(AttributeError):
+    """A `link_attrs` alias whose target attribute does not exist on the
+    source unit AT LINK TIME. Subclasses AttributeError so existing
+    handlers keep working — but it fires at the wiring site, naming both
+    units, instead of as a bare AttributeError at first read inside
+    run()."""
+
+
 class Unit(Logger):
     """Base of everything that lives inside a Workflow."""
 
@@ -34,6 +42,7 @@ class Unit(Logger):
         d["_links_from"] = {}   # src Unit -> pulsed flag (bool)
         d["_links_to"] = {}     # dst Unit -> True
         d["_linked_attrs"] = {}  # own attr name -> (src object, src attr name)
+        d["_late_attrs"] = set()  # own names linked with late=True
         self.name = name or type(self).__name__
         self.gate_block = Bool(False, name=f"{self.name}.gate_block")
         self.gate_skip = Bool(False, name=f"{self.name}.gate_skip")
@@ -47,17 +56,42 @@ class Unit(Logger):
     # -- data links (attribute aliasing) ------------------------------------
 
     def link_attrs(self, other: "Unit",
-                   *names: Union[str, Tuple[str, str]]) -> None:
+                   *names: Union[str, Tuple[str, str]],
+                   late: bool = False) -> None:
         """Alias attributes from `other`: `"x"` links self.x -> other.x;
-        `("own", "remote")` links self.own -> other.remote."""
+        `("own", "remote")` links self.own -> other.remote.
+
+        Validates EAGERLY: a remote attribute that does not exist at
+        link time raises `LinkError` naming both units here, at the
+        wiring site, instead of a bare AttributeError at first read
+        inside run(). Pass `late=True` for intentionally late-bound
+        attributes (created by the source's initialize())."""
         for entry in names:
             own, remote = (entry, entry) if isinstance(entry, str) else entry
+            if not late:
+                try:
+                    exists = hasattr(other, remote)
+                except Exception:   # noqa: BLE001 — alias chains may cycle
+                    exists = False
+                if not exists:
+                    raise LinkError(
+                        f"cannot link {self!r}.{own} -> {other!r}."
+                        f"{remote}: {type(other).__name__} has no "
+                        f"attribute {remote!r} at link time (pass "
+                        "late=True for intentionally late-bound "
+                        "attributes)")
             self.__dict__.pop(own, None)  # linked name must not shadow
             self._linked_attrs[own] = (other, remote)
+            if late:
+                # remembered so the graph verifier downgrades a
+                # not-yet-materialized late alias to a warning
+                # (setdefault: units unpickled from pre-late snapshots)
+                self.__dict__.setdefault("_late_attrs", set()).add(own)
 
     def unlink_attrs(self, *names: str) -> None:
         for n in names:
             self._linked_attrs.pop(n, None)
+            self.__dict__.get("_late_attrs", set()).discard(n)
 
     def __getattr__(self, name: str) -> Any:
         # Called only when normal lookup fails: resolve data links.

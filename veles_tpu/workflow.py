@@ -56,7 +56,30 @@ class Workflow(Container):
 
     def initialize(self, device=None, **kwargs: Any) -> None:
         """Initialize all units. Units may return False to be retried after
-        the others (mirrors the reference's deferred-initialization loop)."""
+        the others (mirrors the reference's deferred-initialization loop).
+
+        `verify="error"|"warn"|"off"` (default "warn") runs the static
+        graph verifier (analysis/graph.py) over the constructed graph
+        first: "warn" logs every finding and continues, "error"
+        additionally raises WorkflowVerifyError on error-severity
+        findings, "off" skips the pass."""
+        verify = kwargs.pop("verify", "warn")
+        if verify not in ("off", "warn", "error"):
+            raise ValueError(f"verify={verify!r}: expected "
+                             "'error', 'warn' or 'off'")
+        if verify != "off":
+            from veles_tpu.analysis.graph import (WorkflowVerifyError,
+                                                  verify_workflow)
+            findings = verify_workflow(self)
+            errs = []
+            for f in findings:
+                if f.severity == "error":
+                    errs.append(f)
+                    self.error("verify: %s", f.format())
+                else:
+                    self.warning("verify: %s", f.format())
+            if errs and verify == "error":
+                raise WorkflowVerifyError(errs)
         self.device = device
         super().initialize(**kwargs)
         pending = list(self.units)

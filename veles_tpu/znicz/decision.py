@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
+
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
 from veles_tpu.mutable import Bool
@@ -22,6 +24,21 @@ from veles_tpu.resilience.hooks import fire_epoch
 
 
 class DecisionBase(AcceleratedUnit):
+    #: abort the run with NonFiniteLossError the moment the evaluator's
+    #: loss goes NaN/inf (the granular arm of --nonfinite-guard; the
+    #: Launcher maps the error to exit 81 and the Supervisor rolls back
+    #: one snapshot). Class attribute so snapshots never pickle it: a
+    #: restored run re-opts-in via its own CLI flags.
+    nonfinite_guard = False
+
+    def __getstate__(self):
+        # the Launcher arms the guard by INSTANCE attribute; strip it
+        # from snapshots so the class-attribute contract above holds (a
+        # restored run must re-opt-in via its own CLI flags)
+        st = super().__getstate__()
+        st.pop("nonfinite_guard", None)
+        return st
+
     def __init__(self, workflow=None, max_epochs: Optional[int] = None,
                  fail_iterations: int = 100, **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
@@ -73,6 +90,17 @@ class DecisionGD(DecisionBase):
 
     def numpy_run(self) -> None:
         cls = int(self.minibatch_class)
+        if self.nonfinite_guard and not np.isfinite(float(self.loss)):
+            # the loss is ALREADY a host float here (the evaluator syncs
+            # its scalars per minibatch in granular mode), so the guard
+            # costs zero extra device round-trips. Raised before any
+            # accumulation/snapshot gating: a poisoned epoch must never
+            # look "improved".
+            from veles_tpu.resilience import NonFiniteLossError
+            raise NonFiniteLossError(
+                f"non-finite loss {float(self.loss)!r} at epoch "
+                f"{self.epoch_number} (class {cls} minibatch, granular "
+                "mode)")
         self._accum[cls] += float(self.n_err)
         self.improved <<= False
         if not bool(self.last_minibatch):

@@ -28,6 +28,12 @@ class EvaluatorBase(XLAUnit):
         self.input = Array()        # network output (probs for softmax)
         self.err_output = Array()   # derivative handed to the GD chain
         self.loss = 0.0
+        #: error metric the Decision consumes (count for softmax, the
+        #: loss itself for MSE). Present from construction: the Decision
+        #: links it at wiring time, and eager link_attrs validation
+        #: (units.LinkError) rightly rejects a source attribute that
+        #: only appears at first run()
+        self.n_err = 0.0
 
 
 class EvaluatorSoftmax(EvaluatorBase):
@@ -106,6 +112,9 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err = int(n_err)
         if self._accumulate_confusion():
             self.confusion_matrix.map_write()
+            # the CxC pull rides the scalar sync two lines up (loss/
+            # n_err already crossed to host): no extra pipeline stall
+            # velint: disable=hot-sync
             self.confusion_matrix.mem += np.asarray(conf)
 
     def _accumulate_confusion(self) -> bool:

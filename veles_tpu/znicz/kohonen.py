@@ -77,6 +77,10 @@ class KohonenForward(Forward):
         x = self.input.devmem(d).reshape(len(self.input), -1)
         winners = self._fn(x, self.weights.devmem(d))
         self.output.set_devmem(winners)
+        # the hits histogram is host-side int64 state scattered with
+        # np.add.at (no jax scatter-add twin on the granular path): the
+        # winners pull is the unit's one deliberate per-minibatch sync
+        # velint: disable=hot-sync
         np.add.at(self.hits.mem, np.asarray(winners), 1)
 
 

@@ -53,7 +53,7 @@ class MultiHistogram(Unit):
         if self.input is None or not self.input:
             return
         self.hist, self.bin_edges = np.histogram(
-            np.asarray(self.input.mem).ravel(), bins=self.n_bins)
+            self.input.mem.ravel(), bins=self.n_bins)
 
 
 class ZeroFiller(Unit):
@@ -69,9 +69,8 @@ class ZeroFiller(Unit):
     def run(self) -> None:
         if self.weights is None or not self.weights or self.mask is None:
             return
-        w = np.asarray(self.weights.mem)
-        w[self.mask] = 0.0
-        self.weights.reset(w)
+        self.weights.map_write()
+        self.weights.mem[self.mask] = 0.0
 
 
 class ImageSaver(Unit):
@@ -95,9 +94,9 @@ class ImageSaver(Unit):
                for a in (self.input, self.labels, self.max_idx)):
             return
         os.makedirs(self.directory, exist_ok=True)
-        x = np.asarray(self.input.mem)
-        y = np.asarray(self.labels.mem)
-        pred = np.asarray(self.max_idx.mem)
+        x = self.input.mem
+        y = self.labels.mem
+        pred = self.max_idx.mem
         for i in np.nonzero(pred != y)[0]:
             if self.saved >= self.limit:
                 return
