@@ -1,0 +1,703 @@
+"""Cluster resilience layer: quorum restart decision, the HTTP control
+plane (ClusterCoordinator/ClusterMember with real subprocess children),
+snapshot mirroring (verify-on-upload, idempotent re-push,
+restore-from-mirror) and the cluster-scale fault-plan actions.
+
+The fast tests here drive the protocol with lightweight fake children
+(a few hundred ms each) so the full gang-restart machinery stays
+tier-1; the real-training scenarios live in tools/chaos.py --cluster
+and the `slow`-marked end-to-end cases below."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from veles_tpu.resilience import EXIT_HOST_DEAD, EXIT_ISOLATED
+from veles_tpu.resilience import faults as rfaults
+from veles_tpu.resilience.cluster import (ClusterCoordinator,
+                                          ClusterMember,
+                                          quorum_snapshot)
+from veles_tpu.resilience.faults import FaultPlan
+from veles_tpu.resilience.mirror import (DirMirror, HttpMirror,
+                                         MirrorServer, get_mirror,
+                                         restore_missing)
+from veles_tpu.snapshotter import Snapshotter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    rfaults.install_plan(None)
+    yield
+    rfaults.install_plan(None)
+
+
+# == quorum decision ==========================================================
+
+def _snap(name, digest, mtime):
+    return {"name": name, "digest": digest, "mtime": mtime}
+
+
+def test_quorum_two_of_three_agree_on_newest():
+    """The ISSUE's acceptance case: 2-of-3 hosts agree on the newest
+    snapshot epoch; the third (stale local dir) only sees an older one
+    — the agreed newest wins."""
+    reports = [
+        {"snapshots": [_snap("wf_a", "d1", 100), _snap("wf_b", "d2", 200)]},
+        {"snapshots": [_snap("wf_a", "d1", 100), _snap("wf_b", "d2", 200)]},
+        {"snapshots": [_snap("wf_a", "d1", 100)]},
+    ]
+    assert quorum_snapshot(reports, quorum=2) == "wf_b"
+
+
+def test_quorum_stale_host_cannot_roll_fleet_back():
+    """A lone host holding ONLY an old snapshot can never drag the
+    restart point backwards: the snapshot a quorum can see wins, and a
+    snapshot only one host sees is ineligible."""
+    reports = [
+        {"snapshots": [_snap("wf_new", "dn", 300)]},      # lone viewer
+        {"snapshots": [_snap("wf_old", "do", 100)]},
+        {"snapshots": [_snap("wf_old", "do", 100)]},
+    ]
+    # wf_new has 1 viewer < quorum 2 -> the quorum-agreed older one wins
+    assert quorum_snapshot(reports, quorum=2) == "wf_old"
+
+
+def test_quorum_digest_disagreement_does_not_count():
+    """A host whose copy rotted to different bytes does not count toward
+    the good copy's quorum (the vote is on (name, digest) pairs)."""
+    reports = [
+        {"snapshots": [_snap("wf_b", "good", 200)]},
+        {"snapshots": [_snap("wf_b", "BAD!", 200)]},      # rotted copy
+        {"snapshots": [_snap("wf_a", "d1", 100),
+                       _snap("wf_b", "good", 200)]},
+    ]
+    assert quorum_snapshot(reports, quorum=2) == "wf_b"    # 2x "good"
+    reports[2]["snapshots"][1]["digest"] = "OTHER"         # now 1/1/1
+    assert quorum_snapshot(reports, quorum=2) is None
+
+
+def test_quorum_none_when_nothing_visible():
+    assert quorum_snapshot([{"snapshots": []}, {}], quorum=2) is None
+
+
+# == cluster-scale fault grammar ==============================================
+
+def test_cluster_fault_grammar_and_counters():
+    plan = FaultPlan.parse("host_loss@epoch=2; partition@beat=3; "
+                           "mirror_corrupt@push=1; "
+                           "stale_local_dir@restart=2")
+    assert [e.key for e in plan.entries] == [
+        "host_loss@epoch=2", "partition@beat=3",
+        "mirror_corrupt@push=1", "stale_local_dir@restart=2"]
+    with pytest.raises(ValueError):
+        FaultPlan.parse("partition@epoch=3")   # keys on beat, not epoch
+
+
+def test_host_loss_fault_fires_exactly_once_across_restarts(tmp_path):
+    """host_loss executes a SIGKILL (so its firing cannot be observed
+    in-process); the fire-once guarantee lives in the shared state
+    file, written BEFORE the kill: a restarted process whose restored
+    epoch counter re-crosses the trigger must find the entry spent."""
+    state = str(tmp_path / "fault_state.json")
+    plan = FaultPlan.parse("host_loss@epoch=2", state_path=state)
+    entry = plan._take("host_loss", 2)
+    assert entry is not None and entry.key == "host_loss@epoch=2"
+    plan._mark_fired(entry)                  # what on_epoch does first
+    # "restarted host": a fresh plan instance over the same state file
+    plan2 = FaultPlan.parse("host_loss@epoch=2", state_path=state)
+    assert plan2._take("host_loss", 2) is None
+    plan2.on_epoch(2)                        # must NOT kill this test
+
+
+def test_partition_fault_fires_exactly_once():
+    plan = FaultPlan.parse("partition@beat=2")
+    assert not plan.partition_at_beat(1)
+    assert plan.partition_at_beat(2)
+    assert not plan.partition_at_beat(2)       # spent
+
+
+def test_mirror_corrupt_fault_fires_exactly_once():
+    plan = FaultPlan.parse("mirror_corrupt@push=2")
+    assert not plan.mirror_corrupt_at_push()   # push 1
+    assert plan.mirror_corrupt_at_push()       # push 2: fires
+    assert not plan.mirror_corrupt_at_push()   # push 3: spent
+
+
+def test_stale_local_dir_fault_fires_exactly_once():
+    plan = FaultPlan.parse("stale_local_dir@restart=1")
+    assert not plan.stale_local_dir_at_restart(0)
+    assert plan.stale_local_dir_at_restart(1)
+    assert not plan.stale_local_dir_at_restart(1)
+
+
+# == mirror backends ==========================================================
+
+def _fake_snapshot(directory, name="wf_a.pickle.gz",
+                   payload=b"snapshot-bytes" * 64):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, name)
+    with open(path, "wb") as f:
+        f.write(payload)
+    digest = hashlib.sha256(payload).hexdigest()
+    with open(path + ".sha256", "w") as f:
+        f.write(f"{digest}  {name}\n")
+    return path, digest
+
+
+def test_dir_mirror_push_verify_fetch_roundtrip(tmp_path):
+    path, digest = _fake_snapshot(tmp_path / "local")
+    mirror = DirMirror(str(tmp_path / "mir"))
+    assert mirror.push(path)
+    assert mirror.has("wf_a.pickle.gz", digest)
+    [entry] = mirror.entries()
+    assert entry["name"] == "wf_a.pickle.gz"
+    assert entry["digest"] == digest
+    got = mirror.fetch("wf_a.pickle.gz", str(tmp_path / "restore"))
+    with open(got, "rb") as f1, open(path, "rb") as f2:
+        assert f1.read() == f2.read()
+    assert os.path.exists(got + ".sha256")
+
+
+def test_dir_mirror_second_push_is_noop(tmp_path):
+    """Acceptance: re-pushing an already-mirrored snapshot is a no-op —
+    the mirrored file is not rewritten (mtime pinned proves it) and the
+    mirror holds exactly one copy (no unbounded growth)."""
+    path, _ = _fake_snapshot(tmp_path / "local")
+    mirror = DirMirror(str(tmp_path / "mir"))
+    assert mirror.push(path)
+    mirrored = os.path.join(str(tmp_path / "mir"), "wf_a.pickle.gz")
+    os.utime(mirrored, (1_000_000, 1_000_000))
+    assert mirror.push(path)                       # verified copy held
+    assert os.path.getmtime(mirrored) == 1_000_000  # untouched
+    data_files = [n for n in os.listdir(tmp_path / "mir")
+                  if not n.endswith(".sha256")]
+    assert data_files == ["wf_a.pickle.gz"]
+
+
+def test_dir_mirror_fetch_refuses_corrupt_copy(tmp_path):
+    path, _ = _fake_snapshot(tmp_path / "local")
+    mirror = DirMirror(str(tmp_path / "mir"))
+    mirror.push(path)
+    mirror._corrupt("wf_a.pickle.gz")
+    assert mirror.fetch("wf_a.pickle.gz", str(tmp_path / "r")) is None
+
+
+def test_mirror_corrupt_fault_tears_mirror_not_local(tmp_path):
+    rfaults.install_plan(FaultPlan.parse("mirror_corrupt@push=1"))
+    path, digest = _fake_snapshot(tmp_path / "local")
+    mirror = DirMirror(str(tmp_path / "mir"))
+    mirror.push(path)
+    # local still verifies; mirrored copy does not
+    assert Snapshotter.verify(path)
+    assert mirror.fetch("wf_a.pickle.gz", str(tmp_path / "r")) is None
+
+
+def test_http_mirror_roundtrip_and_token(tmp_path):
+    path, digest = _fake_snapshot(tmp_path / "local")
+    srv = MirrorServer(str(tmp_path / "blob"), token="sekrit").start()
+    try:
+        mirror = HttpMirror(srv.url, token="sekrit")
+        assert mirror.push(path)
+        assert mirror.has("wf_a.pickle.gz", digest)
+        assert mirror.push(path)               # idempotent
+        got = mirror.fetch("wf_a.pickle.gz", str(tmp_path / "r"))
+        with open(got, "rb") as f1, open(path, "rb") as f2:
+            assert f1.read() == f2.read()
+        # wrong/missing token: nothing visible, nothing writable
+        bad = HttpMirror(srv.url, token="wrong")
+        assert bad.entries() == []
+        assert not bad.has("wf_a.pickle.gz", digest)
+        with pytest.raises(Exception):
+            bad.push(path)
+        # corrupt the mirrored copy -> fetch refuses by digest
+        mirror._corrupt("wf_a.pickle.gz")
+        assert mirror.fetch("wf_a.pickle.gz",
+                            str(tmp_path / "r2")) is None
+    finally:
+        srv.stop()
+
+
+def test_http_mirror_failed_verify_unpublishes(tmp_path, monkeypatch):
+    """An upload whose read-back digest mismatches (corrupted in
+    transit) must not leave a poisoned entry behind: push deletes the
+    blob, returns False, and a retry is NOT short-circuited by has()."""
+    path, digest = _fake_snapshot(tmp_path / "local")
+    srv = MirrorServer(str(tmp_path / "blob")).start()
+    try:
+        mirror = HttpMirror(srv.url)
+
+        def corrupt_readback(name, dst):
+            with open(dst, "wb") as f:
+                f.write(b"garbled in transit")
+            return hashlib.sha256(b"garbled in transit").hexdigest()
+
+        monkeypatch.setattr(mirror, "_get_to_file", corrupt_readback)
+        assert not mirror.push(path)
+        monkeypatch.undo()
+        assert not mirror.has("wf_a.pickle.gz", digest)  # unpublished
+        assert mirror.entries() == []
+        assert mirror.push(path)                         # retry works
+        assert mirror.has("wf_a.pickle.gz", digest)
+    finally:
+        srv.stop()
+
+
+#: a child that heartbeats ONCE and then wedges forever (deadlocked
+#: collective): only stall detection can get the cluster out
+FAKE_CHILD_HANG = '''
+import json, os, sys, time
+hb = os.environ["VELES_HEARTBEAT_FILE"]
+args = sys.argv[1:]
+if "--pidfile" in args:
+    with open(args[args.index("--pidfile") + 1], "w") as f:
+        f.write(str(os.getpid()))
+with open(hb + ".t", "w") as f:
+    json.dump({"epoch": 1, "ts": time.time()}, f)
+os.replace(hb + ".t", hb)
+while True:
+    time.sleep(3600)
+'''
+
+
+def test_cluster_member_detects_stalled_child(tmp_path):
+    """Cluster mode must not lose the Supervisor's hang detection: a
+    child that stops heartbeating past stall_timeout is killed and the
+    host reports failed (EXIT_STALLED), driving a coordinator decision
+    instead of hanging the whole cluster forever."""
+    from veles_tpu.resilience import EXIT_STALLED
+    child = _write_child(tmp_path, FAKE_CHILD_HANG)
+    pidfile = tmp_path / "hung.pid"
+    coord = ClusterCoordinator(1, host="127.0.0.1", port=0,
+                               dead_after=60.0, max_restarts=1,
+                               backoff_base=0.05,
+                               backoff_max=0.1).start()
+    member = _member(tmp_path, 0, coord, coord.port,
+                     [sys.executable, child, "--pidfile", str(pidfile)],
+                     beat_s=0.2, stall_timeout=1.0)
+    codes = _run_members([member], timeout=40.0)
+    assert codes["0"] != 0                    # hangs twice -> gave up
+    rep = json.loads((tmp_path / "report_0.json").read_text())
+    assert "budget" in rep["cluster"]["outcome"]
+    assert rep["cluster"]["restarts"] == 1
+    # the restart reason surfaces the documented EXIT_STALLED code
+    assert str(EXIT_STALLED) in rep["cluster"]["generations"][1]["reason"]
+    # the stalled child was killed, not orphaned
+    pid = int(pidfile.read_text())
+    for _ in range(50):
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.1)
+        except OSError:
+            break
+    else:
+        pytest.fail(f"hung child {pid} survived stall detection")
+
+
+def test_mirror_server_rejects_traversal_names(tmp_path):
+    srv = MirrorServer(str(tmp_path / "blob")).start()
+    try:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            srv.url + "/..%2fescape", data=b"x", method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_get_mirror_dispatch(tmp_path):
+    assert isinstance(get_mirror(str(tmp_path)), DirMirror)
+    assert isinstance(get_mirror("http://127.0.0.1:1/x"), HttpMirror)
+
+
+# == Snapshotter integration ==================================================
+
+def _real_snapshot(tmp_path, suffix, mirror="", **kwargs):
+    from veles_tpu.workflow import Workflow
+    wf = Workflow(name="MirrorWF")
+    snap = Snapshotter(wf, prefix="mwf", directory=str(tmp_path),
+                       compression="", mirror=mirror, **kwargs)
+    snap.initialize()
+    snap.suffix = suffix
+    return snap
+
+
+def test_snapshotter_run_mirrors_and_second_write_is_noop(tmp_path):
+    """Acceptance: the Snapshotter's second write of identical content
+    is a no-op re-upload (uncompressed codec = byte-deterministic
+    pickle, same stamp = same name/digest) — the mirrored file is never
+    rewritten and the mirror holds exactly one copy."""
+    mirror_dir = str(tmp_path / "mir")
+    snap = _real_snapshot(tmp_path / "local", "s1", mirror=mirror_dir)
+    snap.run()
+    name = os.path.basename(snap.destination)
+    mirrored = os.path.join(mirror_dir, name)
+    assert os.path.exists(mirrored)
+    assert Snapshotter.verify(mirrored)
+    os.utime(mirrored, (1_000_000, 1_000_000))
+    snap._last_time = 0.0
+    snap.run()                                  # same bytes, same name
+    assert os.path.getmtime(mirrored) == 1_000_000   # no re-upload
+    assert [n for n in os.listdir(mirror_dir)
+            if not n.endswith(".sha256")] == [name]
+
+
+def test_snapshotter_keep_last_prunes_mirror(tmp_path):
+    mirror_dir = str(tmp_path / "mir")
+    snap = _real_snapshot(tmp_path / "local", "a", mirror=mirror_dir,
+                          keep_last=1)
+    for i, suffix in enumerate(("a", "b", "c")):
+        snap.suffix = suffix
+        snap._last_time = 0.0
+        snap.run()
+    data = [n for n in os.listdir(mirror_dir)
+            if not n.endswith(".sha256")]
+    assert len(data) == 1 and "_c" in data[0]
+
+
+def test_latest_restores_from_mirror_when_local_dir_emptied(tmp_path):
+    """The re-placed host: local dir wiped, mirror intact ->
+    latest(mirror=...) re-populates and resumes from durable state."""
+    local = tmp_path / "local"
+    mirror_dir = str(tmp_path / "mir")
+    snap = _real_snapshot(local, "x", mirror=mirror_dir)
+    snap.run()
+    name = os.path.basename(snap.destination)
+    for n in os.listdir(local):
+        os.remove(os.path.join(local, n))
+    assert Snapshotter.latest(str(local), prefix="mwf") is None
+    got = Snapshotter.latest(str(local), prefix="mwf",
+                             mirror=mirror_dir)
+    assert got is not None and os.path.basename(got) == name
+    assert Snapshotter.verify(got)
+    # and the restored pickle actually loads
+    assert Snapshotter.import_(got).name == "MirrorWF"
+
+
+def test_latest_restores_from_mirror_when_local_corrupt(tmp_path):
+    local = tmp_path / "local"
+    mirror_dir = str(tmp_path / "mir")
+    snap = _real_snapshot(local, "x", mirror=mirror_dir)
+    snap.run()
+    with open(snap.destination, "r+b") as f:   # tear the local copy
+        f.seek(10)
+        f.write(b"\x00" * 32)
+    assert Snapshotter.latest(str(local), prefix="mwf") is None
+    got = Snapshotter.latest(str(local), prefix="mwf",
+                             mirror=mirror_dir)
+    assert got is not None and Snapshotter.verify(got)
+
+
+def test_latest_corrupt_mirror_copy_degrades_to_none(tmp_path):
+    """Both copies bad -> no restore, no crash (the member then
+    degrades to a scratch restart instead of failing the attempt)."""
+    local = tmp_path / "local"
+    mirror_dir = str(tmp_path / "mir")
+    snap = _real_snapshot(local, "x", mirror=mirror_dir)
+    snap.run()
+    name = os.path.basename(snap.destination)
+    DirMirror(mirror_dir)._corrupt(name)
+    os.remove(snap.destination)
+    os.remove(snap.destination + ".sha256")
+    assert Snapshotter.latest(str(local), prefix="mwf",
+                              mirror=mirror_dir) is None
+
+
+def test_restore_missing_skips_valid_local_copies(tmp_path):
+    path, _ = _fake_snapshot(tmp_path / "local")
+    mirror = DirMirror(str(tmp_path / "mir"))
+    mirror.push(path)
+    assert restore_missing(mirror, str(tmp_path / "local"), "wf") == []
+
+
+# == control plane with fake children =========================================
+
+#: a fake training child: heartbeats epochs 1..3, dies at epoch 2 when
+#: told to AND not resumed (-s absent) — a deterministic "bug" the gang
+#: restart must recover by resuming every host from the quorum snapshot
+FAKE_CHILD = '''
+import json, os, sys, time
+hb = os.environ["VELES_HEARTBEAT_FILE"]
+args = sys.argv[1:]
+snap = args[args.index("-s") + 1] if "-s" in args else None
+if "--pidfile" in args:
+    with open(args[args.index("--pidfile") + 1], "w") as f:
+        f.write(str(os.getpid()))
+for e in range(1, 4):
+    with open(hb + ".t", "w") as f:
+        json.dump({"epoch": e, "ts": time.time()}, f)
+    os.replace(hb + ".t", hb)
+    if "--die" in args and snap is None and e == 2:
+        sys.exit(1)
+    time.sleep(0.2)
+sys.exit(0)
+'''
+
+#: a fake child that runs (and heartbeats) forever — for scenarios
+#: where the members, not the children, are the story
+FAKE_CHILD_FOREVER = '''
+import json, os, sys, time
+hb = os.environ["VELES_HEARTBEAT_FILE"]
+args = sys.argv[1:]
+if "--pidfile" in args:
+    with open(args[args.index("--pidfile") + 1], "w") as f:
+        f.write(str(os.getpid()))
+e = 0
+while True:
+    e += 1
+    with open(hb + ".t", "w") as f:
+        json.dump({"epoch": e, "ts": time.time()}, f)
+    os.replace(hb + ".t", hb)
+    time.sleep(0.2)
+'''
+
+
+def _write_child(tmp_path, src=FAKE_CHILD, name="child.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return str(p)
+
+
+def _member(tmp_path, host_id, coord, port, child_argv, *, mirror="",
+            beat_s=0.2, coord_timeout=10.0, **kwargs):
+    local = tmp_path / f"h{host_id}"
+    local.mkdir(exist_ok=True)
+    return ClusterMember(
+        [child_argv], host_id=str(host_id),
+        coordinator_addr=f"127.0.0.1:{port}",
+        coordinator=coord, snapshot_dir=str(local),
+        snapshot_prefix="wf", mirror=mirror, beat_s=beat_s,
+        coord_timeout=coord_timeout,
+        report_path=str(tmp_path / f"report_{host_id}.json"), **kwargs)
+
+
+def _run_members(members, timeout=40.0):
+    codes = {}
+    threads = []
+    for m in members:
+        t = threading.Thread(
+            target=lambda m=m: codes.__setitem__(m.host_id, m.run()),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(0.1, deadline - time.monotonic()))
+    assert len(codes) == len(members), \
+        f"members did not all finish: {codes}"
+    return codes
+
+
+def test_cluster_gang_restart_from_quorum_snapshot(tmp_path):
+    """The tentpole path end-to-end on fake children: a child death on
+    host 1 triggers a coordinated generation bump; BOTH hosts gang-kill
+    and respawn with -s pointing at the quorum snapshot; host 1 (empty
+    local dir) restores it from the mirror; the cluster completes."""
+    child = _write_child(tmp_path)
+    mirror_dir = str(tmp_path / "mirror")
+    # seed the "snapshot stream": one snapshot on host 0, mirrored
+    h0 = tmp_path / "h0"
+    path, _ = _fake_snapshot(h0, name="wf_a.pickle.gz")
+    DirMirror(mirror_dir).push(path)
+    coord = ClusterCoordinator(2, host="127.0.0.1", port=0,
+                               dead_after=15.0, backoff_base=0.1,
+                               backoff_max=0.2).start()
+    members = [
+        _member(tmp_path, i, coord if i == 0 else None, coord.port,
+                [sys.executable, child, "--die"], mirror=mirror_dir)
+        for i in range(2)]
+    codes = _run_members(members)
+    assert codes == {"0": 0, "1": 0}
+    rep0 = json.loads((tmp_path / "report_0.json").read_text())
+    cluster = rep0["cluster"]
+    assert cluster["outcome"] == "completed"
+    assert cluster["generation"] == 2 and cluster["restarts"] == 1
+    assert cluster["generations"][1]["snapshot"] == "wf_a.pickle.gz"
+    # host 1 resumed from a MIRROR-RESTORED local copy
+    rep1 = json.loads((tmp_path / "report_1.json").read_text())
+    resumed = [a["snapshot"] for a in rep1["attempts"]
+               if a["generation"] == 2]
+    assert resumed == [str(tmp_path / "h1" / "wf_a.pickle.gz")]
+    assert Snapshotter.verify(resumed[0])
+
+
+def test_cluster_declares_silent_host_dead(tmp_path):
+    """A host that joined and then went silent (its agent died) is
+    declared dead after dead_after: the surviving member exits with the
+    distinct code and the JSON exit report carries the machine-readable
+    dead_hosts list — the scheduler's re-placement signal."""
+    child = _write_child(tmp_path, FAKE_CHILD_FOREVER)
+    pidfile = tmp_path / "child0.pid"
+    coord = ClusterCoordinator(2, host="127.0.0.1", port=0,
+                               dead_after=1.0).start()
+    # host 1: three real beats, then silence (simulated dead agent)
+    from veles_tpu.http_util import http_post_json
+    for _ in range(3):
+        http_post_json("127.0.0.1", coord.port, "/hb",
+                       {"host": "1", "generation": 1,
+                        "status": "running", "epoch": 1,
+                        "snapshots": []})
+        time.sleep(0.1)
+    member = _member(tmp_path, 0, coord, coord.port,
+                     [sys.executable, child, "--pidfile", str(pidfile)],
+                     beat_s=0.2)
+    codes = _run_members([member], timeout=20.0)
+    assert codes == {"0": EXIT_HOST_DEAD}
+    rep = json.loads((tmp_path / "report_0.json").read_text())
+    assert rep["dead_hosts"] == ["1"]
+    assert rep["cluster"]["dead_hosts"] == ["1"]
+    assert rep["cluster"]["exit_code"] == EXIT_HOST_DEAD
+    assert "re-place" in rep["cluster"]["outcome"]
+    # the surviving host's children were gang-killed, not orphaned
+    pid = int(pidfile.read_text())
+    for _ in range(50):
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.1)
+        except OSError:
+            break
+    else:
+        pytest.fail(f"child {pid} still alive after member exit")
+
+
+def test_cluster_partition_fault_rejoins(tmp_path, monkeypatch):
+    """partition@beat=K drops a few heartbeats (< dead_after): the
+    member must REJOIN and the run must complete with zero restarts —
+    a transient partition is not a failure."""
+    child = _write_child(tmp_path)
+    coord = ClusterCoordinator(1, host="127.0.0.1", port=0,
+                               dead_after=30.0).start()
+    member = _member(tmp_path, 0, coord, coord.port,
+                     [sys.executable, child], beat_s=0.1,
+                     coord_timeout=20.0)
+    plan = FaultPlan.parse("partition@beat=2")
+    monkeypatch.setattr(member, "_plan", lambda: plan)
+    codes = _run_members([member], timeout=20.0)
+    assert codes == {"0": 0}
+    rep = json.loads((tmp_path / "report_0.json").read_text())
+    assert rep["cluster"]["restarts"] == 0
+    assert rep["cluster"]["outcome"] == "completed"
+    # the fault really fired (and only once)
+    assert not plan.partition_at_beat(2)
+
+
+def test_cluster_member_isolated_fail_stops(tmp_path):
+    """A member that cannot reach the control plane past coord_timeout
+    kills its children and exits EXIT_ISOLATED (fail-stop: the quorum
+    side of the partition owns the job) — no zombie collective."""
+    child = _write_child(tmp_path, FAKE_CHILD_FOREVER)
+    pidfile = tmp_path / "childx.pid"
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()                                  # nothing listens here
+    member = _member(tmp_path, 0, None, dead_port,
+                     [sys.executable, child, "--pidfile", str(pidfile)],
+                     beat_s=0.1, coord_timeout=1.0)
+    codes = _run_members([member], timeout=20.0)
+    assert codes == {"0": EXIT_ISOLATED}
+    # isolation never spawned children (no directive ever arrived), so
+    # there is nothing to orphan
+    assert not pidfile.exists()
+
+
+def test_cluster_stale_local_dir_fault_restores_mirror(tmp_path,
+                                                       monkeypatch):
+    """stale_local_dir@restart=1 wipes the member's local snapshot dir
+    right before its first respawn (a re-placed host on a fresh disk):
+    the restart must still resume from the mirror-restored copy."""
+    child = _write_child(tmp_path)
+    mirror_dir = str(tmp_path / "mirror")
+    h0 = tmp_path / "h0"
+    path, _ = _fake_snapshot(h0, name="wf_a.pickle.gz")
+    DirMirror(mirror_dir).push(path)
+    coord = ClusterCoordinator(1, host="127.0.0.1", port=0,
+                               dead_after=15.0, backoff_base=0.1,
+                               backoff_max=0.2).start()
+    member = _member(tmp_path, 0, coord, coord.port,
+                     [sys.executable, child, "--die"],
+                     mirror=mirror_dir)
+    plan = FaultPlan.parse("stale_local_dir@restart=1")
+    monkeypatch.setattr(member, "_plan", lambda: plan)
+    codes = _run_members([member], timeout=30.0)
+    assert codes == {"0": 0}
+    rep = json.loads((tmp_path / "report_0.json").read_text())
+    resumed = [a["snapshot"] for a in rep["attempts"]
+               if a["generation"] == 2]
+    assert resumed and resumed[0].endswith("wf_a.pickle.gz")
+    assert Snapshotter.verify(resumed[0])     # restored + verified
+    assert not plan.stale_local_dir_at_restart(1)   # fired once
+
+
+def test_cluster_gives_up_after_restart_budget(tmp_path):
+    """Children that die at the same point every generation exhaust the
+    coordinator's restart budget -> stop directive, EXIT_GIVEUP-family
+    nonzero exit, attempt log intact."""
+    # no snapshots anywhere: every restart is from scratch and dies again
+    child = _write_child(tmp_path)
+    coord = ClusterCoordinator(1, host="127.0.0.1", port=0,
+                               dead_after=15.0, max_restarts=1,
+                               no_progress_limit=99,
+                               backoff_base=0.05,
+                               backoff_max=0.1).start()
+    member = _member(tmp_path, 0, coord, coord.port,
+                     [sys.executable, child, "--die", "--always"])
+    # --always is inert; children keep dying because no snapshot ever
+    # appears (nothing writes one), so -s is never added
+    codes = _run_members([member], timeout=30.0)
+    assert codes["0"] != 0
+    rep = json.loads((tmp_path / "report_0.json").read_text())
+    assert "budget" in rep["cluster"]["outcome"]
+    assert rep["cluster"]["restarts"] == 1
+
+
+# == end-to-end with real training (slow; operational twin of
+# `tools/chaos.py --cluster`) ================================================
+
+def _chaos():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "chaos_tool", os.path.join(REPO, "tools", "chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: mirrors tools/chaos.py CLUSTER_SCENARIOS — kept literal so a new
+#: scenario added to the tool fails the matching-keys check below
+#: instead of silently going untested
+_E2E_SCENARIOS = ("baseline", "kill_h0", "kill_h1", "stale_dir",
+                  "mirror_corrupt", "partition", "host_loss")
+
+
+def test_e2e_matrix_matches_chaos_tool():
+    assert tuple(_chaos().CLUSTER_SCENARIOS) == _E2E_SCENARIOS
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", _E2E_SCENARIOS)
+def test_cluster_e2e_full_matrix(scenario):
+    """The full cross-host recovery matrix on real CPU training runs —
+    the acceptance criteria end-to-end: kill of either host's children,
+    emptied local dir and corrupted mirror copy each recover to the
+    uninterrupted final epoch with zero human intervention; a transient
+    partition is a non-event; a lost host exits 84 with machine-readable
+    dead_hosts."""
+    chaos = _chaos()
+    plans, expect_rc, _ = chaos.CLUSTER_SCENARIOS[scenario]
+    r = chaos.run_cluster_scenario(scenario, plans, expect_rc,
+                                   verbose=True)
+    import shutil
+    shutil.rmtree(r["tmp"], ignore_errors=True)
+    assert r["ok"], r

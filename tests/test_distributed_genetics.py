@@ -305,6 +305,45 @@ def test_worker_gives_up_and_reports_it():
     assert w.ended_by == "gave_up"
 
 
+def test_worker_poll_backs_off_exponentially_with_jitter(monkeypatch):
+    """Unreachable-coordinator polls back off exponentially (jittered,
+    capped) instead of hammering at poll_s: a briefly-down coordinator
+    must not get a thundering herd from the whole worker fleet the
+    moment it comes back."""
+    from veles_tpu import task_queue as tq
+
+    w = FitnessQueueWorker("127.0.0.1", 1, lambda p: 0.0,
+                           poll_s=0.1, give_up_s=1e9,
+                           backoff_max=2.0, backoff_jitter=0.25)
+    delays = []
+
+    class FakeTime:
+        _now = 0.0
+
+        @classmethod
+        def monotonic(cls):
+            return cls._now
+
+        @classmethod
+        def sleep(cls, d):
+            delays.append(d)
+            cls._now += d
+            if len(delays) >= 8:
+                raise KeyboardInterrupt   # enough samples: stop loop
+
+    monkeypatch.setattr(tq, "time", FakeTime)
+    monkeypatch.setattr(
+        w, "_request",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("refused")))
+    with pytest.raises(KeyboardInterrupt):
+        w.run()
+    for i, d in enumerate(delays):
+        base = min(0.1 * (2 ** i), 2.0)
+        assert base <= d <= base * 1.25 + 1e-9, (i, d)
+    # strictly growing until the cap kicks in (jitter < doubling)
+    assert delays[0] < delays[1] < delays[2] < delays[3]
+
+
 def test_bad_token_worker_raises_not_gave_up():
     """PermissionError must escape run() (it subclasses OSError, which
     run() swallows for unreachable-coordinator) so the CLI reports a

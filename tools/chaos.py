@@ -4,17 +4,28 @@ plan the resilience layer claims to survive, and print a pass/fail
 recovery matrix.
 
     python tools/chaos.py [--keep] [--only kill,stall,...]
+    python tools/chaos.py --cluster [--only kill_h0,host_loss,...]
 
-Each scenario runs `python -m veles_tpu --supervise` on a tiny
-synthetic-classifier workflow (6 epochs, snapshots on improvement) with
-one VELES_FAULT_PLAN entry injected, then checks that the run finished
-with the SAME final epoch count as the uninterrupted baseline — i.e.
-recovery was automatic and complete. Exit code: 0 when every scenario
-recovers, 1 otherwise.
+Each single-host scenario runs `python -m veles_tpu --supervise` on a
+tiny synthetic-classifier workflow (6 epochs, snapshots on improvement)
+with one VELES_FAULT_PLAN entry injected, then checks that the run
+finished with the SAME final epoch count as the uninterrupted baseline
+— i.e. recovery was automatic and complete. Exit code: 0 when every
+scenario recovers, 1 otherwise.
 
-This is the operational twin of tests/test_supervisor.py: CI asserts a
-fast subset; this prints the whole matrix for a human (and is the thing
-to run after touching supervisor/snapshotter/fault code).
+`--cluster` runs the CROSS-HOST matrix instead: two member processes
+(`--supervise --cluster` on loopback, host 0 embedding the control
+plane) share a durable snapshot mirror; host 0's child is the snapshot
+writer, host 1 rejoins from the mirror. Scenarios: SIGKILL of either
+host's children (gang restart from the quorum snapshot), an emptied
+local snapshot dir (restore-from-mirror), a corrupted mirror copy
+(digest fallback), a transient control-plane partition (rejoin), and a
+lost host (quorum death -> nonzero exit + machine-readable dead_hosts).
+
+This is the operational twin of tests/test_supervisor.py +
+tests/test_cluster.py: CI asserts a fast subset; this prints the whole
+matrix for a human (and is the thing to run after touching supervisor/
+cluster/mirror/snapshotter/fault code).
 """
 
 from __future__ import annotations
@@ -62,6 +73,138 @@ def run(load, main):
     main()
     print("FINAL", wf.decision.epoch_number, flush=True)
 '''
+
+#: cluster-matrix workflow: identical to WORKFLOW_SRC but the snapshot
+#: writer role is decided by the harness (host 1 runs with
+#: VELES_SNAPSHOT_DRY_RUN=1 and rejoins from the mirror)
+CLUSTER_WORKFLOW_SRC = WORKFLOW_SRC.replace("chaoswf", "clwf") \
+    .replace("ChaosWF", "ClusterWF")
+
+#: cluster matrix: name -> (per-host fault plans {host: plan},
+#: expected exit codes, expectation blurb). Recovery scenarios must end
+#: rc 0 + FINAL 6 on every surviving host; host_loss must end 84 with
+#: dead_hosts naming host 1.
+CLUSTER_SCENARIOS = {
+    "baseline": ({}, (0, 0), "uninterrupted 2-host run completes"),
+    "kill_h0": ({0: "kill@epoch=2"}, (0, 0),
+                "writer host's children SIGKILLed -> gang restart from "
+                "quorum snapshot"),
+    "kill_h1": ({1: "kill@epoch=2"}, (0, 0),
+                "snapshot-less host's children SIGKILLed -> restart, "
+                "rejoin from mirror"),
+    "stale_dir": ({0: "kill@epoch=2; stale_local_dir@restart=1"},
+                  (0, 0),
+                  "writer's local snapshot dir emptied at respawn -> "
+                  "restore from mirror"),
+    "mirror_corrupt": ({0: "mirror_corrupt@push=2; kill@epoch=3"},
+                       (0, 0),
+                       "corrupted mirror copy refused by digest at "
+                       "restore -> blacklisted from future votes, "
+                       "fleet still recovers"),
+    "partition": ({1: "partition@beat=3"}, (0, 0),
+                  "transient control-plane partition (< dead_after) -> "
+                  "member rejoins, run completes"),
+    "host_loss": ({1: "host_loss@epoch=2"}, (84, None),
+                  "host 1 vanishes (agent + children) -> quorum death, "
+                  "exit 84 with machine-readable dead_hosts"),
+}
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_cluster_scenario(name: str, plans: dict, expect_rc,
+                         verbose: bool) -> dict:
+    tmp = tempfile.mkdtemp(prefix=f"chaos_cluster_{name}_")
+    wf_py = os.path.join(tmp, "clwf.py")
+    with open(wf_py, "w") as f:
+        f.write(CLUSTER_WORKFLOW_SRC)
+    mirror = os.path.join(tmp, "mirror")
+    port = _free_port()
+    procs, reports, local_dirs = [], [], []
+    t0 = time.time()
+    for host in (0, 1):
+        local = os.path.join(tmp, f"h{host}")
+        os.makedirs(local, exist_ok=True)
+        local_dirs.append(local)
+        report = os.path.join(tmp, f"report_{host}.json")
+        reports.append(report)
+        env = dict(os.environ)
+        for var in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
+                    "VELES_FAULT_STATE", "VELES_FAULT_PLAN",
+                    "VELES_SNAPSHOT_DRY_RUN"):
+            env.pop(var, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        if host != 0:
+            env["VELES_SNAPSHOT_DRY_RUN"] = "1"   # single-writer
+        if plans.get(host):
+            env["VELES_FAULT_PLAN"] = plans[host]
+        cmd = [sys.executable, "-m", "veles_tpu", wf_py, "--no-stats",
+               "-v", "--supervise",
+               "--cluster", f"127.0.0.1:{port}",
+               "--cluster-hosts", "2", "--host-id", str(host),
+               "--cluster-beat", "0.5", "--cluster-dead-after", "8",
+               "--max-restarts", "3",
+               "--snapshot-dir", local, "--snapshot-prefix", "clwf",
+               "--mirror", mirror, "--supervise-report", report,
+               f"root.clwf.snapshot_dir={local}"]
+        procs.append(subprocess.Popen(
+            cmd, env=env, cwd=tmp, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+        if host == 0:
+            time.sleep(1.0)     # let the control plane bind first
+    outs = []
+    rcs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((out, err))
+        rcs.append(p.returncode)
+    elapsed = time.time() - t0
+
+    def final_epoch(out):
+        lines = [ln for ln in out.splitlines() if ln.startswith("FINAL")]
+        return int(lines[-1].split()[1]) if lines else None
+
+    rep0 = None
+    if os.path.exists(reports[0]):
+        with open(reports[0]) as f:
+            rep0 = json.load(f)
+    cluster = (rep0 or {}).get("cluster") or {}
+    finals = [final_epoch(o) for o, _ in outs]
+    if expect_rc == (84, None):      # host-loss: h1 was SIGKILLed
+        ok = (rcs[0] == 84 and cluster.get("dead_hosts") == ["1"]
+              and (rep0 or {}).get("dead_hosts") == ["1"])
+    else:
+        ok = (tuple(rcs) == expect_rc
+              and all(f == 6 for f in finals)
+              and cluster.get("outcome") == "completed")
+        if plans and name != "partition":
+            # a fault scenario that never needed a restart is a FAIL
+            ok = ok and cluster.get("restarts", 0) >= 1
+        if name == "partition":
+            ok = ok and cluster.get("restarts", 0) == 0
+    if verbose and not ok:
+        for i, (out, err) in enumerate(outs):
+            sys.stderr.write(f"--- host {i} rc={rcs[i]} ---\n"
+                             + err[-2500:] + "\n")
+    return {"tmp": tmp, "ok": ok, "rc": tuple(rcs),
+            "final_epoch": finals[0], "generation":
+                cluster.get("generation"),
+            "restarts": cluster.get("restarts"),
+            "dead_hosts": cluster.get("dead_hosts"),
+            "elapsed": elapsed}
+
 
 #: the matrix: name -> (fault plan, extra CLI flags, expectation)
 SCENARIOS = {
@@ -123,16 +266,57 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", default="",
                     help="comma-separated scenario subset "
-                         f"(of {', '.join(SCENARIOS)})")
+                         f"(of {', '.join(SCENARIOS)}; with --cluster: "
+                         f"{', '.join(CLUSTER_SCENARIOS)})")
+    ap.add_argument("--cluster", action="store_true",
+                    help="run the CROSS-HOST fault matrix (2 loopback "
+                         "member processes + shared mirror) instead of "
+                         "the single-host one")
     ap.add_argument("--keep", action="store_true",
                     help="keep the per-scenario temp dirs for debugging")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="dump child stderr on failure")
     args = ap.parse_args()
+    catalogue = CLUSTER_SCENARIOS if args.cluster else SCENARIOS
     only = {s.strip() for s in args.only.split(",") if s.strip()}
-    unknown = only - set(SCENARIOS)
+    unknown = only - set(catalogue)
     if unknown:
         ap.error(f"unknown scenarios: {sorted(unknown)}")
+
+    if args.cluster:
+        rows = []
+        for name, (plans, expect_rc, blurb) in CLUSTER_SCENARIOS.items():
+            if only and name not in only:
+                continue
+            print(f"chaos[cluster]: {name}: {blurb} …", flush=True)
+            r = run_cluster_scenario(name, plans, expect_rc,
+                                     args.verbose)
+            plan_str = "; ".join(f"h{h}:{p}"
+                                 for h, p in plans.items()) or "—"
+            rows.append((name, plan_str, r))
+            if not args.keep:
+                import shutil
+                shutil.rmtree(r["tmp"], ignore_errors=True)
+        print()
+        print(f"{'scenario':<15} {'fault plan':<42} {'ok':<5} "
+              f"{'rc':<10} {'gen':<4} {'restarts':<9} {'dead':<8} "
+              f"{'secs':<6}")
+        failed = 0
+        for name, plan, r in rows:
+            verdict = "PASS" if r["ok"] else "FAIL"
+            failed += not r["ok"]
+            print(f"{name:<15} {plan:<42} {verdict:<5} "
+                  f"{str(r['rc']):<10} {str(r['generation'] or '-'):<4} "
+                  f"{str(r['restarts'] if r['restarts'] is not None else '-'):<9} "
+                  f"{','.join(r['dead_hosts'] or []) or '-':<8} "
+                  f"{r['elapsed']:<6.1f}")
+        print()
+        if failed:
+            print(f"{failed} cluster scenario(s) did NOT recover",
+                  file=sys.stderr)
+            return 1
+        print("all cluster scenarios recovered")
+        return 0
 
     rows = []
     for name, (plan, extra, blurb) in SCENARIOS.items():

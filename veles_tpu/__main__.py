@@ -80,14 +80,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
-    p.add_argument("--verify-workflow", action="store_true",
+    p.add_argument("--verify-workflow", nargs="?", const="graph",
+                   default=None, choices=("graph", "audit"),
+                   metavar="{graph,audit}",
                    help="statically verify the constructed workflow "
                         "(analysis pass: dangling/shadowed link_attrs "
                         "aliases, AND-gate control cycles, unreachable "
                         "units, read-before-write flows, plus "
                         "environment findings like pre-vma numerics), "
                         "print the findings and exit nonzero on errors "
-                        "WITHOUT training — docs/ANALYSIS.md")
+                        "WITHOUT training — docs/ANALYSIS.md. "
+                        "--verify-workflow=audit ALSO runs the jaxpr "
+                        "auditor over the initialized workflow's fused "
+                        "step (f64 promotion, host syncs, dropped "
+                        "donation, sharding drift; traces, never "
+                        "compiles)")
     p.add_argument("--serve", nargs="?", const=0, default=None, type=int,
                    metavar="PORT",
                    help="serve the (snapshot-restored) model over HTTP "
@@ -165,6 +172,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--supervise-report", default="", metavar="PATH",
                    help="write the supervisor's JSON exit report "
                         "(attempt log, outcome) to PATH")
+    p.add_argument("--mirror", default="", metavar="SPEC",
+                   help="snapshot durability mirror: a second directory "
+                        "or an http(s):// blob-store URL. Every "
+                        "snapshot write is pushed there (sha256-"
+                        "verified, idempotent) and --supervise/--cluster "
+                        "restarts restore from it when the local "
+                        "snapshot dir is missing or corrupt "
+                        "(docs/RESILIENCE.md)")
+    p.add_argument("--cluster", default="", metavar="HOST:PORT",
+                   help="with --supervise: join the cluster control "
+                        "plane at HOST:PORT (host 0 binds it) — "
+                        "cross-host quorum restarts, gang respawn on a "
+                        "coordinated generation counter, dead-host "
+                        "declaration for the scheduler")
+    p.add_argument("--cluster-hosts", type=int, default=1, metavar="N",
+                   help="total hosts in the --cluster job (quorum is "
+                        "N//2+1)")
+    p.add_argument("--host-id", type=int, default=0, metavar="K",
+                   help="this host's index in the --cluster job "
+                        "(0 also runs the coordinator)")
+    p.add_argument("--cluster-beat", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="cluster heartbeat interval (default 1.0)")
+    p.add_argument("--cluster-dead-after", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="declare a host DEAD (stop the run, report it "
+                        "to the scheduler) after this long without a "
+                        "heartbeat from it (default 30)")
     p.add_argument("--nonfinite-guard", action="store_true",
                    help="abort fused/pipelined training with a distinct "
                         "exit code the moment the loss goes NaN/inf "
@@ -206,17 +241,23 @@ def _daemonize(log_path: str, argv) -> int:
 
 
 #: supervisor-only flags, stripped from the child's command line
-#: (flag name -> takes a value)
+#: (flag name -> takes a value). --mirror is NOT here: the child's
+#: Snapshotter needs it to push durable copies.
 _SUPERVISOR_FLAGS = {"--supervise": False, "--max-restarts": True,
                      "--stall-timeout": True, "--snapshot-dir": True,
-                     "--snapshot-prefix": True, "--supervise-report": True}
+                     "--snapshot-prefix": True, "--supervise-report": True,
+                     "--cluster": True, "--cluster-hosts": True,
+                     "--host-id": True, "--cluster-beat": True,
+                     "--cluster-dead-after": True}
 
 
 def _supervise(args, argv) -> int:
     """--supervise: become the resilience supervisor. This process stays
     import-light (no jax, no workflow module) — it only spawns/watches
     the real training command (= argv minus the supervisor-only flags)
-    and restarts it from snapshots."""
+    and restarts it from snapshots. With --cluster it becomes the
+    per-host member of the cross-host control plane instead (host 0
+    also runs the coordinator)."""
     if args.serve is not None:
         raise SystemExit("--supervise supervises training runs; it "
                          "conflicts with --serve")
@@ -227,12 +268,48 @@ def _supervise(args, argv) -> int:
     from veles_tpu.resilience.supervisor import Supervisor, strip_flags
     cmd = [sys.executable, "-m", "veles_tpu"] \
         + strip_flags(argv, _SUPERVISOR_FLAGS)
+    if args.cluster:
+        from veles_tpu.resilience.cluster import (ClusterCoordinator,
+                                                  ClusterMember)
+        token = os.environ.get("VELES_WEB_TOKEN") or None
+        host, _, port = args.cluster.rpartition(":")
+        if not port.isdigit():
+            raise SystemExit(f"--cluster needs host:port "
+                             f"(got {args.cluster!r})")
+        if not token and host not in ("127.0.0.1", "localhost", "::1"):
+            # same secure-by-default rule as --optimize -l: restart
+            # directives on an open port = any peer can roll back or
+            # stop the fleet. An EMPTY host is NOT exempt — it makes
+            # the coordinator bind 0.0.0.0.
+            raise SystemExit(
+                "--cluster on a non-loopback address needs a shared "
+                "secret: set VELES_WEB_TOKEN on every host (or bind "
+                "127.0.0.1:PORT for single-box tests)")
+        coordinator = None
+        if args.host_id == 0:
+            coordinator = ClusterCoordinator(
+                args.cluster_hosts, host=host or "0.0.0.0",
+                port=int(port), token=token,
+                dead_after=args.cluster_dead_after,
+                max_restarts=args.max_restarts).start()
+        member = ClusterMember(
+            [cmd], host_id=str(args.host_id),
+            coordinator_addr=f"{host or '127.0.0.1'}:{port}",
+            coordinator=coordinator,
+            snapshot_dir=args.snapshot_dir,
+            snapshot_prefix=args.snapshot_prefix,
+            mirror=args.mirror, token=token, beat_s=args.cluster_beat,
+            coord_timeout=max(args.cluster_dead_after * 2, 10.0),
+            stall_timeout=args.stall_timeout,
+            report_path=args.supervise_report)
+        return member.run()
     sup = Supervisor(
         [cmd], snapshot_dir=args.snapshot_dir,
         snapshot_prefix=args.snapshot_prefix,
         max_restarts=args.max_restarts,
         stall_timeout=args.stall_timeout,
-        report_path=args.supervise_report)
+        report_path=args.supervise_report,
+        mirror=args.mirror)
     return sup.run()
 
 
@@ -253,6 +330,9 @@ def main(argv=None) -> int:
         print(daemon_pid, flush=True)
         return 0
     set_verbosity(args.verbose)
+    if args.cluster and not args.supervise:
+        raise SystemExit("--cluster is a supervision mode: combine it "
+                         "with --supervise")
     if args.supervise:
         return _supervise(args, argv if argv is not None else sys.argv[1:])
     if args.no_plot:
@@ -305,7 +385,8 @@ def main(argv=None) -> int:
         tp=args.tp, sp=args.sp, ep=args.ep,
         compile_cache=not args.no_compile_cache,
         nonfinite_guard=args.nonfinite_guard,
-        verify_workflow=args.verify_workflow)
+        verify_workflow=args.verify_workflow or "",
+        mirror=args.mirror)
     if args.verify_workflow:
         # takes precedence over every execution mode (incl. --optimize,
         # which otherwise bypasses Launcher.main entirely): the flag

@@ -20,6 +20,13 @@ them (rule catalogue + one-line triggering examples in docs/ANALYSIS.md):
 - `lock-no-with` (error): a bare `<x>.acquire()` call statement on a
   lock-named attribute: an exception between acquire and release wedges
   every later caller. Use `with lock:`.
+- `loader-thread` (error): a `threading.Thread` / `ThreadPoolExecutor`
+  constructed in LOADER code (path under `loader/`) by a class that
+  defines no `stop()` method. Loaders own background prefetch threads,
+  and the teardown contract is `Workflow._stop_units` calling every
+  unit's `stop()` — a loader that spawns threads without a stop/join
+  path leaks them past Ctrl-C/teardown (the exact bug the teardown
+  hardening fixed once already).
 
 Suppression: append `# velint: disable=RULE[,RULE2]` (or `disable=all`)
 to the offending line. CI gate: `tools/velint.py --ci` compares against
@@ -45,7 +52,23 @@ RULES: Dict[str, str] = {
     "trace-time": "time.time()/random.* inside a traced function "
                   "(freezes into the jaxpr at trace time)",
     "lock-no-with": "lock .acquire() outside a with statement",
+    "loader-thread": "thread/executor created in loader code by a "
+                     "class with no stop() (stop_units teardown "
+                     "contract)",
 }
+
+#: call chains that create background threads (the loader-thread rule)
+_THREAD_CTORS = ("threading.Thread", "Thread", "ThreadPoolExecutor",
+                 "futures.ThreadPoolExecutor",
+                 "concurrent.futures.ThreadPoolExecutor")
+
+
+def _is_loader_path(path: str) -> bool:
+    """Loader code = anything under a `loader/` directory or a file
+    whose name contains "loader" (loader.py, image_loader.py)."""
+    parts = re.split(r"[/\\]", path)
+    return any(p == "loader" for p in parts[:-1]) \
+        or "loader" in parts[-1].lower()
 
 #: method names that ARE the per-minibatch hot path of a unit
 _HOT_METHODS = ("run", "xla_run")
@@ -94,6 +117,9 @@ class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module) -> None:
         self.path = path
         self.findings: List[LintFinding] = []
+        self._loader_file = _is_loader_path(path)
+        #: innermost-class stack of "defines a stop() method" flags
+        self._class_stop: List[bool] = []
         self._class_depth = 0
         self._hot_depth = 0       # inside a run()/xla_run() method body
         self._traced_depth = 0    # inside a traced function body
@@ -130,7 +156,11 @@ class _Linter(ast.NodeVisitor):
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self._class_depth += 1
+        self._class_stop.append(any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "stop" for n in node.body))
         self.generic_visit(node)
+        self._class_stop.pop()
         self._class_depth -= 1
 
     def _visit_function(self, node) -> None:
@@ -200,6 +230,16 @@ class _Linter(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         chain = _attr_chain(node.func)
         leaf = chain.rsplit(".", 1)[-1] if chain else ""
+
+        if self._loader_file and chain in _THREAD_CTORS \
+                and not (self._class_stop and self._class_stop[-1]):
+            self._emit(node, "loader-thread",
+                       f"`{chain}(...)` in loader code "
+                       + ("by a class with no stop() method"
+                          if self._class_stop else "at module scope")
+                       + ": background produce threads must have a "
+                         "stop/join path — Workflow teardown calls "
+                         "every unit's stop() (stop_units contract)")
 
         if chain == "jax.jit" and self._loop_depth:
             self._emit(node, "jit-in-loop",

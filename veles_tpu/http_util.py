@@ -8,18 +8,47 @@ import urllib.request
 
 
 def http_put_file(url: str, path: str, timeout: float = 60.0,
-                  content_type: str = "application/octet-stream") -> int:
+                  content_type: str = "application/octet-stream",
+                  headers=None) -> int:
     """STREAM a file to `url` via PUT (Content-Length set from the file;
     urllib sends a seekable body in chunks — no full read into memory).
     Returns the response status. Shared by the snapshot mirror and the
-    forge HTTP client so transport fixes land in one place."""
+    forge HTTP client so transport fixes land in one place. `headers`
+    adds extra request headers (the mirror's shared-token auth)."""
     with open(path, "rb") as f:
         req = urllib.request.Request(url, data=f, method="PUT")
         req.add_header("Content-Type", content_type)
         req.add_header("Content-Length", str(os.path.getsize(path)))
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
             return resp.status
+
+
+def http_post_json(host: str, port: int, path: str, obj,
+                   token=None, timeout: float = 10.0):
+    """POST a JSON object to `http://host:port{path}` and parse the JSON
+    reply; returns None on any non-200 status. Raises OSError (incl.
+    socket timeouts) for unreachable peers — callers own the retry
+    policy. Shared by the cluster member's heartbeat client so the
+    token/timeout handling matches the other control planes."""
+    import http.client
+    import json
+    payload = json.dumps(obj)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["X-Veles-Token"] = token
+    try:
+        conn.request("POST", path, payload, headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            return None
+        return json.loads(data)
+    finally:
+        conn.close()
 
 
 def check_shared_token(handler, token) -> bool:

@@ -42,7 +42,8 @@ class Launcher(Logger):
                  tp: Optional[int] = None, sp: Optional[int] = None,
                  ep: bool = False, compile_cache: bool = True,
                  nonfinite_guard: bool = False,
-                 verify_workflow: bool = False,
+                 verify_workflow: str = "",
+                 mirror: str = "",
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -142,9 +143,17 @@ class Launcher(Logger):
         #: modes (resilience layer: the Supervisor rolls back one
         #: snapshot before retrying)
         self.nonfinite_guard = nonfinite_guard
-        #: static-analysis-only mode: verify the constructed workflow
-        #: graph, print findings, exit nonzero on errors, never train
-        self.verify_workflow = verify_workflow
+        #: static-analysis-only mode ("", "graph" or "audit"): verify
+        #: the constructed workflow graph — "audit" ALSO runs the jaxpr
+        #: auditor over the initialized workflow's fused step — print
+        #: findings, exit nonzero on errors, never train
+        if verify_workflow is True:     # pre-PR-4 boolean callers
+            verify_workflow = "graph"
+        self.verify_workflow = verify_workflow or ""
+        #: snapshot durability mirror spec (resilience/mirror.py):
+        #: wired onto the workflow's Snapshotter before the run so
+        #: every snapshot write pushes a verified durable copy
+        self.mirror = mirror
         #: opt-out for the persistent XLA compile cache (the cache is
         #: also auto-skipped on axon backends — see
         #: enable_compilation_cache)
@@ -244,13 +253,32 @@ class Launcher(Logger):
         """--verify-workflow: run the static graph verifier plus the
         config-level environment findings over the CONSTRUCTED (not
         initialized) workflow, print every finding, and exit nonzero on
-        errors — no initialization, no training, no devices."""
+        errors — no training. The default "graph" mode never
+        initializes and never touches a device; "audit" additionally
+        initializes the workflow (host-side) and runs the jaxpr auditor
+        over its fused step — `make_jaxpr` only traces, it never
+        compiles, so the promise "exit without training" still holds."""
         from veles_tpu.analysis.graph import verify_workflow
         from veles_tpu.analysis.trace import environment_findings
         findings = list(verify_workflow(self.workflow))
         findings += environment_findings(
             pp=self.pp, tp=self.tp, sp=self.sp,
             nonfinite_guard=(self.nonfinite_guard or self.debug_nans))
+        if self.verify_workflow == "audit":
+            if not hasattr(self.workflow, "build_fused_step"):
+                print(f"verify-workflow: audit skipped — "
+                      f"{type(self.workflow).__name__} has no fused "
+                      f"step (StandardWorkflow-family only)",
+                      flush=True)
+            else:
+                from veles_tpu.analysis.trace import audit_workflow
+                # nonfinite_guard=None: environment_findings above
+                # already emitted the guard-off warning once
+                audit_finds = audit_workflow(self.workflow,
+                                             nonfinite_guard=None)
+                print(f"verify-workflow: audit traced the fused step "
+                      f"({len(audit_finds)} finding(s))", flush=True)
+                findings += audit_finds
         for f in findings:
             print(f.format(), flush=True)
         n_err = sum(1 for f in findings if f.severity == "error")
@@ -326,6 +354,11 @@ class Launcher(Logger):
         # still reported before the process stops heartbeating.
         from veles_tpu.resilience import faults as _faults
         from veles_tpu.resilience import hooks as _rhooks
+        if self.mirror and getattr(self.workflow, "snapshotter",
+                                   None) is not None:
+            # durability plumbing (--mirror / cluster member child):
+            # every snapshot write pushes a verified copy to the mirror
+            self.workflow.snapshotter.mirror = self.mirror
         installed_hooks = []
         hb_path = os.environ.get("VELES_HEARTBEAT_FILE", "")
         if hb_path:

@@ -7,9 +7,21 @@ snapshot" — this package turns that from a manual procedure into code:
   process(es), detects death AND hangs (heartbeat file touched every
   epoch), and restarts from `Snapshotter.latest` with a bounded retry
   budget, exponential backoff + jitter and a no-progress cutoff.
+- `cluster.py` — cross-host supervision: per-host `ClusterMember`
+  agents join a `ClusterCoordinator` HTTP control plane that decides
+  restarts by QUORUM (newest snapshot visible to a majority of hosts),
+  gang-restarts the whole job on a coordinated generation counter, and
+  declares silent hosts dead (machine-readable `dead_hosts` in the
+  exit report for the scheduler).
+- `mirror.py` — snapshot durability: every atomic local write is
+  mirrored (second directory or HTTP store) with verify-on-upload and
+  idempotent re-push; restores fall back to the mirror when the local
+  dir is missing or corrupt.
 - `faults.py` — deterministic fault injection (`VELES_FAULT_PLAN`):
   `kill@epoch=K`, `hang@epoch=K`, `nan@step=K`,
-  `corrupt_snapshot@write=K` — so every recovery path is testable on
+  `corrupt_snapshot@write=K`, plus the cluster-scale faults
+  `host_loss@epoch=K`, `partition@beat=K`, `mirror_corrupt@push=K`,
+  `stale_local_dir@restart=K` — so every recovery path is testable on
   CPU in CI, zero-cost when no plan is set.
 - `hooks.py` — the process-wide epoch hook registry the Decision unit
   fires at each epoch boundary (heartbeats + epoch-keyed faults ride
@@ -33,6 +45,16 @@ EXIT_GIVEUP = 82
 
 #: a child was killed by the supervisor after its heartbeat went stale.
 EXIT_STALLED = 83
+
+#: the cluster coordinator declared one or more hosts dead (missed
+#: heartbeats past dead_after): the run cannot continue until the
+#: scheduler re-places them — the exit report's `dead_hosts` says which.
+EXIT_HOST_DEAD = 84
+
+#: a cluster member lost contact with the control plane past its
+#: timeout and fail-stopped (killed its children, exited) — the quorum
+#: side of the partition owns the job.
+EXIT_ISOLATED = 85
 
 
 class NonFiniteLossError(RuntimeError):

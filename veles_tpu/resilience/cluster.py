@@ -1,0 +1,763 @@
+"""Cluster supervision: cross-host quorum restart over an HTTP control
+plane.
+
+PR 1 gave every HOST a `Supervisor`, but each one guessed alone: a dead
+host was invisible (its supervisor died with it), and each supervisor
+trusted its own snapshot directory — a host with a stale local dir
+could restart "from the newest snapshot" and silently roll the fleet
+back. This module closes both gaps (ROADMAP "Still manual" items):
+
+- `ClusterCoordinator` — a tiny HTTP control plane (same
+  loopback-testable hardening as task_queue/web_status: shared token,
+  bounded bodies) that aggregates per-host heartbeats. It owns the
+  restart decision: when any host's children die, it bumps a cluster
+  GENERATION counter and picks the restart snapshot by **quorum** —
+  the newest snapshot visible to at least `quorum` hosts (default
+  majority), so no single stale host can pick the rollback point. A
+  host that misses heartbeats past `dead_after` is declared **dead**:
+  the run stops with a distinct exit code and the JSON exit report
+  carries a machine-readable `dead_hosts` list — exactly what the
+  cluster scheduler needs in order to re-place it.
+- `ClusterMember` — the per-host agent (runs the coordinator in-process
+  on host 0): gang-spawns the host's `-l`/`-m` process set, reports
+  liveness/epoch/visible-snapshots every beat, and on a generation bump
+  gang-kills + respawns from the directive snapshot — restoring it
+  **from the mirror** (resilience/mirror.py) when the local copy is
+  missing or corrupt, so a re-placed host rejoins from durable state.
+
+The SPMD contract stays the reference's (SURVEY.md §5.3): one process
+lost = the collective is dead = restart the JOB — now cluster-wide and
+from an agreed-on snapshot.
+
+Import-light on purpose: no jax, no workflow machinery — members and
+the coordinator are the processes that must outlive any model bug.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from veles_tpu.logger import Logger
+from veles_tpu.resilience import (EXIT_GIVEUP, EXIT_HOST_DEAD,
+                                  EXIT_ISOLATED, EXIT_NONFINITE)
+from veles_tpu.resilience.supervisor import read_heartbeat
+
+#: heartbeats a partition fault suppresses once it fires (long enough
+#: to be visible in the coordinator's beat ages, short enough to stay
+#: under any sane dead_after so the member REJOINS instead of dying)
+PARTITION_BEATS = 3
+
+
+# -- quorum decision (pure function: the unit-testable core) ------------------
+
+def quorum_snapshot(reports: Sequence[Dict[str, Any]],
+                    quorum: int) -> Optional[str]:
+    """The restart snapshot: the newest (by reported mtime) snapshot
+    NAME that at least `quorum` hosts report as visible **with an
+    agreeing digest**. Each report carries
+    ``{"snapshots": [{"name", "digest", "mtime"}, ...]}``.
+
+    Counting (name, digest) pairs — not bare names — means a host whose
+    LOCAL copy rotted to different bytes (local reports re-hash against
+    the sidecar) does not count toward the quorum of the good copy, and
+    a lone host holding a snapshot nobody else can see (the stale-dir
+    rollback hazard, or a half-mirrored newest file) can never drag the
+    fleet to it. Mirror-visible entries are counted on their sidecar
+    claim; a mirror blob whose bytes rotted under an intact sidecar is
+    caught at restore time (fetch re-verifies) and blacklisted from the
+    reporting host's future votes. Returns None when nothing reaches
+    quorum (restart from scratch)."""
+    seen: Dict[tuple, Dict[str, Any]] = {}
+    for host_idx, rep in enumerate(reports):
+        for snap in rep.get("snapshots") or ():
+            try:
+                key = (str(snap["name"]), str(snap["digest"]))
+                mtime = float(snap.get("mtime", 0.0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            ent = seen.setdefault(key, {"hosts": set(), "mtime": 0.0})
+            ent["hosts"].add(host_idx)
+            ent["mtime"] = max(ent["mtime"], mtime)
+    best: Optional[str] = None
+    best_order = None
+    for (name, _digest), ent in seen.items():
+        if len(ent["hosts"]) < max(1, quorum):
+            continue
+        order = (ent["mtime"], name)
+        if best_order is None or order > best_order:
+            best_order = order
+            best = name
+    return best
+
+
+class ClusterCoordinator(Logger):
+    """The control plane. One per cluster, embedded in host 0's member
+    process (or run standalone). Pure state machine + HTTP transport;
+    every decision happens under one lock inside `handle_beat`, so the
+    logic is directly drivable in-process by tests."""
+
+    def __init__(self, n_hosts: int, host: str = "0.0.0.0",
+                 port: int = 0, *, token: Optional[str] = None,
+                 quorum: int = 0, dead_after: float = 30.0,
+                 join_grace: float = 120.0, max_restarts: int = 3,
+                 no_progress_limit: int = 2,
+                 backoff_base: float = 1.0, backoff_max: float = 30.0,
+                 max_body: int = 1 << 20) -> None:
+        super().__init__()
+        if n_hosts < 1:
+            raise ValueError(f"n_hosts must be >= 1 (got {n_hosts})")
+        self.n_hosts = n_hosts
+        #: majority by default; an explicit quorum may be smaller (2-of-5
+        #: when three hosts share no storage) but never below 1
+        self.quorum = quorum or (n_hosts // 2 + 1)
+        self.host = host
+        self.port = port
+        self.token = token
+        #: a host silent this long is DEAD (scheduler must re-place it)
+        self.dead_after = dead_after
+        #: grace for hosts that never reported at all (first contact
+        #: includes process scheduling + interpreter start on a fresh VM)
+        self.join_grace = join_grace
+        self.max_restarts = max_restarts
+        self.no_progress_limit = no_progress_limit
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.max_body = max_body
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+        #: host_id -> {"last_beat": monotonic, "report": {...}}
+        self._hosts: Dict[str, Dict[str, Any]] = {}
+        self.generation = 1
+        self.snapshot: Optional[str] = None   # directive for current gen
+        self.action = "run"
+        self.exit_code = 0
+        self.outcome = ""
+        self.dead_hosts: List[str] = []
+        self.restarts = 0
+        self._best_epoch = -1
+        self._stagnant = 0
+        #: per-generation log for the exit report
+        self.generations: List[Dict[str, Any]] = [
+            {"generation": 1, "snapshot": None, "reason": "initial"}]
+        #: hosts that have RECEIVED a terminal (done/stop) directive —
+        #: the embedding member drains on this before tearing the
+        #: control plane down, so no peer is left polling a dead port
+        self._acked: set = set()
+        self._httpd = None
+        self._thread = None
+
+    # -- decision core (in-process API; HTTP is transport only) ---------------
+
+    def handle_beat(self, report: Dict[str, Any]) -> Dict[str, Any]:
+        """Ingest one host heartbeat, advance the state machine, return
+        the directive the host must follow."""
+        now = time.monotonic()
+        host_id = str(report.get("host", ""))[:128]
+        with self._lock:
+            self._hosts[host_id] = {"last_beat": now, "report": report}
+            self._sweep_dead(now)
+            if self.action == "run":
+                status = report.get("status")
+                gen = int(report.get("generation", 0))
+                if status == "failed" and gen == self.generation:
+                    self._initiate_restart(
+                        f"host {host_id} children died "
+                        f"(exit codes {report.get('exit_codes')})",
+                        nonfinite=EXIT_NONFINITE in (
+                            report.get("exit_codes") or ()))
+                elif self._all_done():
+                    self.action = "done"
+                    self.outcome = "completed"
+            directive = self._directive()
+            if directive["action"] in ("done", "stop"):
+                self._acked.add(host_id)
+            return directive
+
+    def _sweep_dead(self, now: float) -> None:
+        dead = [hid for hid, h in self._hosts.items()
+                if now - h["last_beat"] > self.dead_after]
+        if len(self._hosts) < self.n_hosts \
+                and now - self._started > max(self.join_grace,
+                                              self.dead_after):
+            expected = {str(i) for i in range(self.n_hosts)}
+            dead += sorted(expected - set(self._hosts))
+        if dead and self.action not in ("stop", "done"):
+            self.dead_hosts = sorted(set(dead))
+            self.action = "stop"
+            self.exit_code = EXIT_HOST_DEAD
+            self.outcome = (f"host(s) {', '.join(self.dead_hosts)} "
+                            f"declared dead after {self.dead_after:.0f}s "
+                            "without a heartbeat: the scheduler must "
+                            "re-place them")
+            self.error("%s", self.outcome)
+
+    def _all_done(self) -> bool:
+        if len(self._hosts) < self.n_hosts:
+            return False
+        return all(h["report"].get("status") == "done"
+                   and int(h["report"].get("generation", 0))
+                   == self.generation
+                   for h in self._hosts.values())
+
+    def _initiate_restart(self, reason: str,
+                          nonfinite: bool = False) -> None:
+        epoch = max((int(h["report"].get("epoch", -1))
+                     for h in self._hosts.values()), default=-1)
+        if epoch > self._best_epoch:
+            self._best_epoch = epoch
+            self._stagnant = 0
+        else:
+            self._stagnant += 1
+        if self.restarts >= self.max_restarts:
+            self.action = "stop"
+            self.exit_code = EXIT_GIVEUP
+            self.outcome = (f"retry budget exhausted "
+                            f"({self.max_restarts} restarts)")
+            return
+        if self._stagnant >= self.no_progress_limit:
+            self.action = "stop"
+            self.exit_code = EXIT_GIVEUP
+            self.outcome = (f"no epoch progress across {self._stagnant} "
+                            f"consecutive failures (stuck at epoch "
+                            f"{self._best_epoch})")
+            return
+        self.restarts += 1
+        self.generation += 1
+        reports = [h["report"] for h in self._hosts.values()]
+        snap = quorum_snapshot(reports, self.quorum)
+        if nonfinite and snap is not None:
+            # the newest quorum snapshot may embed the divergence that
+            # tripped the guard: drop it from every report and re-run
+            # the quorum pick one snapshot back (the cluster analog of
+            # Snapshotter.latest(skip=1))
+            pruned = [{"snapshots": [s for s in (r.get("snapshots")
+                                                 or ())
+                                     if s.get("name") != snap]}
+                      for r in reports]
+            snap = quorum_snapshot(pruned, self.quorum)
+        self.snapshot = snap
+        self.generations.append({
+            "generation": self.generation, "snapshot": snap,
+            "reason": reason, "epoch_reached": epoch})
+        self.warning(
+            "restart -> generation %d from %s (%s; quorum %d/%d)",
+            self.generation, snap or "<scratch>", reason, self.quorum,
+            self.n_hosts)
+
+    def _directive(self) -> Dict[str, Any]:
+        delay = 0.0
+        if self.action == "run" and self.restarts:
+            delay = min(self.backoff_base * (2 ** (self.restarts - 1)),
+                        self.backoff_max)
+        return {"generation": self.generation, "action": self.action,
+                "snapshot": self.snapshot,
+                "dead_hosts": self.dead_hosts,
+                "exit_code": self.exit_code,
+                "backoff": delay,
+                "reason": self.outcome}
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until every live host that ever reported has received
+        the terminal directive (dead hosts cannot ack), or `timeout`.
+        Returns whether the drain completed."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                waiting = (set(self._hosts) - self._acked
+                           - set(self.dead_hosts))
+                if not waiting:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def summary(self) -> Dict[str, Any]:
+        """The cluster block of the exit report."""
+        with self._lock:
+            return {
+                "n_hosts": self.n_hosts, "quorum": self.quorum,
+                "generation": self.generation,
+                "restarts": self.restarts,
+                "dead_hosts": list(self.dead_hosts),
+                "outcome": self.outcome or self.action,
+                "exit_code": self.exit_code,
+                "generations": [dict(g) for g in self.generations],
+                "hosts": {hid: {
+                    "status": h["report"].get("status"),
+                    "generation": h["report"].get("generation"),
+                    "epoch": h["report"].get("epoch"),
+                    "beat_age_s": round(
+                        time.monotonic() - h["last_beat"], 3)}
+                    for hid, h in sorted(self._hosts.items())}}
+
+    # -- HTTP transport -------------------------------------------------------
+
+    def start(self) -> "ClusterCoordinator":
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        from veles_tpu.http_util import check_shared_token
+        outer = self
+        token = self.token
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if not self.path.startswith("/hb"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                if not check_shared_token(self, token):
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    length = -1
+                if not 0 <= length <= outer.max_body:
+                    self.send_response(413 if length > outer.max_body
+                                       else 400)
+                    self.end_headers()
+                    return
+                try:
+                    report = json.loads(self.rfile.read(length)
+                                        or b"{}")
+                    directive = outer.handle_beat(dict(report))
+                except (ValueError, TypeError):
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                body = json.dumps(directive).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — observability endpoint
+                if not self.path.startswith("/status"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                if not check_shared_token(self, token):
+                    return
+                body = json.dumps(outer.summary()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._started = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="cluster-coordinator")
+        self._thread.start()
+        self.info("cluster control plane on %s:%d (%d hosts, quorum "
+                  "%d, dead after %.0fs)", self.host, self.port,
+                  self.n_hosts, self.quorum, self.dead_after)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class ClusterMember(Logger):
+    """Per-host agent: supervises this host's training process set under
+    the coordinator's directives. `host_id` "0" also hosts the
+    coordinator (pass one in via `coordinator=`)."""
+
+    def __init__(self, commands: Sequence[Sequence[str]], *,
+                 host_id: str, coordinator_addr: str,
+                 coordinator: Optional[ClusterCoordinator] = None,
+                 snapshot_dir: str = ".", snapshot_prefix: str = "",
+                 mirror: str = "", token: Optional[str] = None,
+                 beat_s: float = 1.0, coord_timeout: float = 60.0,
+                 stall_timeout: float = 0.0,
+                 term_grace: float = 5.0,
+                 env: Optional[Dict[str, str]] = None,
+                 report_path: str = "") -> None:
+        super().__init__()
+        if commands and isinstance(commands[0], str):
+            commands = [commands]
+        self.commands = [list(c) for c in commands]
+        if not self.commands:
+            raise ValueError("ClusterMember needs at least one command")
+        self.host_id = str(host_id)
+        host, _, port = coordinator_addr.rpartition(":")
+        if not port.isdigit():
+            raise ValueError(f"coordinator address needs host:port "
+                             f"(got {coordinator_addr!r})")
+        self.coord_host = host or "127.0.0.1"
+        self.coord_port = int(port)
+        self.coordinator = coordinator
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_prefix = snapshot_prefix
+        self.mirror_spec = mirror
+        self.token = token
+        self.beat_s = beat_s
+        #: a member that cannot reach the control plane this long is on
+        #: the wrong side of a partition: fail-stop (kill children, exit
+        #: EXIT_ISOLATED) rather than train a zombie collective
+        self.coord_timeout = coord_timeout
+        #: hang detection, same contract as Supervisor.stall_timeout: a
+        #: child whose heartbeat file goes stale this long is killed and
+        #: the host reports "failed" (EXIT_STALLED codes) so the
+        #: coordinator gang-restarts the job; 0 disables
+        self.stall_timeout = stall_timeout
+        self.term_grace = term_grace
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.report_path = report_path
+        self.generation = 0           # nothing spawned yet
+        self.attempts: List[Dict[str, Any]] = []
+        self._procs: List[subprocess.Popen] = []
+        self._hb_paths: List[str] = []
+        self._beats_sent = 0
+        self._suppress_beats = 0
+        self._respawns = 0
+        self._snap_cache: Dict[str, tuple] = {}
+        #: mirror entries whose FETCH failed digest verification: their
+        #: sidecar claim is a lie (bit rot in the store), so this host
+        #: stops reporting them as visible — the next quorum pick can't
+        #: re-elect a snapshot this host has proven unrestorable
+        self._bad_mirror: set = set()
+
+    # -- snapshot visibility --------------------------------------------------
+
+    def _local_snapshots(self) -> List[Dict[str, Any]]:
+        """Valid local snapshots as (name, digest, mtime), verified via
+        the sha256 sidecar, cached on (mtime, size) so a beat never
+        re-hashes an unchanged file."""
+        from veles_tpu.resilience.mirror import (_read_sidecar,
+                                                 _sha256_file)
+        try:
+            names = [n for n in os.listdir(self.snapshot_dir)
+                     if ".pickle" in n
+                     and n.startswith(self.snapshot_prefix)
+                     and not n.endswith((".tmp", ".sha256"))]
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            path = os.path.join(self.snapshot_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            key = (st.st_mtime, st.st_size)
+            cached = self._snap_cache.get(name)
+            if cached is None or cached[0] != key:
+                digest = _read_sidecar(path)
+                valid = (digest is not None
+                         and _sha256_file(path) == digest)
+                cached = (key, digest, valid)
+                self._snap_cache[name] = cached
+            _, digest, valid = cached
+            if valid:
+                out.append({"name": name, "digest": digest,
+                            "mtime": st.st_mtime})
+        return out
+
+    def _visible_snapshots(self) -> List[Dict[str, Any]]:
+        """What this host reports to the quorum: locally held valid
+        snapshots (sidecar digest re-verified by hashing) plus what it
+        can see on the durable mirror — a host with an empty local dir
+        but healthy mirror access still votes for the newest durable
+        snapshot; only a host cut off from BOTH is left voting for its
+        stale view. Mirror entries are counted on their SIDECAR claim
+        (hashing every remote blob per beat would be prohibitive);
+        restores re-verify the bytes, and an entry that ever fails that
+        check lands in `_bad_mirror` and stops being reported."""
+        snaps = {s["name"]: s for s in self._local_snapshots()}
+        if self.mirror_spec:
+            from veles_tpu.resilience.mirror import get_mirror
+            try:
+                for e in get_mirror(self.mirror_spec,
+                                    token=self.token).entries():
+                    name = str(e["name"])
+                    if name in self._bad_mirror:
+                        continue
+                    if self.snapshot_prefix and not name.startswith(
+                            self.snapshot_prefix):
+                        continue
+                    snaps.setdefault(name, {
+                        "name": name, "digest": str(e["digest"]),
+                        "mtime": float(e["mtime"])})
+            except Exception as e:  # noqa: BLE001 — mirror visibility
+                self.warning("mirror %s unreadable: %s",
+                             self.mirror_spec, e)
+        return sorted(snaps.values(), key=lambda s: -s["mtime"])
+
+    def _resolve_snapshot(self, name: Optional[str]) -> Optional[str]:
+        """Directive snapshot name -> local path, restoring from the
+        mirror when the local copy is missing or corrupt; falls back to
+        the newest local valid snapshot, then to older mirror entries,
+        then to a fresh start — a failed restore must degrade, not fail
+        the attempt."""
+        from veles_tpu.snapshotter import Snapshotter
+        if name:
+            local = os.path.join(self.snapshot_dir, name)
+            if os.path.exists(local) and Snapshotter.verify(local):
+                return local
+            if self.mirror_spec:
+                from veles_tpu.resilience.mirror import get_mirror
+                try:
+                    got = get_mirror(self.mirror_spec,
+                                     token=self.token).fetch(
+                        name, self.snapshot_dir)
+                except Exception as e:  # noqa: BLE001
+                    self.warning("mirror fetch of %s failed: %s",
+                                 name, e)
+                    got = None
+                if got is not None:
+                    self.info("restored %s from mirror", name)
+                    return got
+                # the mirror's sidecar claimed this name but the bytes
+                # did not verify (or the fetch died): stop voting for
+                # it so the NEXT quorum pick excludes it
+                self._bad_mirror.add(name)
+            self.warning("directive snapshot %s is unavailable locally "
+                         "AND on the mirror — degrading (and no longer "
+                         "reporting it as visible)", name)
+        return Snapshotter.latest(self.snapshot_dir,
+                                  prefix=self.snapshot_prefix,
+                                  mirror=self.mirror_spec)
+
+    # -- child lifecycle ------------------------------------------------------
+
+    def _spawn(self, run_dir: str, snapshot: Optional[str]) -> None:
+        from veles_tpu.resilience.supervisor import _with_snapshot
+        self._respawns += 1
+        plan = self._plan()
+        if plan is not None and plan.stale_local_dir_at_restart(
+                self._respawns - 1):
+            self.warning("FAULT INJECTION: emptying local snapshot dir "
+                         "%s before respawn (re-placed host)",
+                         self.snapshot_dir)
+            for s in list(self._local_snapshots()):
+                for victim in (s["name"], s["name"] + ".sha256"):
+                    try:
+                        os.remove(os.path.join(self.snapshot_dir,
+                                               victim))
+                    except OSError:
+                        pass
+            self._snap_cache.clear()
+            snapshot = self._resolve_snapshot(
+                os.path.basename(snapshot) if snapshot else None)
+        self._hb_paths = [
+            os.path.join(run_dir,
+                         f"hb_g{self.generation}_{i}.json")
+            for i in range(len(self.commands))]
+        self._procs = []
+        for argv, hb in zip(self.commands, self._hb_paths):
+            if snapshot:
+                argv = _with_snapshot(argv, snapshot)
+            env = dict(self.env)
+            env["VELES_HEARTBEAT_FILE"] = hb
+            self._procs.append(subprocess.Popen(argv, env=env))
+        self.attempts.append({
+            "generation": self.generation,
+            "snapshot": snapshot, "pids":
+                [p.pid for p in self._procs]})
+        self._spawned_at = time.time()   # wall: compared to hb mtimes
+        self.info("generation %d: spawned %d process(es)%s",
+                  self.generation, len(self._procs),
+                  f" from {snapshot}" if snapshot else " fresh")
+
+    def _kill_children(self) -> None:
+        from veles_tpu.resilience.supervisor import kill_procs
+        kill_procs(self._procs, self.term_grace)  # TERM→grace→KILL
+
+    def _children_status(self) -> tuple:
+        """(status, exit_codes): "running" | "done" | "failed". With
+        stall_timeout set, a running child whose heartbeat file went
+        stale (mtime older than the bound, spawn time as startup grace —
+        the Supervisor._monitor contract) is killed here and the whole
+        set reports "failed" with EXIT_STALLED codes, so the
+        coordinator treats a cluster-wide hang like any other death."""
+        from veles_tpu.resilience import EXIT_STALLED
+        codes = [p.poll() for p in self._procs]
+        if any(c is not None and c != 0 for c in codes):
+            return "failed", codes
+        if codes and all(c == 0 for c in codes):
+            return "done", codes
+        if self.stall_timeout > 0 and self._procs:
+            wall_now = time.time()
+            spawned = getattr(self, "_spawned_at", wall_now)
+            for hb, c in zip(self._hb_paths, codes):
+                if c is not None:
+                    continue     # finished children don't heartbeat
+                try:
+                    last = os.path.getmtime(hb)
+                except OSError:
+                    last = spawned        # not yet written: startup
+                stale = wall_now - max(last, spawned)
+                if stale > self.stall_timeout:
+                    self.warning(
+                        "heartbeat %s stale for %.1fs (> %.1fs) — "
+                        "declaring this host's job hung", hb, stale,
+                        self.stall_timeout)
+                    self._kill_children()
+                    return "failed", [
+                        EXIT_STALLED if (c2 is not None and c2 < 0)
+                        else c2 for c2 in
+                        (p.poll() for p in self._procs)]
+        return "running", codes
+
+    def _epoch(self) -> int:
+        return max((read_heartbeat(p)["epoch"]
+                    for p in self._hb_paths), default=-1)
+
+    # -- control-plane client -------------------------------------------------
+
+    def _plan(self):
+        from veles_tpu.resilience.faults import active_plan
+        return active_plan()
+
+    def _beat(self, status: str, codes: List[Any]
+              ) -> Optional[Dict[str, Any]]:
+        """Send one heartbeat; returns the directive, or None when the
+        coordinator is unreachable OR a partition fault is suppressing
+        this beat."""
+        self._beats_sent += 1
+        plan = self._plan()
+        if plan is not None and plan.partition_at_beat(self._beats_sent):
+            self._suppress_beats = PARTITION_BEATS
+            self.warning("FAULT INJECTION: partition — dropping %d "
+                         "heartbeat(s)", PARTITION_BEATS)
+        if self._suppress_beats > 0:
+            self._suppress_beats -= 1
+            return None
+        report = {"host": self.host_id, "generation": self.generation,
+                  "status": status,
+                  "exit_codes": [c for c in codes],
+                  "epoch": self._epoch(),
+                  "snapshots": self._visible_snapshots()}
+        from veles_tpu.http_util import http_post_json
+        try:
+            return http_post_json(self.coord_host, self.coord_port,
+                                  "/hb", report, token=self.token,
+                                  timeout=max(5.0, self.beat_s * 3))
+        except OSError:
+            return None
+
+    # -- main loop ------------------------------------------------------------
+
+    def run(self) -> int:
+        run_dir = tempfile.mkdtemp(
+            prefix=f"veles_cluster_h{self.host_id}_")
+        self.env.setdefault("VELES_FAULT_STATE",
+                            os.path.join(run_dir, "fault_state.json"))
+        last_contact = time.monotonic()
+
+        # SIGTERM (scheduler preempting the AGENT) must not orphan the
+        # training children: convert to the Ctrl-C teardown path (same
+        # contract as Supervisor.run; no-op off the main thread)
+        def _to_interrupt(*_):
+            raise KeyboardInterrupt
+
+        import signal
+        try:
+            prev_term = signal.signal(signal.SIGTERM, _to_interrupt)
+        except ValueError:
+            prev_term = None
+        try:
+            while True:
+                status, codes = (self._children_status()
+                                 if self._procs else ("joining", []))
+                directive = self._beat(status, codes)
+                if directive is None:
+                    if time.monotonic() - last_contact \
+                            > self.coord_timeout:
+                        self.error(
+                            "no control-plane contact for %.0fs: this "
+                            "host is partitioned — killing children "
+                            "and exiting (fail-stop, the quorum side "
+                            "owns the job)", self.coord_timeout)
+                        self._kill_children()
+                        return self._finish(EXIT_ISOLATED,
+                                            "isolated from the control "
+                                            "plane")
+                    time.sleep(self.beat_s)
+                    continue
+                last_contact = time.monotonic()
+                action = directive.get("action")
+                if action in ("done", "stop"):
+                    self._kill_children()   # "done": no-op, exited 0
+                    if self.coordinator is not None:
+                        # keep the control plane up until every live
+                        # peer has received the terminal directive too
+                        self.coordinator.drain(
+                            timeout=max(5.0, self.beat_s * 10))
+                    if action == "done":
+                        return self._finish(0, "completed")
+                    code = int(directive.get("exit_code")
+                               or EXIT_GIVEUP)
+                    return self._finish(
+                        code, directive.get("reason") or "stopped",
+                        dead_hosts=directive.get("dead_hosts"))
+                gen = int(directive.get("generation", 1))
+                if gen > self.generation:
+                    # gang restart on the coordinated generation counter
+                    self._kill_children()
+                    backoff = float(directive.get("backoff") or 0.0)
+                    if backoff:
+                        time.sleep(backoff)
+                    self.generation = gen
+                    # no directive snapshot = run the argv as-is: the
+                    # initial generation, or a quorum that agreed on
+                    # NOTHING (scratch restart — resolving a local
+                    # latest() unilaterally here would reintroduce the
+                    # stale-dir rollback hazard the quorum exists for)
+                    name = directive.get("snapshot")
+                    self._spawn(run_dir,
+                                self._resolve_snapshot(name)
+                                if name else None)
+                time.sleep(self.beat_s)
+        except KeyboardInterrupt:
+            self._kill_children()
+            return self._finish(130, "terminated by signal")
+        finally:
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
+            shutil.rmtree(run_dir, ignore_errors=True)
+
+    def _finish(self, code: int, outcome: str,
+                dead_hosts: Optional[List[str]] = None) -> int:
+        report: Dict[str, Any] = {
+            "outcome": outcome, "exit_code": code,
+            "host": self.host_id, "generation": self.generation,
+            "dead_hosts": list(dead_hosts or []),
+            "attempts": self.attempts}
+        if self.coordinator is not None:
+            cluster = self.coordinator.summary()
+            report["cluster"] = cluster
+            report["dead_hosts"] = cluster["dead_hosts"]
+        (self.info if code == 0 else self.error)(
+            "cluster member %s: %s (exit %d, generation %d%s)",
+            self.host_id, outcome, code, self.generation,
+            f", dead hosts {report['dead_hosts']}"
+            if report["dead_hosts"] else "")
+        print(f"cluster member {self.host_id}: {outcome} "
+              f"(generation {self.generation})", file=sys.stderr,
+              flush=True)
+        if self.report_path:
+            with open(self.report_path, "w") as f:
+                json.dump(report, f, indent=2)
+        if self.coordinator is not None:
+            self.coordinator.stop()
+        return code

@@ -24,6 +24,25 @@ Actions:
   torn post-write (garbage bytes mid-file), simulating a half-written
   checkpoint that the sha256 sidecar must catch.
 
+Cluster-scale actions (resilience/cluster.py + mirror.py):
+
+- ``host_loss`` — at the end of epoch K the process SIGKILLs its
+  PARENT (the per-host cluster member agent) and then itself: the
+  whole host vanishes at once — children, supervisor, heartbeats —
+  which is what a preempted/failed VM looks like to the cluster
+  coordinator (quorum death detection, not process restart).
+- ``partition`` — the K-th control-plane heartbeat this member would
+  send starts a window of ``PARTITION_BEATS`` dropped beats (a
+  transient network partition shorter than ``dead_after``: the member
+  must rejoin, not die).
+- ``mirror_corrupt`` — the K-th successful mirror push is followed by
+  tearing the MIRRORED copy (local stays intact): restore-from-mirror
+  must detect the digest mismatch and degrade instead of restoring
+  garbage.
+- ``stale_local_dir`` — before respawn number K the member empties its
+  local snapshot dir (a re-placed host on a fresh disk): the restart
+  must restore from the durable mirror.
+
 Each entry fires AT MOST ONCE. When ``VELES_FAULT_STATE`` names a file
 (the Supervisor sets it), fired entries are recorded there BEFORE the
 fault executes, so a restarted process — whose restored epoch counter
@@ -47,7 +66,10 @@ from typing import Any, Dict, List, Optional
 _log = logging.getLogger("veles.FaultPlan")
 
 _ACTIONS = {"kill": "epoch", "hang": "epoch", "nan": "step",
-            "corrupt_snapshot": "write"}
+            "corrupt_snapshot": "write",
+            # cluster-scale (resilience/cluster.py, mirror.py)
+            "host_loss": "epoch", "partition": "beat",
+            "mirror_corrupt": "push", "stale_local_dir": "restart"}
 
 #: sentinel distinguishing "not looked up yet" from "looked up: no plan"
 _UNSET = object()
@@ -85,6 +107,7 @@ class FaultPlan:
         self._fired = set(self._load_state())
         self._train_steps = 0      # counted by the fused loop
         self._snapshot_writes = 0  # counted by the snapshotter hook
+        self._mirror_pushes = 0    # counted by Mirror.push
 
     # -- parsing -------------------------------------------------------------
 
@@ -164,12 +187,24 @@ class FaultPlan:
 
     def on_epoch(self, epoch: int) -> None:
         """Epoch-boundary hook (registered on the hooks registry by the
-        Launcher): executes kill/hang entries keyed on this epoch."""
+        Launcher): executes kill/hang/host_loss entries keyed on this
+        epoch."""
         e = self._take("kill", epoch)
         if e is not None:
             self._mark_fired(e)
             _log.warning("FAULT INJECTION: %s -> SIGKILL self", e.key)
             logging.shutdown()
+            os.kill(os.getpid(), signal.SIGKILL)
+        e = self._take("host_loss", epoch)
+        if e is not None:
+            self._mark_fired(e)
+            _log.warning("FAULT INJECTION: %s -> SIGKILL parent (host "
+                         "agent) + self", e.key)
+            logging.shutdown()
+            try:
+                os.kill(os.getppid(), signal.SIGKILL)
+            except OSError:
+                pass
             os.kill(os.getpid(), signal.SIGKILL)
         e = self._take("hang", epoch)
         if e is not None:
@@ -189,6 +224,39 @@ class FaultPlan:
             return False
         self._mark_fired(e)
         _log.warning("FAULT INJECTION: %s -> loss := NaN", e.key)
+        return True
+
+    def partition_at_beat(self, beat: int) -> bool:
+        """True when the member's `beat`-th control-plane heartbeat
+        should open a dropped-beats window (cluster.PARTITION_BEATS)."""
+        e = self._take("partition", beat)
+        if e is None:
+            return False
+        self._mark_fired(e)
+        _log.warning("FAULT INJECTION: %s -> partition window", e.key)
+        return True
+
+    def mirror_corrupt_at_push(self) -> bool:
+        """True when the current mirror push (counted internally, like
+        snapshot writes) should be followed by tearing the mirrored
+        copy. Called by Mirror.push after a verified upload."""
+        self._mirror_pushes += 1
+        e = self._take("mirror_corrupt", self._mirror_pushes)
+        if e is None:
+            return False
+        self._mark_fired(e)
+        _log.warning("FAULT INJECTION: %s", e.key)
+        return True
+
+    def stale_local_dir_at_restart(self, restart: int) -> bool:
+        """True when respawn number `restart` should begin by emptying
+        the member's local snapshot dir (re-placed-host simulation)."""
+        e = self._take("stale_local_dir", restart)
+        if e is None:
+            return False
+        self._mark_fired(e)
+        _log.warning("FAULT INJECTION: %s -> emptying local snapshot "
+                     "dir", e.key)
         return True
 
     def maybe_corrupt_snapshot(self, path: str) -> bool:

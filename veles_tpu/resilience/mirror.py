@@ -1,0 +1,578 @@
+"""Snapshot durability backend: mirror snapshots to a second store.
+
+PR-1 left snapshot durability to the filesystem (ROADMAP "Still
+manual"); this module closes it. After every atomic local write the
+Snapshotter pushes the snapshot AND its sha256 sidecar to a configurable
+mirror — a second directory (NFS/attached volume) or an HTTP blob store
+(`upload_url`-style PUT endpoint) — verifies the uploaded bytes against
+the sidecar digest, and skips the upload entirely when the mirror
+already holds a verified copy (idempotent: re-running a job over the
+same snapshot stream never grows the mirror). On the restore side,
+`Snapshotter.latest(mirror=...)` and the cluster member's snapshot
+resolution fetch from the mirror when the local directory is missing,
+truncated or corrupt — a re-placed host rejoins from durable state
+instead of failing the attempt.
+
+TRUST MODEL: mirrored snapshots are the SAME pickles the local
+directory holds — code on unpickle — so a mirror must live inside the
+same trust boundary as the local snapshot dir (your volume, your
+loopback/token-authenticated store). `MirrorServer` below enforces the
+usual loopback-testable hardening (shared token, bounded bodies,
+sanitized names) but it does not make foreign pickles safe; never point
+a restore at a mirror you do not own.
+
+Import-light on purpose (stdlib only): the supervisor/cluster member
+processes use this and must never initialize jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+_log = logging.getLogger("veles.Mirror")
+
+#: mirrored snapshot bodies above this are refused by MirrorServer
+#: (a snapshot is a compressed workflow pickle: even flagship runs sit
+#: far below this; anything bigger is a bug or an attack)
+MAX_SNAPSHOT_BODY = 1 << 30
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
+
+
+def _read_sidecar(path: str) -> Optional[str]:
+    """Digest recorded in `path`'s .sha256 sidecar (None when absent or
+    unreadable)."""
+    try:
+        with open(path + ".sha256") as f:
+            return f.read().split()[0]
+    except (OSError, IndexError):
+        return None
+
+
+def _safe_name(name: str) -> str:
+    """Mirror entries are FLAT: reject anything that is not a plain
+    basename (path traversal through a snapshot name must be impossible
+    on both client and server side)."""
+    base = os.path.basename(name)
+    if not base or base != name or base.startswith(".") or "/" in name \
+            or "\\" in name:
+        raise ValueError(f"illegal mirror entry name {name!r}")
+    return base
+
+
+class Mirror:
+    """One mirrored snapshot store. Entries are (name, digest, mtime)
+    triples; `push` is idempotent on (name, digest)."""
+
+    #: for logs/reports
+    spec = ""
+
+    def entries(self) -> List[Dict[str, object]]:
+        """[{"name", "digest", "mtime"}] for every mirrored snapshot
+        (digest from the mirrored sidecar; empty on an unreachable
+        mirror — visibility is best-effort, restores re-verify)."""
+        raise NotImplementedError
+
+    def has(self, name: str, digest: str) -> bool:
+        raise NotImplementedError
+
+    def push(self, path: str) -> bool:
+        """Mirror `path` + its sidecar; verify the mirrored bytes
+        against the sidecar digest. Returns True when the mirror holds a
+        verified copy afterwards (including the no-op case where it
+        already did)."""
+        raise NotImplementedError
+
+    def fetch(self, name: str, dest_dir: str) -> Optional[str]:
+        """Restore one snapshot (+ sidecar) into `dest_dir`, verifying
+        the digest; returns the local path or None (missing/corrupt)."""
+        raise NotImplementedError
+
+    def delete(self, name: str) -> None:
+        """Best-effort removal (keep_last pruning follows the local
+        retention policy so the mirror cannot grow without bound)."""
+        raise NotImplementedError
+
+    def _corrupt(self, name: str) -> None:
+        """Deterministic bit-rot injection hook (mirror_corrupt fault):
+        tear the MIRRORED copy while the local one stays intact."""
+        raise NotImplementedError
+
+    def _maybe_inject_corruption(self, name: str) -> None:
+        from veles_tpu.resilience.faults import active_plan
+        plan = active_plan()
+        if plan is not None and plan.mirror_corrupt_at_push():
+            self._corrupt(name)
+            _log.warning("FAULT INJECTION: tore mirrored copy of %s",
+                         name)
+
+
+class DirMirror(Mirror):
+    """Second-directory mirror (attached volume, NFS mount)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.spec = root
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, _safe_name(name))
+
+    def entries(self) -> List[Dict[str, object]]:
+        try:
+            names = [n for n in os.listdir(self.root)
+                     if ".pickle" in n and not n.endswith(".sha256")
+                     and not n.endswith(".tmp")]
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            digest = _read_sidecar(self._path(n))
+            if digest is None:
+                continue     # sidecar-less mirror entry: not trustable
+            try:
+                mtime = os.path.getmtime(self._path(n))
+            except OSError:
+                continue
+            out.append({"name": n, "digest": digest, "mtime": mtime})
+        return out
+
+    def has(self, name: str, digest: str) -> bool:
+        return _read_sidecar(self._path(name)) == digest
+
+    def push(self, path: str) -> bool:
+        name = os.path.basename(path)
+        digest = _read_sidecar(path) or _sha256_file(path)
+        os.makedirs(self.root, exist_ok=True)
+        if self.has(name, digest):
+            _log.debug("mirror already holds %s (digest match): no-op",
+                       name)
+            return True
+        dst = self._path(name)
+        tmp = dst + ".tmp"
+        shutil.copyfile(path, tmp)
+        if _sha256_file(tmp) != digest:      # torn read of a live file
+            os.remove(tmp)
+            _log.warning("mirror push of %s read back a different "
+                         "digest: not published", name)
+            return False
+        os.replace(tmp, dst)
+        with open(dst + ".sha256.tmp", "w") as f:
+            f.write(f"{digest}  {name}\n")
+        os.replace(dst + ".sha256.tmp", dst + ".sha256")
+        self._maybe_inject_corruption(name)
+        return True
+
+    def fetch(self, name: str, dest_dir: str) -> Optional[str]:
+        src = self._path(name)
+        digest = _read_sidecar(src)
+        if digest is None or not os.path.exists(src):
+            return None
+        if _sha256_file(src) != digest:
+            _log.warning("mirror copy of %s is corrupt (digest "
+                         "mismatch) — not restoring it", name)
+            return None
+        os.makedirs(dest_dir, exist_ok=True)
+        dst = os.path.join(dest_dir, name)
+        tmp = dst + ".tmp"
+        shutil.copyfile(src, tmp)
+        if _sha256_file(tmp) != digest:
+            os.remove(tmp)
+            return None
+        os.replace(tmp, dst)
+        with open(dst + ".sha256.tmp", "w") as f:
+            f.write(f"{digest}  {name}\n")
+        os.replace(dst + ".sha256.tmp", dst + ".sha256")
+        return dst
+
+    def delete(self, name: str) -> None:
+        for victim in (self._path(name), self._path(name) + ".sha256"):
+            try:
+                os.remove(victim)
+            except OSError:
+                pass
+
+    def _corrupt(self, name: str) -> None:
+        from veles_tpu.resilience.faults import corrupt_file
+        corrupt_file(self._path(name))
+
+
+class HttpMirror(Mirror):
+    """HTTP blob-store mirror: PUT `{base}/{name}` (the PR-1
+    `upload_url` contract) plus the sidecar, GET to verify/restore,
+    `GET {base}/?index=1` for the entry listing (MirrorServer speaks
+    all of these; a dumb PUT-only store still receives verified-size
+    uploads, it just cannot serve restores)."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 timeout: float = 60.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token = token if token is not None \
+            else os.environ.get("VELES_WEB_TOKEN") or None
+        self.timeout = timeout
+        self.spec = self.base_url
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _request(self, method: str, name_or_query: str,
+                 data: Optional[bytes] = None):
+        req = urllib.request.Request(
+            f"{self.base_url}/{name_or_query}", data=data, method=method)
+        if self.token:
+            req.add_header("X-Veles-Token", self.token)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _get_bytes(self, name_or_query: str) -> Optional[bytes]:
+        try:
+            with self._request("GET", name_or_query) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def _get_to_file(self, name: str, dst: str) -> Optional[str]:
+        """Stream a GET into `dst`, returning the sha256 hex digest."""
+        h = hashlib.sha256()
+        try:
+            with self._request("GET", name) as resp, open(dst, "wb") as f:
+                while True:
+                    block = resp.read(1 << 20)
+                    if not block:
+                        break
+                    h.update(block)
+                    f.write(block)
+        except (urllib.error.URLError, OSError, ValueError):
+            try:
+                os.remove(dst)
+            except OSError:
+                pass
+            return None
+        return h.hexdigest()
+
+    # -- Mirror API -----------------------------------------------------------
+
+    def entries(self) -> List[Dict[str, object]]:
+        raw = self._get_bytes("?index=1")
+        if raw is None:
+            return []
+        try:
+            items = json.loads(raw)
+            return [{"name": _safe_name(str(i["name"])),
+                     "digest": str(i["digest"]),
+                     "mtime": float(i.get("mtime", 0.0))}
+                    for i in items]
+        except (ValueError, KeyError, TypeError):
+            return []
+
+    def has(self, name: str, digest: str) -> bool:
+        raw = self._get_bytes(_safe_name(name) + ".sha256")
+        if raw is None:
+            return False
+        try:
+            return raw.decode().split()[0] == digest
+        except (UnicodeDecodeError, IndexError):
+            return False
+
+    def push(self, path: str) -> bool:
+        from veles_tpu.http_util import http_put_file
+        name = _safe_name(os.path.basename(path))
+        digest = _read_sidecar(path) or _sha256_file(path)
+        if self.has(name, digest):
+            _log.debug("mirror already holds %s (digest match): no-op",
+                       name)
+            return True
+        headers = {"X-Veles-Token": self.token} if self.token else None
+        http_put_file(f"{self.base_url}/{name}", path,
+                      timeout=self.timeout, headers=headers)
+        # verify-on-upload BEFORE publishing the sidecar: the sidecar
+        # is what `has()`/`entries()` trust, so it must only ever sit
+        # next to bytes that verified — publishing it first would turn
+        # a corrupted-in-transit upload into a permanently "already
+        # mirrored" poisoned entry. A PUT-only store (no GET) is
+        # tolerated with a warning — that upload happened, it just
+        # cannot be independently verified (nor serve restores).
+        tmp = path + ".mirror_verify.tmp"
+        got = self._get_to_file(name, tmp)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        if got is not None and got != digest:
+            _log.warning("mirror copy of %s failed verify-on-upload "
+                         "(digest mismatch): unpublishing it", name)
+            self.delete(name)
+            return False
+        sidecar = path + ".sha256"
+        if os.path.exists(sidecar):
+            http_put_file(f"{self.base_url}/{name}.sha256", sidecar,
+                          timeout=self.timeout, headers=headers)
+        else:
+            with self._request(
+                    "PUT", name + ".sha256",
+                    data=f"{digest}  {name}\n".encode()) as resp:
+                resp.read()
+        if got is None:
+            _log.warning("mirror %s does not serve GET: upload of %s "
+                         "is unverified", self.base_url, name)
+        self._maybe_inject_corruption(name)
+        return True
+
+    def fetch(self, name: str, dest_dir: str) -> Optional[str]:
+        name = _safe_name(name)
+        raw = self._get_bytes(name + ".sha256")
+        if raw is None:
+            return None
+        try:
+            digest = raw.decode().split()[0]
+        except (UnicodeDecodeError, IndexError):
+            return None
+        os.makedirs(dest_dir, exist_ok=True)
+        dst = os.path.join(dest_dir, name)
+        tmp = dst + ".tmp"
+        got = self._get_to_file(name, tmp)
+        if got != digest:
+            _log.warning("mirror copy of %s is corrupt (digest "
+                         "mismatch) — not restoring it", name)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        os.replace(tmp, dst)
+        with open(dst + ".sha256.tmp", "w") as f:
+            f.write(f"{digest}  {name}\n")
+        os.replace(dst + ".sha256.tmp", dst + ".sha256")
+        return dst
+
+    def delete(self, name: str) -> None:
+        for victim in (_safe_name(name), _safe_name(name) + ".sha256"):
+            try:
+                with self._request("DELETE", victim) as resp:
+                    resp.read()
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+
+    def _corrupt(self, name: str) -> None:
+        """Re-PUT a torn copy over the mirrored file (the server keeps
+        whatever bytes the last PUT sent — exactly how real bit rot
+        looks to a digest check). Local file and sidecar stay intact."""
+        import tempfile
+
+        from veles_tpu.http_util import http_put_file
+        from veles_tpu.resilience.faults import corrupt_file
+        fd, tmp = tempfile.mkstemp(prefix="mirror_corrupt_")
+        os.close(fd)
+        try:
+            if self._get_to_file(name, tmp) is None:
+                return
+            corrupt_file(tmp)
+            headers = {"X-Veles-Token": self.token} if self.token \
+                else None
+            http_put_file(f"{self.base_url}/{name}", tmp,
+                          timeout=self.timeout, headers=headers)
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def get_mirror(spec: str, token: Optional[str] = None) -> Mirror:
+    """`http(s)://...` -> HttpMirror; anything else -> DirMirror."""
+    if spec.startswith(("http://", "https://")):
+        return HttpMirror(spec, token=token)
+    return DirMirror(spec)
+
+
+def restore_missing(mirror: "Mirror | str", directory: str,
+                    prefix: str = "") -> List[str]:
+    """Fetch every verified mirror entry matching `prefix` that the
+    local `directory` is missing (or holds corrupt) — the re-placed
+    host's rejoin path. Returns the restored local paths, newest
+    first."""
+    if isinstance(mirror, str):
+        mirror = get_mirror(mirror)
+    restored: List[str] = []
+    entries = sorted(mirror.entries(),
+                     key=lambda e: float(e["mtime"]), reverse=True)
+    for e in entries:
+        name = str(e["name"])
+        if prefix and not name.startswith(prefix):
+            continue
+        local = os.path.join(directory, name)
+        if os.path.exists(local) \
+                and _read_sidecar(local) == e["digest"] \
+                and _sha256_file(local) == e["digest"]:
+            continue        # local copy already valid
+        got = mirror.fetch(name, directory)
+        if got is not None:
+            # preserve the mirror's ordering hint: latest() sorts by
+            # mtime, and a fetched batch would otherwise all carry "now"
+            try:
+                os.utime(got, (float(e["mtime"]), float(e["mtime"])))
+            except OSError:
+                pass
+            _log.warning("restored %s from mirror %s", name,
+                         mirror.spec)
+            restored.append(got)
+    return restored
+
+
+# -- loopback-testable HTTP mirror store --------------------------------------
+
+class MirrorServer:
+    """Tiny blob store speaking the HttpMirror protocol: PUT/GET/DELETE
+    `/{name}` plus `GET /?index=1`. Hardened like the other control
+    planes (task_queue/web_status): optional shared token via
+    `X-Veles-Token` (constant-time compare), bounded bodies (413),
+    sanitized flat names (400). Runs on a thread; `port=0` auto-picks —
+    the loopback chaos/CI store, and a real single-box durable store
+    when pointed at a separate volume."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1",
+                 port: int = 0, token: Optional[str] = None,
+                 max_body: int = MAX_SNAPSHOT_BODY) -> None:
+        self.root = root
+        self.host = host
+        self.port = port
+        self.token = token
+        self.max_body = max_body
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MirrorServer":
+        import threading
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        from veles_tpu.http_util import check_shared_token
+        os.makedirs(self.root, exist_ok=True)
+        outer = self
+        token = self.token
+
+        class Handler(BaseHTTPRequestHandler):
+            def _name(self):
+                name = self.path.lstrip("/").split("?")[0]
+                try:
+                    return _safe_name(name) if name else None
+                except ValueError:
+                    return None
+
+            def _deny(self, code: int) -> None:
+                self.send_response(code)
+                self.end_headers()
+
+            def do_PUT(self):  # noqa: N802 (http.server API)
+                if not check_shared_token(self, token):
+                    return
+                name = self._name()
+                if name is None:
+                    return self._deny(400)
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    return self._deny(400)
+                if length > outer.max_body:
+                    return self._deny(413)
+                dst = os.path.join(outer.root, name)
+                tmp = dst + ".tmp"
+                remaining = length
+                with open(tmp, "wb") as f:
+                    while remaining > 0:
+                        block = self.rfile.read(min(1 << 20, remaining))
+                        if not block:
+                            break
+                        f.write(block)
+                        remaining -= len(block)
+                if remaining:
+                    os.remove(tmp)      # short body: do not publish
+                    return self._deny(400)
+                os.replace(tmp, dst)
+                self._deny(200)
+
+            def do_GET(self):  # noqa: N802
+                if not check_shared_token(self, token):
+                    return
+                if "index=1" in self.path:
+                    out = []
+                    for n in sorted(os.listdir(outer.root)):
+                        if n.endswith((".sha256", ".tmp")):
+                            continue
+                        digest = _read_sidecar(
+                            os.path.join(outer.root, n))
+                        if digest is None:
+                            continue
+                        out.append({
+                            "name": n, "digest": digest,
+                            "mtime": os.path.getmtime(
+                                os.path.join(outer.root, n))})
+                    body = json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                name = self._name()
+                if name is None:
+                    return self._deny(400)
+                src = os.path.join(outer.root, name)
+                if not os.path.isfile(src):
+                    return self._deny(404)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length",
+                                 str(os.path.getsize(src)))
+                self.end_headers()
+                with open(src, "rb") as f:
+                    shutil.copyfileobj(f, self.wfile)
+
+            def do_DELETE(self):  # noqa: N802
+                if not check_shared_token(self, token):
+                    return
+                name = self._name()
+                if name is None:
+                    return self._deny(400)
+                try:
+                    os.remove(os.path.join(outer.root, name))
+                except OSError:
+                    return self._deny(404)
+                self._deny(200)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mirror-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -103,6 +103,28 @@ def _with_snapshot(argv: Sequence[str], snapshot: str) -> List[str]:
         + ["-s", snapshot]
 
 
+def kill_procs(procs: Sequence[subprocess.Popen],
+               term_grace: float = 5.0) -> None:
+    """TERM, short grace, then KILL — every child, idempotent. Shared by
+    the per-host Supervisor and the cluster member's gang-kill."""
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.time() + term_grace
+    for p in live:
+        try:
+            p.wait(timeout=max(0.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+            p.wait()
+
+
 class Supervisor(Logger):
     """Spawn, watch and restart a training job until it finishes or the
     retry budget / progress cutoff says stop."""
@@ -114,7 +136,7 @@ class Supervisor(Logger):
                  jitter: float = 0.25, no_progress_limit: int = 2,
                  poll_interval: float = 0.2, term_grace: float = 5.0,
                  env: Optional[Dict[str, str]] = None,
-                 report_path: str = "") -> None:
+                 report_path: str = "", mirror: str = "") -> None:
         super().__init__()
         if commands and isinstance(commands[0], str):
             commands = [commands]        # a single argv, not a list of them
@@ -137,6 +159,10 @@ class Supervisor(Logger):
         self.env = dict(env) if env is not None else dict(os.environ)
         #: optional JSON exit report (attempt log, outcome, final codes)
         self.report_path = report_path
+        #: snapshot mirror spec (resilience/mirror.py): restart snapshot
+        #: resolution restores from it when the local dir cannot satisfy
+        #: the request (missing/corrupt) — durable-state rejoin
+        self.mirror = mirror
         self.attempts: List[Dict[str, Any]] = []
 
     # -- lifecycle -------------------------------------------------------------
@@ -230,7 +256,7 @@ class Supervisor(Logger):
             skip = 1 if EXIT_NONFINITE in codes else 0
             snapshot = Snapshotter.latest(self.snapshot_dir,
                                           prefix=self.snapshot_prefix,
-                                          skip=skip)
+                                          skip=skip, mirror=self.mirror)
             if snapshot is None:
                 self.warning("no valid snapshot in %s — restarting from "
                              "scratch", self.snapshot_dir)
@@ -289,23 +315,7 @@ class Supervisor(Logger):
             time.sleep(self.poll_interval)
 
     def _kill_all(self, procs: List[subprocess.Popen]) -> None:
-        """TERM, short grace, then KILL — every child, idempotent."""
-        live = [p for p in procs if p.poll() is None]
-        for p in live:
-            try:
-                p.terminate()
-            except OSError:
-                pass
-        deadline = time.time() + self.term_grace
-        for p in live:
-            try:
-                p.wait(timeout=max(0.0, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                try:
-                    p.send_signal(signal.SIGKILL)
-                except OSError:
-                    pass
-                p.wait()
+        kill_procs(procs, self.term_grace)
 
     def _finish(self, code: int, outcome: str) -> int:
         """Log the actionable exit report (and mirror it to JSON when
@@ -319,7 +329,8 @@ class Supervisor(Logger):
                 f"snapshot {a['snapshot'] or '<fresh>'}")
         if code != 0:
             latest = Snapshotter.latest(self.snapshot_dir,
-                                        prefix=self.snapshot_prefix)
+                                        prefix=self.snapshot_prefix,
+                                        mirror=self.mirror)
             lines.append(
                 f"  resume manually with: -s {latest}" if latest else
                 f"  no valid snapshot found in {self.snapshot_dir!r}")

@@ -92,17 +92,24 @@ class SnapshotterBase(Unit, IDistributable):
                  directory: str = ".", compression: str = "gz",
                  interval: int = 1, time_interval: float = 0.0,
                  keep_last: int = 0, upload_url: str = "",
-                 **kwargs: Any) -> None:
+                 mirror: str = "", **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.prefix = prefix
         self.directory = directory
         self.compression = compression
-        #: remote-destination slot (reference shipped snapshots to
-        #: ODBC/S3-style backends): every written file is ALSO HTTP PUT
-        #: to `{upload_url}/{filename}` — any blob store with a PUT
-        #: endpoint works. Best-effort: the local file (what resume
-        #: reads) is authoritative, a failed mirror only warns.
+        #: legacy remote-destination slot (reference shipped snapshots
+        #: to ODBC/S3-style backends): now an alias for `mirror` with an
+        #: http:// spec. Kept so old configs keep working.
         self.upload_url = upload_url
+        #: durability backend (resilience/mirror.py): after each atomic
+        #: local write the snapshot + sha256 sidecar are pushed to this
+        #: store — an `http(s)://` PUT endpoint or a second directory —
+        #: verified on upload, and skipped when the mirror already holds
+        #: a verified copy (idempotent). Best-effort: the local file
+        #: (what resume reads first) is authoritative, a failed mirror
+        #: push only warns — but `latest(mirror=...)` can RESTORE from
+        #: it when the local dir is lost.
+        self.mirror = mirror
         #: distributed workers run the SAME control flow (so sharded-
         #: param gathers in write_back stay symmetric across processes)
         #: but skip the actual file export — set by the Launcher
@@ -137,6 +144,14 @@ class SnapshotterBase(Unit, IDistributable):
 
     def initialize(self, **kwargs: Any):
         os.makedirs(self.directory, exist_ok=True)
+        if os.environ.get("VELES_SNAPSHOT_DRY_RUN"):
+            # single-writer election from OUTSIDE the object graph: a
+            # restored workflow carries the writer's Snapshotter state,
+            # so a non-writer host (cluster member resuming a mirrored
+            # snapshot, SPMD worker) pins dry_run via the environment —
+            # the unit keeps running (symmetric write_back collectives)
+            # but never exports a file
+            self.dry_run = True
         return super().initialize(**kwargs)
 
     def run(self) -> None:
@@ -161,12 +176,18 @@ class SnapshotterBase(Unit, IDistributable):
         plan = active_plan()
         if plan is not None:    # deterministic torn-write injection
             plan.maybe_corrupt_snapshot(self.destination)
-        if self.upload_url:
+        spec = self.mirror or self.upload_url
+        if spec:
             try:
-                self._upload(self.destination)
+                from veles_tpu.resilience.mirror import get_mirror
+                if get_mirror(spec).push(self.destination):
+                    self.info("snapshot mirrored -> %s", spec)
+                else:
+                    self.warning("snapshot mirror to %s did not "
+                                 "verify", spec)
             except Exception as e:  # noqa: BLE001 — mirror is best-effort
                 self.warning("snapshot mirror to %s failed: %s",
-                             self.upload_url, e)
+                             spec, e)
         self._written.append(self.destination)
         if self.keep_last:
             while len(self._written) > self.keep_last:
@@ -175,6 +196,16 @@ class SnapshotterBase(Unit, IDistributable):
                     try:
                         os.remove(victim)
                     except OSError:
+                        pass
+                if spec:
+                    # mirror follows the local retention policy, so the
+                    # durable copy set stays bounded too
+                    try:
+                        from veles_tpu.resilience.mirror import \
+                            get_mirror
+                        get_mirror(spec).delete(
+                            os.path.basename(stale))
+                    except Exception:  # noqa: BLE001 — best-effort
                         pass
 
     def export(self) -> str:
@@ -200,15 +231,19 @@ class SnapshotterBase(Unit, IDistributable):
                 "best_validation_err":
                     getattr(dec, "best_validation_err", None)}
 
-    def _upload(self, path: str) -> None:
-        from veles_tpu.http_util import http_put_file
-        url = self.upload_url.rstrip("/") + "/" + os.path.basename(path)
-        status = http_put_file(url, path, timeout=30)
-        self.info("snapshot mirrored -> %s (HTTP %s)", url, status)
-
     def __getstate__(self):
         d = super().__getstate__()
         d.pop("_decision", None)  # re-linked by the owner on restore
+        # runtime bookkeeping is process-local (absolute paths from the
+        # writing host, rate-limit clocks) and must not ride into the
+        # snapshot: dropping it ALSO makes exports byte-deterministic
+        # for unchanged model state, which is what lets the mirror
+        # recognize a re-written snapshot as already-held (idempotent
+        # re-upload instead of churn)
+        d["destination"] = ""
+        d["_written"] = []
+        d["_skipped"] = 0
+        d["_last_time"] = 0.0
         return d
 
 
@@ -222,6 +257,13 @@ class Snapshotter(SnapshotterBase):
     def export(self) -> str:
         from veles_tpu import prng
         opener, ext = _open_codec(self.compression)
+        if self.compression == "gz":
+            # deterministic gzip: pin the header mtime (gzip stamps
+            # "now" by default), so identical workflow state pickles to
+            # identical bytes — the property the mirror's digest-keyed
+            # idempotent push relies on
+            def opener(p, mode):  # noqa: F811 — deliberate shadow
+                return gzip.GzipFile(p, mode, mtime=0)
         path = os.path.join(self.directory,
                             f"{self.prefix}_{self.stamp()}.pickle{ext}")
         wf = self.workflow
@@ -293,13 +335,41 @@ class Snapshotter(SnapshotterBase):
 
     @staticmethod
     def latest(directory: str, prefix: str = "", verify: bool = True,
-               skip: int = 0) -> Optional[str]:
+               skip: int = 0, mirror: str = "") -> Optional[str]:
         """Newest VALID snapshot file in `directory` (restart-from-
         snapshot recovery, SURVEY.md §5.3: the SPMD fault model is
         resume, not mid-step elasticity). Corrupt/partial files — bad
         sha256, truncated stream — are skipped with a warning naming the
         fallback. `skip=N` returns the (N+1)-th newest valid snapshot
-        (the supervisor's roll-back-one on a non-finite abort)."""
+        (the supervisor's roll-back-one on a non-finite abort). With
+        `mirror` set (a resilience/mirror.py spec: second directory or
+        http store), a local dir that cannot satisfy the request —
+        missing, emptied, or all candidates corrupt — is re-populated
+        from verified mirror copies before giving up: the re-placed
+        host's rejoin path."""
+        result = Snapshotter._latest_local(directory, prefix, verify,
+                                           skip)
+        if result is None and mirror:
+            from veles_tpu.resilience.mirror import restore_missing
+            log = logging.getLogger("veles.Snapshotter")
+            try:
+                restored = restore_missing(mirror, directory, prefix)
+            except Exception as e:  # noqa: BLE001 — degrade, not die
+                log.warning("mirror restore from %s failed: %s",
+                            mirror, e)
+                restored = []
+            if restored:
+                log.warning("local snapshot dir %s could not satisfy "
+                            "the restore — re-populated %d file(s) "
+                            "from mirror %s", directory, len(restored),
+                            mirror)
+                result = Snapshotter._latest_local(directory, prefix,
+                                                   verify, skip)
+        return result
+
+    @staticmethod
+    def _latest_local(directory: str, prefix: str, verify: bool,
+                      skip: int) -> Optional[str]:
         log = logging.getLogger("veles.Snapshotter")
         try:
             # exclude in-flight ".tmp" files: a crash mid-export leaves a

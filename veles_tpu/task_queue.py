@@ -398,13 +398,22 @@ class FitnessQueueWorker(Logger):
     def __init__(self, host: str, port: int,
                  fitness_fn: Callable[[Dict[str, Any]], float],
                  token: Optional[str] = None, poll_s: float = 0.5,
-                 worker_id: str = "", give_up_s: float = 60.0) -> None:
+                 worker_id: str = "", give_up_s: float = 60.0,
+                 backoff_max: float = 10.0,
+                 backoff_jitter: float = 0.25) -> None:
         super().__init__()
         self.host = host
         self.port = port
         self.fitness_fn = fitness_fn
         self.token = token
         self.poll_s = poll_s
+        #: on connection-refused/timeout the poll interval backs off
+        #: exponentially (capped here, jittered below) instead of
+        #: hammering at poll_s: when a briefly-down coordinator comes
+        #: back, a big worker fleet must not thundering-herd it — the
+        #: jitter decorrelates the retry instants across workers
+        self.backoff_max = backoff_max
+        self.backoff_jitter = backoff_jitter
         import os
         import socket as _socket
         #: identity sent with every lease request, so the coordinator
@@ -454,9 +463,11 @@ class FitnessQueueWorker(Logger):
 
     def run(self, max_tasks: Optional[int] = None) -> int:
         """Returns the number of tasks completed by this worker."""
+        import random
         task_path = f"/task?worker={quote(self.worker_id)}"
         self.ended_by = ""                 # fresh verdict for THIS run
         last_contact = time.monotonic()
+        fail_streak = 0
         while max_tasks is None or self.tasks_done < max_tasks:
             try:
                 got = self._request("GET", task_path)
@@ -471,9 +482,18 @@ class FitnessQueueWorker(Logger):
                               self.give_up_s)
                     self.ended_by = "gave_up"
                     break
-                time.sleep(self.poll_s)
+                # jittered exponential backoff, reset on contact (the
+                # exponent is clamped BEFORE the multiply: an unbounded
+                # 2**streak overflows float around streak 1030, which a
+                # never-give-up worker would eventually reach)
+                delay = min(self.poll_s * (2 ** min(fail_streak, 30)),
+                            self.backoff_max)
+                delay *= 1.0 + self.backoff_jitter * random.random()
+                fail_streak += 1
+                time.sleep(delay)
                 continue
             last_contact = time.monotonic()
+            fail_streak = 0
             if got.get("done"):
                 self.ended_by = "done"
                 break

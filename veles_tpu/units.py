@@ -184,9 +184,13 @@ class Unit(Logger):
     def __getstate__(self):
         """Drop transient state: attributes prefixed `_fn` hold jitted
         callables (rebuilt by initialize()); `_initialized` is reset so a
-        restored workflow re-initializes (re-jits, re-acquires device)."""
+        restored workflow re-initializes (re-jits, re-acquires device).
+        `_logger` is recreated lazily (Logger mixin) — dropping it here
+        (this override shadows Logger.__getstate__'s pop) also keeps a
+        unit's pickled bytes identical whether or not it has logged yet,
+        which snapshot-mirror digest dedup relies on."""
         d = {k: v for k, v in self.__dict__.items()
-             if not k.startswith("_fn")}
+             if not k.startswith("_fn") and k != "_logger"}
         d["_initialized"] = False
         return d
 
