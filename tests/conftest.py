@@ -49,3 +49,26 @@ def _reseed():
     prng._generators.clear()
     yield
     prng._generators.clear()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_produce_threads():
+    """Loader prefetch pools (thread_name_prefix "<name>-produce") must
+    be released by stop() — the stop_units/DeviceFeed.stop teardown
+    contract. A test that leaves one running would silently serialize
+    every later test against a zombie pool (and a production run would
+    leak it past Ctrl-C). Idle pool workers park on the work queue, so
+    a short grace only covers threads mid-exit after shutdown()."""
+    import threading
+    import time as _time
+
+    def produce_threads():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and "-produce" in t.name]
+
+    yield
+    deadline = _time.time() + 2.0
+    while produce_threads() and _time.time() < deadline:
+        _time.sleep(0.05)
+    leaked = produce_threads()
+    assert not leaked, f"leaked loader prefetch threads: {leaked}"

@@ -537,6 +537,50 @@ def test_velint_loader_thread_clean_cases():
     assert lint.lint_source(leaky, path="veles_tpu/web_status.py") == []
 
 
+def test_velint_sync_feed_in_step_driver_loop():
+    """A loop that dispatches step.train/evaluate is a step-driver loop:
+    host-blocking transfers inside it (np.asarray, jax.device_get,
+    UNSHARDED jax.device_put) serialize H2D against compute — the
+    DeviceFeed exists for exactly this (ISSUE 5)."""
+    src = (
+        "import numpy as np\n"
+        "import jax\n"
+        "def drive(step, state, batches):\n"
+        "    for x, y in batches:\n"
+        "        state, m = step.train(state, x, y)\n"
+        "        host = np.asarray(m)\n"
+        "        xd = jax.device_put(x)\n"
+        "        g = jax.device_get(m)\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["sync-feed"] * 3
+    assert sorted(f.line for f in findings) == [6, 7, 8]
+    assert "DeviceFeed" in findings[0].message
+
+
+def test_velint_sync_feed_clean_cases():
+    # a loop with no step dispatch is NOT a driver loop
+    src = (
+        "import numpy as np\n"
+        "def gather(rows):\n"
+        "    out = []\n"
+        "    for r in rows:\n"
+        "        out.append(np.asarray(r))\n"
+        "    return out\n"
+    )
+    assert lint.lint_source(src) == []
+    # a SHARDED device_put (explicit placement arg) in a driver loop is
+    # the feed's own idiom — not flagged; evaluate also marks the loop
+    src2 = (
+        "import jax\n"
+        "def drive(step, state, batches, sh):\n"
+        "    while batches:\n"
+        "        x = jax.device_put(batches.pop(), sh)\n"
+        "        loss, n = step.evaluate(state, x)\n"
+    )
+    assert lint.lint_source(src2) == []
+
+
 def test_velint_suppression_same_line_and_line_above():
     src = (
         "import numpy as np\n"

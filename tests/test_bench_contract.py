@@ -40,6 +40,10 @@ def test_error_record_is_parseable_and_carries_measurements(tmp_path):
                BENCH_ATTEMPTS="1", BENCH_BACKOFF_S="1")
     rec = _run(env, timeout=120)
     assert rec["metric"] == "alexnet_train_samples_per_sec_per_chip"
+    # ISSUE 5 satellite: the failure path ENDS with the compact record
+    # and classifies itself — no probing null values (the BENCH_r05
+    # "parsed: null" regression class)
+    assert rec["status"] == "failed"
     assert rec["value"] is None and "error" in rec
     # the committed measured evidence moved to the FULL record file the
     # compact line points at — a dead tunnel still leaves numbers there
@@ -68,6 +72,7 @@ def test_success_record_names_variants_and_merges_e2e(tmp_path):
                BENCH_ATTEMPTS="1")
     rec = _run(env, timeout=580)
     assert rec["metric"] == "alexnet_train_samples_per_sec_per_chip"
+    assert rec["status"] == "ok"
     assert rec["value"] > 0, rec
     # the acceptance bar: the last stdout line NAMES the chosen variant
     # per tunable op the measured step contained
@@ -87,4 +92,9 @@ def test_success_record_names_variants_and_merges_e2e(tmp_path):
     assert e2e["value"] == rec["e2e_value"]
     assert e2e["loader_samples_per_sec"] > 0
     assert e2e["device_only_same_protocol"] > 0
+    # the e2e child trains through the SHARED DeviceFeed: its overlap
+    # counters land in the record — uint8 on the wire, batches fed ahead
+    feed = e2e["feed"]
+    assert feed["uint8_wire"] is True
+    assert feed["bytes_per_batch"] > 0 and feed["batches"] > 0
     assert full["fwd_layer_gflops_per_sample"]   # bulk stays in the file

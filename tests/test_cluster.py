@@ -301,6 +301,32 @@ def test_cluster_member_detects_stalled_child(tmp_path):
         pytest.fail(f"hung child {pid} survived stall detection")
 
 
+def test_member_gang_kill_dedupes_on_generation(tmp_path):
+    """Flap damping (ROADMAP PR-4 open item): a member whose stall
+    detection already tore its children down at generation G, then
+    rejoins mid-bump and receives the directive for G+1, must not issue
+    a SECOND kill round for the same incident — one kill per generation
+    transition, deduped on the generation counter."""
+    member = ClusterMember(
+        [["true"]], host_id="0", coordinator_addr="127.0.0.1:1",
+        snapshot_dir=str(tmp_path))
+    kills = []
+    member._kill_children = lambda: kills.append(member._killed_gen)
+    member.generation = 1
+    # stall detection fires first, anticipating the bump to gen 2
+    member._gang_kill(member.generation + 1)
+    assert kills == [2]
+    # the rejoin delivers the directive for that same bump: no 2nd kill
+    member._gang_kill(2)
+    assert kills == [2]
+    # a replayed/duplicate directive is equally inert
+    member._gang_kill(2)
+    assert kills == [2]
+    # the NEXT real bump kills again
+    member._gang_kill(3)
+    assert kills == [2, 3]
+
+
 def test_mirror_server_rejects_traversal_names(tmp_path):
     srv = MirrorServer(str(tmp_path / "blob")).start()
     try:

@@ -105,3 +105,78 @@ def test_fused_conv_trains_on_image_tree(image_tree):
         (wf.decision.best_validation_err, wf.decision.history)
     # per-epoch history recorded in fused mode too
     assert len(wf.decision.history) >= 1
+
+
+def test_uint8_emit_and_wire_format(image_tree):
+    """emit="uint8": raw re-quantized bytes leave the host (the mean
+    moves into the wire_format normalize spec for the step's on-device
+    prologue) and run_fused negotiates the uint8 wire end-to-end."""
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.seed_all(5)
+    loader = ImageDirectoryLoader(
+        data_path=image_tree, size_hw=(12, 12), n_validation=6,
+        minibatch_size=6, shuffle_train=False, emit="uint8")
+    loader.initialize(device=None)
+    loader.run()
+    x = loader.minibatch_data.mem
+    assert x.dtype == np.uint8              # raw bytes, 4x less H2D
+    spec = loader.wire_format()
+    assert spec["emit"] == "uint8"
+    assert spec["normalize"]["mean"] is not None  # device-side mean
+    # the u8 rows decode back to the float path within quantization
+    f32 = (x.astype(np.float32) / 127.5 - 1.0) - loader.mean_image
+    prng.seed_all(5)
+    ref = ImageDirectoryLoader(
+        data_path=image_tree, size_hw=(12, 12), n_validation=6,
+        minibatch_size=6, shuffle_train=False)
+    ref.initialize(device=None)
+    ref.run()
+    np.testing.assert_allclose(f32, ref.minibatch_data.mem,
+                               atol=0.5 / 127.5)
+    loader.stop()
+    ref.stop()
+
+    # float32 loaders never offer the lossy wire automatically
+    assert ref.wire_format() is None
+
+    prng.seed_all(6)
+    loader2 = ImageDirectoryLoader(
+        data_path=image_tree, size_hw=(12, 12), n_validation=6,
+        minibatch_size=6, emit="uint8")
+    wf = StandardWorkflow(
+        layers=[{"type": "softmax", "output_sample_shape": 3,
+                 "weights_stddev": 0.05}],
+        loader=loader2, loss="softmax", n_classes=3,
+        decision_config={"max_epochs": 2, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.05},
+        name="ImgU8")
+    wf.run_fused()
+    assert wf.feed_stats["uint8_wire"] is True
+    assert wf.decision.epoch_number == 2
+
+
+def test_hflip_agrees_across_emit_modes(image_tree):
+    """hflip applies to the RAW pixels BEFORE normalization in BOTH emit
+    modes (the memmap convention — the mean image is never flipped): the
+    uint8 wire's device-normalized rows match the float path within
+    quantization for flipped and unflipped rows alike."""
+    def produce(emit):
+        prng.seed_all(23)
+        loader = ImageDirectoryLoader(
+            data_path=image_tree, size_hw=(8, 8), n_validation=6,
+            minibatch_size=6, shuffle_train=False, hflip=True,
+            emit=emit)
+        loader.initialize(device=None)
+        rows = []
+        for _ in range(3):
+            loader.run()
+            rows.append(loader.minibatch_data.mem.copy())
+        mean = loader.mean_image
+        loader.stop()
+        return rows, mean
+
+    u8_rows, mean = produce("uint8")
+    f32_rows, _ = produce("float32")
+    for u8, f32 in zip(u8_rows, f32_rows):
+        dev = (u8.astype(np.float32) / 127.5 - 1.0) - mean
+        np.testing.assert_allclose(dev, f32, atol=0.51 / 127.5)

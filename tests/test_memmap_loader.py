@@ -132,7 +132,10 @@ def test_uint8_emit_with_input_normalize_trains(tmp_path):
     wf_u8 = build("uint8")
     wf_u8.run_fused()
     wf_f32 = build("float32")
-    wf_f32.run_fused()
+    # pin the host-normalized float wire: this arm IS the golden
+    # reference — letting run_fused auto-negotiate uint8 (ISSUE 5)
+    # would compare the device path against itself
+    wf_f32.run_fused(uint8_wire=False)
     # identical trajectories: on-device normalize == host normalize
     assert wf_u8.decision.best_validation_err == \
         wf_f32.decision.best_validation_err

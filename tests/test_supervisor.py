@@ -194,3 +194,17 @@ def test_supervisor_no_progress_cutoff(tmp_path):
     # far fewer attempts than the budget of 10: the cutoff fired
     assert len(report["attempts"]) <= 4
     assert all(a["reason"] == "died" for a in report["attempts"])
+
+
+def test_supervisor_report_carries_feed_counters(tmp_path):
+    """ISSUE 5 observability: a supervised FUSED child publishes its
+    device-feed overlap counters through the per-epoch heartbeat, and
+    the supervisor's JSON exit report promotes the newest attempt's
+    view to the top level (input-pipeline health without instrumenting
+    the child)."""
+    out, report = _run_supervised(tmp_path, extra=("--fused",))
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    feed = report["feed"]
+    assert feed["batches"] > 0 and feed["bytes_h2d"] > 0
+    assert "loader_block_s" in feed and "device_sync_s" in feed
+    assert report["attempts"][-1]["feed"]["batches"] > 0

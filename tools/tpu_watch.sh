@@ -17,7 +17,10 @@ x = jnp.ones((256, 256), jnp.bfloat16)
 print(jax.jit(lambda a: (a @ a).sum())(x))
 " > tpu_watch/probe.txt 2>&1; then
     log "tunnel UP: $(cat tpu_watch/probe.txt | tail -1)"
-    timeout 600 python bench.py \
+    # BENCH_AUTOTUNE=1: apply persisted autotune-cache winners (pure
+    # cache hits, zero timing; misses keep defaults) so on-chip runs
+    # measure the tuned configuration — ROADMAP PR-2 open item
+    BENCH_AUTOTUNE=1 timeout 600 python bench.py \
       > tpu_watch/bench_out.txt 2> tpu_watch/bench_err.txt
     tail -1 tpu_watch/bench_out.txt > tpu_watch/bench_last.json
     if python - <<'EOF'

@@ -25,7 +25,10 @@ x = jnp.ones((256, 256), jnp.bfloat16)
 print(jax.jit(lambda a: (a @ a).sum())(x))
 " > tpu_watch/r5_probe.txt 2>&1; then
     log "tunnel UP: $(tail -1 tpu_watch/r5_probe.txt)"
-    timeout 600 python bench.py \
+    # BENCH_AUTOTUNE=1: every measuring bench call applies the persisted
+    # autotune-cache winners (pure cache hits; explicit BENCH_LRN/
+    # BENCH_POOL env pins still win) — ROADMAP PR-2 open item
+    BENCH_AUTOTUNE=1 timeout 600 python bench.py \
       > tpu_watch/r5_bench_out.txt 2> tpu_watch/r5_bench_err.txt
     log "1 bench rc=$? last: $(tail -1 tpu_watch/r5_bench_out.txt | head -c 200)"
     timeout 900 python tools/ablate_lrn.py 1024 \
@@ -35,7 +38,7 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       > tpu_watch/r5_pool_ab.txt 2>&1
     log "3 ablate pool rc=$?"
     for B in 512 2048; do
-      BENCH_BATCH=$B BENCH_ATTACH_E2E=0 timeout 420 python bench.py \
+      BENCH_BATCH=$B BENCH_ATTACH_E2E=0 BENCH_AUTOTUNE=1 timeout 420 python bench.py \
         > tpu_watch/r5_bench_b$B.txt 2> tpu_watch/r5_bench_b$B.err
       log "4 bench batch=$B rc=$? last: $(tail -1 tpu_watch/r5_bench_b$B.txt | head -c 160)"
     done
@@ -95,7 +98,7 @@ PY
     # command name (a bare expanded VAR=x word would exec-fail rc=127);
     # empty BENCH_POOL is inert — bench.py only reacts to "slices"
     env BENCH_LRN="$BEST_LRN" BENCH_POOL="$BEST_POOL" \
-      BENCH_BATCH="$BEST_BATCH" BENCH_ATTACH_E2E=0 \
+      BENCH_BATCH="$BEST_BATCH" BENCH_ATTACH_E2E=0 BENCH_AUTOTUNE=1 \
       timeout 600 python bench.py \
       > tpu_watch/r5_bench_best.txt 2> tpu_watch/r5_bench_best.err
     log "8 best-config bench rc=$? last: $(tail -1 tpu_watch/r5_bench_best.txt | head -c 200)"

@@ -29,7 +29,9 @@ sys.path.insert(0, _REPO_ROOT)
 
 from veles_tpu.analysis import lint  # noqa: E402
 
-DEFAULT_PATHS = ("veles_tpu", "tools")
+#: bench.py rides along since the sync-feed rule exists exactly to keep
+#: step-driver loops (the bench protocol included) on the DeviceFeed
+DEFAULT_PATHS = ("veles_tpu", "tools", "bench.py")
 DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
                                 "velint_baseline.json")
 

@@ -125,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expert parallelism for distributed MoE runs: "
                         "expert tensors sharded over the data axis, "
                         "all_to_all token exchange; combine with -l/-m")
+    p.add_argument("--feed-ahead", type=int, default=None, metavar="N",
+                   help="device-feed lookahead depth for --fused/--pp "
+                        "runs (loader/device_feed.py): while step k "
+                        "computes, the next N batches' async sharded "
+                        "device_put is already in flight. Default 1 "
+                        "(the classic double buffer); 0 disables "
+                        "lookahead")
     p.add_argument("--accum", type=int, default=None, metavar="K",
                    help="gradient accumulation: compute each minibatch's "
                         "gradient as K scanned microbatches before the "
@@ -386,7 +393,7 @@ def main(argv=None) -> int:
         compile_cache=not args.no_compile_cache,
         nonfinite_guard=args.nonfinite_guard,
         verify_workflow=args.verify_workflow or "",
-        mirror=args.mirror)
+        mirror=args.mirror, feed_ahead=args.feed_ahead)
     if args.verify_workflow:
         # takes precedence over every execution mode (incl. --optimize,
         # which otherwise bypasses Launcher.main entirely): the flag

@@ -55,6 +55,9 @@ RULES: Dict[str, str] = {
     "loader-thread": "thread/executor created in loader code by a "
                      "class with no stop() (stop_units teardown "
                      "contract)",
+    "sync-feed": "host-blocking transfer (np.asarray/jax.device_get/"
+                 "unsharded device_put) inside a step-driver loop — "
+                 "feed batches through loader.device_feed.DeviceFeed",
 }
 
 #: call chains that create background threads (the loader-thread rule)
@@ -80,6 +83,12 @@ _TRACED_METHODS = ("fused_apply", "_apply", "_backward_model")
 _TRACING_CALLS = ("jit", "shard_map", "make_jaxpr", "grad",
                   "value_and_grad", "vjp", "checkpoint", "remat",
                   "eval_shape", "scan", "pmap", "vmap")
+
+#: attribute-call names that make a loop a STEP-DRIVER loop (the
+#: sync-feed rule): a for/while whose body dispatches train/eval steps
+#: is the hot path the DeviceFeed exists for — host-blocking transfers
+#: there serialize H2D against device compute
+_STEP_DRIVER_CALLS = ("train", "train_accum", "train_repeat", "evaluate")
 
 _SUPPRESS_RE = re.compile(r"#\s*velint:\s*disable=([\w\-,]+)")
 
@@ -124,6 +133,7 @@ class _Linter(ast.NodeVisitor):
         self._hot_depth = 0       # inside a run()/xla_run() method body
         self._traced_depth = 0    # inside a traced function body
         self._loop_depth = 0
+        self._driver_depth = 0    # inside a step-driver loop body
         #: local function names passed into tracing calls, plus the ids
         #: of lambda nodes passed directly (`self.jit(lambda ...)`, the
         #: codebase's dominant traced idiom) — one pre-pass collects
@@ -185,6 +195,19 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
         self._traced_depth -= traced
 
+    @staticmethod
+    def _is_driver_loop(node) -> bool:
+        """True when the loop body dispatches train/eval steps — an
+        attribute call like `step.train(...)` anywhere inside (the
+        sync-feed rule's scope)."""
+        for child in node.body:
+            for sub in ast.walk(child):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _STEP_DRIVER_CALLS:
+                    return True
+        return False
+
     def _visit_loop(self, node) -> None:
         # a For's iter evaluates ONCE — visit it outside the loop
         # context (other rules still see it); a While's test re-runs
@@ -193,12 +216,15 @@ class _Linter(ast.NodeVisitor):
         if it is not None:
             self.visit(it)
         self._loop_depth += 1
+        driver = self._is_driver_loop(node)
+        self._driver_depth += driver
         test = getattr(node, "test", None)
         if test is not None:
             self.visit(test)
         for child in node.body:
             self.visit(child)
         self._loop_depth -= 1
+        self._driver_depth -= driver
         for child in node.orelse:
             self.visit(child)
 
@@ -263,6 +289,25 @@ class _Linter(ast.NodeVisitor):
                            "np.asarray in a unit hot path forces a "
                            "device->host transfer: keep results "
                            "device-side (set_devmem) until a boundary")
+
+        if self._driver_depth:
+            if chain == "jax.device_get" \
+                    or chain.startswith(("np.asarray", "numpy.asarray")):
+                self._emit(node, "sync-feed",
+                           f"`{chain}(...)` inside a step-driver loop "
+                           "blocks the host on a device->host transfer "
+                           "between dispatches: feed batches through "
+                           "loader.device_feed.DeviceFeed (async "
+                           "sharded put, one batch ahead) and sync "
+                           "only at class-pass boundaries")
+            elif chain == "jax.device_put" and len(node.args) < 2 \
+                    and not node.keywords:
+                self._emit(node, "sync-feed",
+                           "unsharded jax.device_put of batch data "
+                           "inside a step-driver loop: a bespoke "
+                           "transfer path — use loader.device_feed."
+                           "DeviceFeed, which puts to the step's "
+                           "data-axis in_shardings one batch ahead")
 
         if self._traced_depth:
             if chain in ("time.time", "time.perf_counter",

@@ -44,6 +44,7 @@ class Launcher(Logger):
                  nonfinite_guard: bool = False,
                  verify_workflow: str = "",
                  mirror: str = "",
+                 feed_ahead: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -154,6 +155,21 @@ class Launcher(Logger):
         #: wired onto the workflow's Snapshotter before the run so
         #: every snapshot write pushes a verified durable copy
         self.mirror = mirror
+        #: device-feed lookahead depth for fused/pipelined runs
+        #: (loader/device_feed.py): None = the feed's default (1, the
+        #: classic double buffer); 0 disables lookahead. CLI --feed-ahead
+        if feed_ahead is not None and feed_ahead < 0:
+            raise SystemExit(f"--feed-ahead needs N >= 0 (got "
+                             f"{feed_ahead})")
+        if feed_ahead is not None and not (fused or pp
+                                           or listen or master):
+            # same precedent as --autotune: the granular unit graph
+            # never consumes the feed, and silently ignoring the knob
+            # would let an operator believe lookahead is active
+            raise SystemExit("--feed-ahead tunes the device feed of the "
+                             "fused/pipelined loops: combine with "
+                             "--fused, --pp or a distributed -l/-m run")
+        self.feed_ahead = feed_ahead
         #: opt-out for the persistent XLA compile cache (the cache is
         #: also auto-skipped on axon backends — see
         #: enable_compilation_cache)
@@ -366,8 +382,16 @@ class Launcher(Logger):
             epoch0 = getattr(getattr(self.workflow, "decision", None),
                              "epoch_number", 0)
             write_heartbeat(hb_path, epoch0)
-            installed_hooks.append(_rhooks.add_epoch_hook(
-                lambda epoch: write_heartbeat(hb_path, epoch)))
+            wf = self.workflow
+
+            def _hb(epoch: int) -> None:
+                # the device feed's overlap counters ride the heartbeat
+                # payload so the supervisor's JSON exit report can show
+                # the input-pipeline health of the supervised child
+                # (loader/device_feed.py; None for granular runs)
+                feed = getattr(wf, "feed_stats", None)
+                write_heartbeat(hb_path, epoch, feed=feed)
+            installed_hooks.append(_rhooks.add_epoch_hook(_hb))
         plan = _faults.active_plan()
         if plan is not None:
             self.warning("fault plan active: %s", plan)
@@ -481,7 +505,8 @@ class Launcher(Logger):
                         self.mode, self.n_processes, dict(smesh.shape))
                     self.workflow.run_pipelined(
                         mesh=smesh, n_microbatches=self.pp,
-                        device=self.device, **kwargs)
+                        device=self.device,
+                        feed_ahead=self.feed_ahead, **kwargs)
                 else:
                     from veles_tpu.parallel.mesh import make_mesh
                     mesh = make_mesh(jax.devices(), model=self.tp or 1,
@@ -496,7 +521,8 @@ class Launcher(Logger):
                         device=self.device, mesh=mesh,
                         mode="auto", ep=self.ep,
                         accum_steps=self.accum,
-                        nonfinite_guard=self.nonfinite_guard, **kwargs)
+                        nonfinite_guard=self.nonfinite_guard,
+                        feed_ahead=self.feed_ahead, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):
                     raise SystemExit(
@@ -504,7 +530,8 @@ class Launcher(Logger):
                         "pipeline step (StandardWorkflow-family only)")
                 self.workflow.run_pipelined(
                     n_microbatches=self.pp, device=self.device,
-                    nonfinite_guard=self.nonfinite_guard, **kwargs)
+                    nonfinite_guard=self.nonfinite_guard,
+                    feed_ahead=self.feed_ahead, **kwargs)
             elif self.fused:
                 if not hasattr(self.workflow, "run_fused"):
                     raise SystemExit(
@@ -512,7 +539,8 @@ class Launcher(Logger):
                         "fused step (StandardWorkflow-family only)")
                 self.workflow.run_fused(
                     device=self.device, accum_steps=self.accum,
-                    nonfinite_guard=self.nonfinite_guard, **kwargs)
+                    nonfinite_guard=self.nonfinite_guard,
+                    feed_ahead=self.feed_ahead, **kwargs)
             else:
                 if self.nonfinite_guard and hasattr(self.workflow,
                                                     "decision"):

@@ -1,4 +1,5 @@
 """Data loading layer (parity: reference `veles/loader/` — SURVEY.md §2.7)."""
 
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION, Loader  # noqa: F401
+from veles_tpu.loader.device_feed import DeviceFeed  # noqa: F401
 from veles_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401

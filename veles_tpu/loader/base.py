@@ -84,6 +84,16 @@ class Loader(AcceleratedUnit, IDistributable):
         with labels (FullBatchLoader) implement this."""
         return None
 
+    def wire_format(self) -> Optional[dict]:
+        """The uint8-over-the-wire offer for the device feed
+        (loader/device_feed.py): loaders that can emit raw uint8
+        minibatches return {"emit": "uint8", "normalize": {"scale",
+        "offset", "mean"}} describing the on-device affine that
+        reproduces their host float path; the fused/pipeline step then
+        normalizes on device and the H2D transfer shrinks 4x. None (the
+        default) keeps the host float wire."""
+        return None
+
     # -- lifecycle -----------------------------------------------------------
 
     def __setstate__(self, d):
@@ -92,6 +102,24 @@ class Loader(AcceleratedUnit, IDistributable):
         #: carried schedule/cursor/shuffle (explicit marker — a second
         #: initialize() of a LIVE loader must still re-derive them)
         self._restored = True
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        # device-feed counters (loader/device_feed.py) are process-local
+        # observability with timing floats: dropped so identical model
+        # state pickles to identical bytes (mirror digest dedup)
+        d.pop("feed_stats", None)
+        # a RUN-SCOPED negotiated wire format (uint8 wire; see
+        # _run_with_step) must not ride into snapshots: the restored
+        # graph carries no normalize prologue, so a granular resume
+        # would train on raw un-normalized bytes. Pickle the
+        # constructed emit instead — which also keeps identical model
+        # state byte-identical regardless of which wire the producing
+        # run negotiated.
+        pristine = d.pop("_emit_pristine", None)
+        if pristine is not None:
+            d["emit"] = pristine
+        return d
 
     def initialize(self, device=None, **kwargs: Any):
         self.load_data()
@@ -378,6 +406,19 @@ class PrefetchingLoader(Loader):
             for _, fut in self._pending.values():
                 fut.cancel()
             self._pending.clear()
+
+    def set_emit(self, emit: str) -> None:
+        """Flip the wire dtype mid-run (the device feed's uint8-wire
+        negotiation), dropping any lookahead produced under the old
+        format — a pending float32 future handed to a step built with a
+        uint8 prologue would be normalized twice. No-op for loaders
+        without an `emit` knob or when the format is unchanged."""
+        if getattr(self, "emit", None) in (None, emit):
+            return
+        self.emit = emit
+        for _, fut in self._pending.values():
+            fut.cancel()
+        self._pending.clear()
 
     def stop(self) -> None:
         if self._pool is not None:

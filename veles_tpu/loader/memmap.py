@@ -197,6 +197,17 @@ class MemmapImageLoader(PrefetchingLoader):
             return None
         return self._labels[self._train_base]
 
+    def wire_format(self):
+        """uint8-wire offer (loader/device_feed.py): the packed source
+        IS uint8, so shipping raw bytes and running `_normalize`'s
+        affine on device is lossless — always offered. The returned
+        normalize spec mirrors `_normalize` (scale, offset, then the
+        mean image); a step built with it can consume `emit="uint8"`
+        batches with no `input_normalize` layer in the graph."""
+        return {"emit": "uint8",
+                "normalize": {"scale": 1.0 / 127.5, "offset": -1.0,
+                              "mean": self.mean_image}}
+
     # -- gather ----------------------------------------------------------------
 
     def _use_native(self) -> bool:
@@ -290,5 +301,12 @@ def loader_throughput(loader, n_batches: int = 50) -> dict:
         loader.run()
         n += loader.minibatch_size
     dt = time.perf_counter() - t0
-    return {"samples_per_sec": n / dt, "batches": n_batches,
-            "minibatch_size": loader.minibatch_size}
+    out = {"samples_per_sec": n / dt, "batches": n_batches,
+           "minibatch_size": loader.minibatch_size}
+    # overlap observability: when a DeviceFeed wraps this loader, its
+    # counters (bytes/batch, uint8 wire, time blocked on loader vs
+    # device, lookahead health) ride along with the host rate
+    feed = getattr(loader, "feed_stats", None)
+    if feed:
+        out["feed"] = dict(feed)
+    return out

@@ -61,6 +61,23 @@ def _tree_cast(tree, dtype):
         else a, tree)
 
 
+def apply_input_normalize(spec, x):
+    """The uint8-wire prologue affine, shared by FusedTrainStep (traced
+    into the step) and PipelineTrainStep (eager device ops before
+    microbatching): float conversion + scale/offset + mean subtraction
+    in f32 — exactly the loaders' host `_normalize` math (loader
+    wire_format contract). One implementation so the fused and pipeline
+    paths can never diverge numerically. No-op when spec is None."""
+    if spec is None:
+        return x
+    x = x.astype(jnp.float32) * spec.get("scale", 1.0) \
+        + spec.get("offset", 0.0)
+    mean = spec.get("mean")
+    if mean is not None:
+        x = x - jnp.asarray(mean, jnp.float32)
+    return x
+
+
 #: the base GD units keep velocities as vel_w/vel_b for the params named
 #: weights/bias; every other GD twin names them vel_<param_name>
 #: (vel_wq, vel_wx, vel_wr, ...). _vel_attr resolves the attribute for a
@@ -116,8 +133,17 @@ class FusedTrainStep:
     def __init__(self, workflow, mesh=None, mode: str = "auto",
                  donate: bool = True,
                  compute_dtype: Optional[Any] = None,
-                 ep: bool = False) -> None:
+                 ep: bool = False,
+                 input_normalize: Optional[Dict[str, Any]] = None) -> None:
         self.mesh = mesh
+        #: on-device input prologue {"scale", "offset", "mean"} (the
+        #: uint8-wire contract, loader wire_format/device_feed): raw
+        #: integer batches are converted + affinely normalized as the
+        #: first traced op, where XLA fuses it into the first layer's
+        #: HBM read — the bench-e2e trick promoted into the step proper.
+        #: None = inputs arrive host-normalized (the float32 wire).
+        self.input_normalize = (dict(input_normalize)
+                                if input_normalize else None)
         self.forwards = list(workflow.forwards)
         self.loss_kind = workflow.loss
         self.n_classes = getattr(workflow, "n_classes", None)
@@ -351,6 +377,9 @@ class FusedTrainStep:
 
     def _forward(self, params, x, key, train: bool,
                  local_trace: bool = False):
+        # uint8-wire prologue: traced into the step, so it fuses into
+        # the first layer's HBM read
+        x = apply_input_normalize(self.input_normalize, x)
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
             params = _tree_cast(params, self.compute_dtype)
@@ -387,6 +416,15 @@ class FusedTrainStep:
         if self.compute_dtype is not None:
             x = x.astype(jnp.float32)
         return x
+
+    def input_put_specs(self):
+        """Leading-dim PartitionSpecs for the device feed's async
+        batch put ((x, y, w) order): the data-axis layout every sharded
+        mode consumes — seq mode's sequence-dim split happens inside
+        jit, a device-side reshard of already-resident arrays."""
+        if self.mode in ("dp", "gspmd", "seq"):
+            return (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+        return (P(), P(), P())
 
     def _constrain_tp_act(self, x, i):
         """GSPMD mode: pin a TP plan's sharded activations to

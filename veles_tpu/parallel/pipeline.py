@@ -180,10 +180,19 @@ class PipelineTrainStep:
     def __init__(self, workflow, mesh: Mesh, n_microbatches: int,
                  boundaries: Optional[Sequence[int]] = None,
                  compute_dtype: Optional[Any] = None,
-                 dispatch: str = "auto") -> None:
+                 dispatch: str = "auto",
+                 input_normalize: Optional[Dict[str, Any]] = None) -> None:
         from veles_tpu.parallel.fused import pair_gd_configs
         self.mesh = mesh
         self.n_micro = n_microbatches
+        #: on-device input prologue {"scale", "offset", "mean"} (the
+        #: uint8-wire contract, loader wire_format/device_feed): raw
+        #: integer batches are normalized on device in _microbatch,
+        #: BEFORE flattening/padding — the mean is image-shaped, and the
+        #: pipeline scan carries activations in one dtype, so the
+        #: conversion must land before microbatches enter the schedule.
+        self.input_normalize = (dict(input_normalize)
+                                if input_normalize else None)
         #: how a device picks its stage each tick:
         #: - "switch": lax.switch — only the selected stage's ops execute
         #:   (the pipelining point). VALIDATED ONLY ON TPU MESHES: on the
@@ -410,12 +419,24 @@ class PipelineTrainStep:
 
     # -- public API -----------------------------------------------------------
 
+    def input_put_specs(self):
+        """Device-feed put layout: the pipeline's shard_map consumes
+        replicated inputs (only stage 0 reads them), so the async put
+        replicates — still issued one step ahead of consumption."""
+        return (P(), P(), P())
+
     def _microbatch(self, x, y, w):
         m = self.n_micro
         n = x.shape[0]
         assert n % m == 0, (n, m)
         mb = n // m
-        flat = jnp.asarray(x).reshape(n, -1)
+        x = jnp.asarray(x)
+        if self.input_normalize is not None:
+            # uint8 wire: eager DEVICE ops (x is already resident when a
+            # DeviceFeed delivers it) — the transfer stays raw bytes
+            from veles_tpu.parallel.fused import apply_input_normalize
+            x = apply_input_normalize(self.input_normalize, x)
+        flat = x.reshape(n, -1)
         if self.compute_dtype is not None:
             # inter-stage activations (and the ppermute traffic) ride the
             # compute dtype; the loss head casts back to f32

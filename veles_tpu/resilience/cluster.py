@@ -429,6 +429,12 @@ class ClusterMember(Logger):
         self._beats_sent = 0
         self._suppress_beats = 0
         self._respawns = 0
+        #: highest generation a gang kill was already issued FOR (flap
+        #: damping): a member whose stall detection tore the children
+        #: down, then rejoins mid-generation-bump and receives the
+        #: directive for that same bump, must not log/issue a second
+        #: TERM round — one kill per generation transition
+        self._killed_gen = 0
         self._snap_cache: Dict[str, tuple] = {}
         #: mirror entries whose FETCH failed digest verification: their
         #: sidecar claim is a lie (bit rot in the store), so this host
@@ -582,6 +588,21 @@ class ClusterMember(Logger):
         from veles_tpu.resilience.supervisor import kill_procs
         kill_procs(self._procs, self.term_grace)  # TERM→grace→KILL
 
+    def _gang_kill(self, gen: int) -> None:
+        """Kill this host's children at most ONCE per generation
+        transition (dedupe on the generation counter — ROADMAP PR-4
+        flap damping). Both of an incident's kill sites route here: the
+        member-side stall detection (which fires at the CURRENT
+        generation, anticipating the coordinator's bump to gen+1) and
+        the directive handler (which learns the bump's actual target);
+        whichever fires first wins, the other becomes a no-op instead
+        of a second logged TERM round against already-dead children."""
+        if gen <= self._killed_gen:
+            return
+        self._killed_gen = gen
+        self.info("gang kill for generation %d", gen)
+        self._kill_children()
+
     def _children_status(self) -> tuple:
         """(status, exit_codes): "running" | "done" | "failed". With
         stall_timeout set, a running child whose heartbeat file went
@@ -611,7 +632,9 @@ class ClusterMember(Logger):
                         "heartbeat %s stale for %.1fs (> %.1fs) — "
                         "declaring this host's job hung", hb, stale,
                         self.stall_timeout)
-                    self._kill_children()
+                    # anticipates the coordinator's bump to gen+1: the
+                    # directive for that bump then skips its kill
+                    self._gang_kill(self.generation + 1)
                     return "failed", [
                         EXIT_STALLED if (c2 is not None and c2 < 0)
                         else c2 for c2 in
@@ -713,7 +736,9 @@ class ClusterMember(Logger):
                 gen = int(directive.get("generation", 1))
                 if gen > self.generation:
                     # gang restart on the coordinated generation counter
-                    self._kill_children()
+                    # (deduped: a stall kill or a replayed directive for
+                    # this same bump already tore the children down)
+                    self._gang_kill(gen)
                     backoff = float(directive.get("backoff") or 0.0)
                     if backoff:
                         time.sleep(backoff)

@@ -51,13 +51,22 @@ from veles_tpu.snapshotter import Snapshotter
 
 # -- heartbeat protocol (writer side lives in the Launcher) -------------------
 
-def write_heartbeat(path: str, epoch: int) -> None:
+def write_heartbeat(path: str, epoch: int,
+                    feed: Optional[Dict[str, Any]] = None) -> None:
     """Atomically publish liveness + the epoch counter. Atomic so a
     supervisor read never sees a torn file; the file's mtime is the
-    liveness signal, the payload is the progress signal."""
+    liveness signal, the payload is the progress signal. `feed` is the
+    child's device-feed overlap counter dict (loader/device_feed.py) —
+    the supervisor surfaces the last one in its JSON exit report."""
     tmp = f"{path}.{os.getpid()}.tmp"
+    payload: Dict[str, Any] = {"epoch": int(epoch), "ts": time.time()}
+    if feed:
+        # drop the bulky per-epoch rows: the heartbeat is read every
+        # poll interval and only the totals matter to the supervisor
+        payload["feed"] = {k: v for k, v in feed.items()
+                           if k != "epoch_log"}
     with open(tmp, "w") as f:
-        json.dump({"epoch": int(epoch), "ts": time.time()}, f)
+        json.dump(payload, f)
     os.replace(tmp, path)
 
 
@@ -66,8 +75,11 @@ def read_heartbeat(path: str) -> Dict[str, Any]:
     try:
         with open(path) as f:
             data = json.load(f)
-        return {"epoch": int(data.get("epoch", -1)),
-                "ts": float(data.get("ts", 0.0))}
+        out = {"epoch": int(data.get("epoch", -1)),
+               "ts": float(data.get("ts", 0.0))}
+        if isinstance(data.get("feed"), dict):
+            out["feed"] = data["feed"]
+        return out
     except (OSError, ValueError):
         return {"epoch": -1, "ts": 0.0}
 
@@ -217,12 +229,20 @@ class Supervisor(Logger):
                       f" (resume from {snapshot})" if snapshot else "")
             procs = self._procs = self._spawn(snapshot, hb_paths)
             reason, codes = self._monitor(procs, hb_paths)
-            epoch = max((read_heartbeat(p)["epoch"] for p in hb_paths),
-                        default=-1)
-            self.attempts.append({
+            hbs = [read_heartbeat(p) for p in hb_paths]
+            epoch = max((h["epoch"] for h in hbs), default=-1)
+            attempt = {
                 "attempt": attempt_no, "reason": reason,
                 "exit_codes": codes, "epoch_reached": epoch,
-                "snapshot": snapshot})
+                "snapshot": snapshot}
+            # input-pipeline overlap counters from the child's last
+            # heartbeat (loader/device_feed.py via the Launcher's epoch
+            # hook): the exit report shows whether the host pipeline
+            # kept the device fed, without instrumenting the child
+            feed = next((h["feed"] for h in hbs if h.get("feed")), None)
+            if feed is not None:
+                attempt["feed"] = feed
+            self.attempts.append(attempt)
             if reason == "ok":
                 return self._finish(0, "completed")
             self.warning("attempt %d failed: %s (exit codes %s, "
@@ -340,6 +360,12 @@ class Supervisor(Logger):
         if self.report_path:
             report_obj = {"outcome": outcome, "exit_code": code,
                           "attempts": self.attempts}
+            # the newest attempt's device-feed counters, promoted to the
+            # top level (the scheduler-facing input-pipeline health view)
+            for a in reversed(self.attempts):
+                if a.get("feed"):
+                    report_obj["feed"] = a["feed"]
+                    break
             try:
                 # which op lowerings the run was configured to trace.
                 # PROVENANCE: this is the supervisor process's view

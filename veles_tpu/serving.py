@@ -118,9 +118,19 @@ class InferenceServer(Logger):
             return out
 
         self._fn = jax.jit(fwd)
-        # warm the cache at the fixed serving batch
-        probe = jnp.zeros((self.max_batch,) + self._sample_shape,
-                          jnp.float32)
+        # warm the cache at the fixed serving batch, issuing the probe
+        # through the device feed's shared async put (the same transfer
+        # implementation _run_with_step and bench e2e train through —
+        # no bespoke warm path; None only on multi-host meshes, where
+        # the jit's uniform-host-input convention transfers instead)
+        from veles_tpu.loader.device_feed import make_batch_put
+        probe = np.zeros((self.max_batch,) + self._sample_shape,
+                         np.float32)
+        put = make_batch_put(step)
+        if put is not None:
+            (probe,) = put((probe,))
+        else:
+            probe = jnp.asarray(probe)
         self._fn(self._state["params"], probe).block_until_ready()
 
     # -- request handling -----------------------------------------------------

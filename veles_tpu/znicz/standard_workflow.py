@@ -254,6 +254,17 @@ class StandardWorkflow(Workflow):
 
     # -- conveniences --------------------------------------------------------
 
+    def __getstate__(self):
+        d = super().__getstate__()
+        # device-feed runtime (device arrays in flight, sharded-put
+        # closures) and its counters are process-local volatile state:
+        # dropping them keeps snapshots loadable AND byte-deterministic
+        # for unchanged model state — the property the mirror's
+        # digest-keyed idempotent push relies on (resilience/mirror.py)
+        d.pop("device_feed", None)
+        d.pop("feed_stats", None)
+        return d
+
     def initialize(self, device=None, **kwargs: Any) -> None:
         self._wire_gates()
         super().initialize(device=device, **kwargs)
@@ -269,14 +280,17 @@ class StandardWorkflow(Workflow):
     # -- fused/sharded execution (veles_tpu.parallel) -------------------------
 
     def build_fused_step(self, mesh=None, mode: str = "auto",
-                         compute_dtype=None, ep: bool = False):
+                         compute_dtype=None, ep: bool = False,
+                         input_normalize=None):
         """Compile the whole forward+backward+update chain into one donated
         XLA step, optionally sharded over `mesh` (data/model axes; ep=True
-        additionally shards MoE expert tensors over the data axis). See
-        parallel.fused.FusedTrainStep."""
+        additionally shards MoE expert tensors over the data axis).
+        `input_normalize` is the uint8-wire prologue spec (see
+        `_wire_spec`). See parallel.fused.FusedTrainStep."""
         from veles_tpu.parallel.fused import FusedTrainStep
         return FusedTrainStep(self, mesh=mesh, mode=mode,
-                              compute_dtype=compute_dtype, ep=ep)
+                              compute_dtype=compute_dtype, ep=ep,
+                              input_normalize=input_normalize)
 
     def autotune(self, mesh=None, compute_dtype=None, **kwargs: Any):
         """Pick the fastest registered lowering for every tunable op this
@@ -290,7 +304,8 @@ class StandardWorkflow(Workflow):
                                  compute_dtype=compute_dtype, **kwargs)
 
     def build_pipeline_step(self, mesh, n_microbatches: int = 4,
-                            boundaries=None, compute_dtype=None):
+                            boundaries=None, compute_dtype=None,
+                            input_normalize=None):
         """Compile the chain as an S-stage GPipe pipeline over `mesh`'s
         "stage" axis (see parallel.pipeline.PipelineTrainStep). The
         workflow must be initialized first (stage shapes come from the
@@ -298,17 +313,48 @@ class StandardWorkflow(Workflow):
         from veles_tpu.parallel.pipeline import PipelineTrainStep
         return PipelineTrainStep(self, mesh, n_microbatches,
                                  boundaries=boundaries,
-                                 compute_dtype=compute_dtype)
+                                 compute_dtype=compute_dtype,
+                                 input_normalize=input_normalize)
+
+    def _wire_spec(self, uint8_wire="auto"):
+        """uint8-over-the-wire negotiation with the loader (the device
+        feed, loader/device_feed.py): when the loader offers a raw-bytes
+        wire (`wire_format()`) and the graph does not already carry its
+        own `input_normalize` layer, return the prologue spec the step
+        builder should trace and the emit format the loader should
+        switch to — host conversion work and H2D bytes both drop 4x,
+        normalization fuses into the first layer's device read.
+        `uint8_wire=False` PINS the host-normalized float wire (golden
+        comparisons): a loader constructed with `emit="uint8"` is
+        switched to float emission for the run — leaving it raw with no
+        prologue would silently train on un-normalized 0..255 bytes."""
+        from veles_tpu.znicz.normalization import InputNormalize
+        if any(isinstance(u, InputNormalize) for u in self.forwards):
+            return None     # the graph normalizes on device already
+        if not uint8_wire:
+            if getattr(self.loader, "emit", None) == "uint8" \
+                    and hasattr(self.loader, "set_emit"):
+                return {"emit": "float32", "normalize": None}
+            return None
+        wf = getattr(self.loader, "wire_format", None)
+        return wf() if wf is not None else None
 
     def run_fused(self, epochs: Optional[int] = None, device=None,
                   mesh=None, mode: str = "auto", compute_dtype=None,
                   ep: bool = False,
                   accum_steps: Optional[int] = None,
-                  nonfinite_guard: bool = False) -> None:
+                  nonfinite_guard: bool = False,
+                  uint8_wire="auto",
+                  feed_ahead: Optional[int] = None) -> None:
         """Train with the fused step while keeping the graph semantics:
         the real Loader drives minibatches and the real Decision unit does
         the epoch/stop bookkeeping (so snapshot gating, best-error tracking
         and the `complete` Bool behave exactly as in granular mode).
+        Batches reach the device through the async DeviceFeed
+        (loader/device_feed.py): host prep AND the H2D transfer overlap
+        device compute, and loaders offering a uint8 wire ship raw bytes
+        with an on-device normalize prologue (`uint8_wire=False` opts
+        out; `feed_ahead` sets the lookahead depth, default 1).
 
         `accum_steps=K` computes each minibatch's gradient as K scanned
         microbatches before the single update (train_accum) — activation
@@ -324,19 +370,24 @@ class StandardWorkflow(Workflow):
             self.decision.max_epochs = epochs
         if not self.is_initialized:
             self.initialize(device=device)
-        step = self.build_fused_step(mesh=mesh, mode=mode,
-                                     compute_dtype=compute_dtype, ep=ep)
+        wire = self._wire_spec(uint8_wire)
+        step = self.build_fused_step(
+            mesh=mesh, mode=mode, compute_dtype=compute_dtype, ep=ep,
+            input_normalize=wire["normalize"] if wire else None)
         self._run_with_step(step, accum_steps=accum_steps,
-                            nonfinite_guard=nonfinite_guard)
+                            nonfinite_guard=nonfinite_guard,
+                            wire=wire, feed_ahead=feed_ahead)
 
     def run_pipelined(self, mesh=None, n_microbatches: int = 4,
                       epochs: Optional[int] = None, device=None,
                       boundaries=None, compute_dtype=None,
-                      nonfinite_guard: bool = False) -> None:
+                      nonfinite_guard: bool = False,
+                      uint8_wire="auto",
+                      feed_ahead: Optional[int] = None) -> None:
         """Train as a GPipe pipeline over `mesh`'s "stage" axis (default:
         one stage per device) with the same Loader/Decision/Snapshotter
-        semantics as run_fused. The CLI exposes this as `--pp M`
-        (M = microbatches)."""
+        semantics (and the same DeviceFeed input path) as run_fused. The
+        CLI exposes this as `--pp M` (M = microbatches)."""
         if epochs is not None:
             self.decision.max_epochs = epochs
         if not self.is_initialized:
@@ -348,16 +399,26 @@ class StandardWorkflow(Workflow):
             # one stage per device, capped at one UNIT per stage
             mesh = make_stage_mesh(
                 jax.devices()[:max(1, len(self.forwards))])
-        step = self.build_pipeline_step(mesh, n_microbatches,
-                                        boundaries=boundaries,
-                                        compute_dtype=compute_dtype)
-        self._run_with_step(step, nonfinite_guard=nonfinite_guard)
+        wire = self._wire_spec(uint8_wire)
+        step = self.build_pipeline_step(
+            mesh, n_microbatches, boundaries=boundaries,
+            compute_dtype=compute_dtype,
+            input_normalize=wire["normalize"] if wire else None)
+        self._run_with_step(step, nonfinite_guard=nonfinite_guard,
+                            wire=wire, feed_ahead=feed_ahead)
 
     def _run_with_step(self, step, accum_steps: Optional[int] = None,
-                       nonfinite_guard: bool = False) -> None:
+                       nonfinite_guard: bool = False,
+                       wire=None, feed_ahead: Optional[int] = None) -> None:
         """Drive any train/evaluate/write_back step object through the
         Loader + Decision bookkeeping (shared by run_fused /
-        run_pipelined)."""
+        run_pipelined). Batches arrive through the async DeviceFeed —
+        while step k executes, batch k+1's sharded device_put is already
+        in flight (feed.prefetch() at the loop bottom, AFTER the
+        snapshot window so pickled loader cursors stay exact-resume
+        correct) — and each FeedBatch's Decision metadata is replayed
+        onto the loader, so the epoch bookkeeping below is unchanged
+        from the synchronous loop it replaces."""
         if accum_steps and accum_steps > 1:
             import types
             base = step
@@ -367,19 +428,33 @@ class StandardWorkflow(Workflow):
                 evaluate=base.evaluate, init_state=base.init_state,
                 write_back=base.write_back,
                 # keep the full step surface: the confusion companion,
-                # local_rows and mesh drive features below this wrapper
+                # local_rows, sharding specs and mesh drive features
+                # below this wrapper
                 confusion=getattr(base, "confusion", None),
                 local_rows=getattr(base, "local_rows", None),
+                input_put_specs=getattr(base, "input_put_specs", None),
                 mesh=getattr(base, "mesh", None))
+        import time as _time
+
         from veles_tpu.config import root as _root
         from veles_tpu.loader.base import TRAIN
+        from veles_tpu.loader.device_feed import DeviceFeed
         from veles_tpu.resilience.faults import active_plan
         fault_plan = active_plan()   # None in production: zero per-step cost
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
-        # the fused step uploads (sharded) itself; the loader's granular-path
-        # device push would be a second, wasted H2D transfer per minibatch
+        # the feed uploads (sharded, async) itself; the loader's granular-
+        # path device push would be a second, wasted H2D per minibatch
         prev_on_device, loader.on_device = loader.on_device, False
+        # uint8 wire negotiated (run_fused/_wire_spec): raw bytes leave
+        # the host, the step's input_normalize prologue converts on
+        # device — restore the loader's emit format afterwards
+        prev_emit = getattr(loader, "emit", None)
+        if wire is not None and hasattr(loader, "set_emit"):
+            loader.set_emit(wire["emit"])
+            # mid-run snapshots pickle the CONSTRUCTED emit, not the
+            # run-scoped negotiated one (Loader.__getstate__)
+            loader._emit_pristine = prev_emit
         # multi-host input sharding: tell a prefetching loader which
         # global batch rows this process's shards own, so host decode
         # divides by the host count (non-local rows zero-fill; the jit
@@ -391,6 +466,20 @@ class StandardWorkflow(Workflow):
             from veles_tpu.parallel.mesh import is_multihost
             if is_multihost(mesh):
                 loader.local_rows_fn = step.local_rows
+        ahead = 1 if feed_ahead is None else feed_ahead
+        if self.snapshotter is not None and ahead > 1:
+            # a snapshot taken with k pending batches pickles a loader
+            # cursor k past the trained batch — the restore would skip
+            # them, forking the resumed trajectory. Exact resume beats
+            # deeper lookahead; loops that never pickle the loader
+            # (bench) may run deeper.
+            self.warning("feed_ahead=%d clamped to 1: snapshots require "
+                         "an exact-resume loader cursor "
+                         "(loader/device_feed.py)", ahead)
+            ahead = 1
+        feed = DeviceFeed.for_step(loader, step, ahead=ahead)
+        #: observability handle: heartbeats/reports read feed_stats
+        self.device_feed = feed
         try:
             # Metrics accumulate ON DEVICE across each class pass (lazy
             # scalar adds); the single host sync happens at last_minibatch,
@@ -399,11 +488,9 @@ class StandardWorkflow(Workflow):
             acc_loss = acc_err = acc_conf = None
             acc_w = 0.0
             while not bool(dec.complete):
-                loader.run()
-                x = loader.minibatch_data.mem
-                y = loader.minibatch_labels.mem
-                w = loader.minibatch_valid.mem  # pad mask: exact metrics
-                if loader.minibatch_class == TRAIN:
+                b = feed.next()
+                x, y, w = b.x, b.y, b.w
+                if b.minibatch_class == TRAIN:
                     state, (loss, n_err) = step.train(state, x, y, w)
                     if fault_plan is not None and fault_plan.nan_at_step():
                         loss = float("nan")   # deterministic divergence
@@ -415,7 +502,7 @@ class StandardWorkflow(Workflow):
                     # Accumulated as LAZY DEVICE adds like loss/err; the
                     # host sync stays at the class-pass boundary.
                     cs = getattr(ev, "confusion_split", None)
-                    if (cs is not None and loader.minibatch_class == cs
+                    if (cs is not None and b.minibatch_class == cs
                             and getattr(self, "plotters", None)
                             and getattr(ev, "compute_confusion", True)
                             and not _root.common.get("plotting_disabled",
@@ -430,16 +517,19 @@ class StandardWorkflow(Workflow):
                 # by the batch's valid-row weight so the class-pass total
                 # is the EXACT weighted mean (a wrapped final minibatch
                 # with few valid rows must not count as a full one)
-                bw = float(w.sum())
+                bw = float(b.w_host.sum())
                 wl = loss * bw
                 acc_loss = wl if acc_loss is None else acc_loss + wl
                 acc_w += bw
                 acc_err = n_err if acc_err is None else acc_err + n_err
-                if bool(loader.last_minibatch):
+                if b.last_minibatch:
                     # Decision's improvement/stop logic only reads totals
                     # at the class-pass boundary; feeding the accumulated
                     # value here (zeros in between) preserves its
-                    # semantics.
+                    # semantics. This float() is THE driver-side device
+                    # sync — timed so the feed's stats decompose blocked
+                    # time into loader vs device.
+                    t_sync = _time.perf_counter()
                     ev.loss = float(acc_loss) / max(acc_w, 1.0)
                     if nonfinite_guard and not np.isfinite(ev.loss):
                         # raised BEFORE dec.run()/the snapshot branch: a
@@ -450,21 +540,31 @@ class StandardWorkflow(Workflow):
                         raise NonFiniteLossError(
                             f"non-finite loss {ev.loss!r} at epoch "
                             f"{dec.epoch_number} (class "
-                            f"{int(loader.minibatch_class)} pass)")
+                            f"{int(b.minibatch_class)} pass)")
                     ev.n_err = (int(acc_err) if self.loss == "softmax"
                                 else float(acc_err))
                     if acc_conf is not None:
                         ev.confusion_matrix.map_write()
+                        # class-pass-boundary sync by design: confusion
+                        # accumulated as lazy device adds above, pulled
+                        # host-side ONCE per pass, not per batch
+                        # velint: disable=sync-feed
                         ev.confusion_matrix.mem += np.asarray(
                             acc_conf).astype(ev.confusion_matrix.mem.dtype)
+                    feed.note_device_sync(_time.perf_counter() - t_sync)
                     acc_loss = acc_err = acc_conf = None
                     acc_w = 0.0
                 else:
                     ev.loss = 0.0
                     ev.n_err = 0
+                if b.epoch_ended:
+                    # BEFORE dec.run(): the Decision's epoch hooks write
+                    # the heartbeat, which carries these counters to the
+                    # supervisor's exit report
+                    self.feed_stats = feed.stats()
                 dec.run()
                 if getattr(self, "plotters", None) \
-                        and bool(loader.epoch_ended) \
+                        and b.epoch_ended \
                         and not _root.common.get("plotting_disabled",
                                                  False):
                     # weight plots need the CURRENT fused params in the
@@ -481,8 +581,20 @@ class StandardWorkflow(Workflow):
                 if self.snapshotter is not None and bool(dec.improved):
                     step.write_back(state)
                     self.snapshotter.run()
+                # NOW produce batch k+1 and issue its async put: the
+                # step dispatched above is still executing on device,
+                # so the H2D transfer hides under it — and the snapshot
+                # (if any) already pickled the pristine loader cursor
+                if not bool(dec.complete):
+                    feed.prefetch()
         finally:
+            feed.stop()
+            self.feed_stats = feed.stats()
             loader.on_device = prev_on_device
+            if wire is not None and hasattr(loader, "set_emit") \
+                    and prev_emit is not None:
+                loader.set_emit(prev_emit)
+                loader._emit_pristine = None
             if hasattr(loader, "local_rows_fn"):
                 loader.local_rows_fn = prev_rows_fn
             step.write_back(state)
