@@ -75,6 +75,18 @@ PEAK_TFLOPS = {
 }
 
 
+def _mem_record():
+    """Per-device memory snapshot (parallel/memstats.py) embedded next
+    to the measured number: live-array bytes per device everywhere, the
+    allocator's peak where the backend reports one (TPU). Guarded like
+    _audit_record — accounting must never cost the measured value."""
+    try:
+        from veles_tpu.parallel.memstats import device_memory_stats
+        return device_memory_stats()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _audit_record(step, x_shape, y_shape=None, state=None) -> dict:
     """Jaxpr-audit summary (analysis/trace.py) embedded in the record
     next to `variants`: the measured number ships with the auditor's
@@ -302,6 +314,9 @@ def child_main() -> None:
         # the jaxpr auditor's verdict on the step that was measured
         # (analysis pass 2; docs/ANALYSIS.md)
         "analysis": _audit_record(step, in_shape, state=state),
+        # per-device memory under the measured config (memstats): the
+        # ZeRO optimizer-state delta is a recorded number, not a claim
+        "device_memory": _mem_record(),
         "train_gflops_per_sample": round(train_flops / 1e9, 3),
         "fwd_layer_gflops_per_sample": layer_gflops,
         "scaling_prediction_v5e64": scaling_rec,
@@ -430,6 +445,7 @@ def e2e_child_main() -> None:
         # f32/4), time blocked on loader vs device, lookahead health
         "feed": feed_stats,
         "variants": step.variant_table(),
+        "device_memory": _mem_record(),
         "device_kind": jax.devices()[0].device_kind,
         "batch_per_chip": batch,
         "n_samples_packed": n,

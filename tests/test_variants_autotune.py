@@ -139,7 +139,8 @@ def test_registry_validation():
     with pytest.raises(KeyError):
         variants.select("no_such_op", "x")
     table = variants.selection_table(include_defaults=True)
-    assert set(table) == {"lrn", "maxpool", "conv_stem", "dropout"}
+    assert set(table) == {"lrn", "maxpool", "conv_stem", "dropout",
+                          "grad_reduce"}
     # pallas variants resolve to the op's non-pallas fallback on CPU...
     variants.select("lrn", "pallas_one_pass")
     assert variants.resolve("lrn").name == "banded_matmul"

@@ -2,6 +2,7 @@
 
 Usage (on a machine with the TPU visible):
     python tools/ablate.py full no-LRN no-dropout no-bigFC
+    python tools/ablate.py --zero          # ZeRO update A/B (needs >=2 devices)
 
 Each variant builds the AlexNet fused train step with a layer family
 removed and reports samples/s via train_repeat — the deltas attribute
@@ -10,6 +11,14 @@ Lowering-choice variants (s2d-stem, slicepool) are thin wrappers over
 the ops.variants registry now — `tools/autotune.py` measures the same
 candidates systematically and persists the winner; this script remains
 for layer-family REMOVAL attribution, which the registry can't express.
+
+`--zero` is the weight-update-sharding A/B (ISSUE 6 / arxiv 2004.13336):
+the SAME dp-mode AlexNet step with the replicated update vs the
+ZeRO-sharded one, reporting samples/s, per-device optimizer-state bytes
+and the allocator peak — step-time and memory deltas land in a bench
+record (VELES_ZERO_AB_PATH, default ZERO_AB_RECORD.json next to the
+repo's other BENCH records) so the N× memory cut is a measured number.
+
 Do NOT enable the persistent compilation cache here (hangs on the axon
 backend — see the r3 session notes)."""
 
@@ -113,6 +122,130 @@ def variant(name: str):
     raise SystemExit(f"unknown variant {name}")
 
 
+def measure_zero_ab() -> dict:
+    """A/B the ZeRO-sharded vs replicated weight update on a dp mesh
+    over every local device: step time (train_repeat protocol, same as
+    the layer ablations), per-device optimizer-state bytes (measured
+    from the state pytree's shards), and the per-device memory snapshot
+    (parallel/memstats.py). Writes the record and prints one compact
+    ABLATE line per arm plus the deltas."""
+    import json
+
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.parallel.memstats import device_memory_stats
+    from veles_tpu.samples.alexnet import alexnet_layers
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit("--zero needs a >=2-device mesh (the A/B is "
+                         "data-parallel); this host exposes "
+                         f"{len(devs)} device(s)")
+    mesh = make_mesh(devs)
+    n_data = len(devs)
+    # CPU smoke knobs (the BENCH_E2E_WIDTH precedent): full-size AlexNet
+    # at batch 512 is the on-chip protocol; a virtual-device CPU mesh
+    # shrinks both to stay testable
+    batch = int(os.environ.get("ZERO_AB_BATCH", str(BATCH)))
+    width = float(os.environ.get("ZERO_AB_WIDTH", "1.0"))
+    if batch % n_data:
+        raise SystemExit(f"--zero: batch {batch} not divisible by the "
+                         f"{n_data}-device data axis")
+    record = {"metric": "zero_sharding_ab", "n_devices": n_data,
+              "device_kind": devs[0].device_kind, "batch": batch,
+              "width": width, "steps_per_window": K, "arms": {}}
+    for name, zs in (("replicated", "off"), ("zero", "on")):
+        prng.seed_all(1)
+        loader = SyntheticClassifierLoader(
+            n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
+            n_train=128, minibatch_size=batch, noise=0.5)
+        wf = StandardWorkflow(
+            layers=list(alexnet_layers(64, width,
+                                       int(4096 * width) or 64)),
+            loader=loader,
+            loss="softmax", n_classes=64,
+            decision_config={"max_epochs": 1, "fail_iterations": 9},
+            gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
+            name=f"ZeroAB-{name}")
+        wf.initialize(device=None)
+        step = wf.build_fused_step(mesh=mesh, mode="dp",
+                                   compute_dtype="bfloat16",
+                                   zero_sharding=zs)
+        state = step.init_state()
+        rng = np.random.RandomState(0)
+        # pre-stage the batch sharded over the data axis (the feed's
+        # layout): the timed windows below must measure the UPDATE
+        # decomposition, not a synchronous full-batch H2D each window
+        # (measure() stages the same way for the layer ablations)
+        xs, ys_, _ = step.input_put_specs()
+        x = jax.device_put(
+            rng.randn(batch, 227, 227, 3).astype(np.float32),
+            jax.sharding.NamedSharding(mesh, xs))
+        y = jax.device_put(rng.randint(0, 64, batch),
+                           jax.sharding.NamedSharding(mesh, ys_))
+        state, _ = step.train_repeat(state, x, y, K)   # compile + warm
+        # post-warm sync barrier BY DESIGN: the timed windows below must
+        # start from a drained device (cf. measure())
+        # velint: disable=sync-feed
+        np.asarray(state["params"][-1]["bias"][:1])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state, _ = step.train_repeat(state, x, y, K)
+            # measurement barrier BY DESIGN (cf. measure())
+            # velint: disable=sync-feed
+            np.asarray(state["params"][-1]["bias"][:1])
+            best = min(best, time.perf_counter() - t0)
+        opt_bytes = step.optimizer_state_bytes(state)
+        arm = {
+            "samples_per_sec": round(batch * K / best, 1),
+            "zero_active": step.zero_active,
+            "zero_reason": step.zero_reason,
+            "opt_state_bytes_per_device": {
+                str(d): b for d, b in sorted(opt_bytes.items())},
+            "opt_state_bytes_max": max(opt_bytes.values(), default=0),
+            "variants": step.variant_table(),
+            "device_memory": device_memory_stats(),
+        }
+        record["arms"][name] = arm
+        print(f"ABLATE zero[{name}]: {arm['samples_per_sec']:.0f} "
+              f"samples/s, opt-state {arm['opt_state_bytes_max']} "
+              f"B/device", flush=True)
+        del state
+    rep = record["arms"]["replicated"]
+    zro = record["arms"]["zero"]
+    record["deltas"] = {
+        "step_time_ratio": round(
+            rep["samples_per_sec"] / max(zro["samples_per_sec"], 1e-9),
+            4),
+        "opt_state_bytes_drop": round(
+            1.0 - zro["opt_state_bytes_max"]
+            / max(rep["opt_state_bytes_max"], 1), 4),
+        "expected_drop_floor": round((n_data - 1) / n_data, 4),
+    }
+    path = os.environ.get("VELES_ZERO_AB_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ZERO_AB_RECORD.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"ABLATE zero: opt-state drop "
+          f"{record['deltas']['opt_state_bytes_drop']:.4f} "
+          f"(floor {(n_data - 1) / n_data:.4f}), speed ratio "
+          f"repl/zero {record['deltas']['step_time_ratio']:.3f} "
+          f"-> {path}", flush=True)
+    return record
+
+
 if __name__ == "__main__":
-    for v in (sys.argv[1:] or ["full"]):
+    args = sys.argv[1:]
+    if "--zero" in args:
+        measure_zero_ab()
+        args = [a for a in args if a != "--zero"]
+        if not args:
+            raise SystemExit(0)
+    for v in (args or ["full"]):
         measure(variant(v), v)

@@ -132,6 +132,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "device_put is already in flight. Default 1 "
                         "(the classic double buffer); 0 disables "
                         "lookahead")
+    p.add_argument("--zero-sharding", nargs="?", const="on",
+                   default="auto", choices=("on", "off", "auto"),
+                   metavar="{on,off,auto}",
+                   help="ZeRO-style sharded weight update for the fused "
+                        "dp step (arxiv 2004.13336): reduce-scatter "
+                        "grads, update this replica's 1/N slice of "
+                        "params + optimizer state, all-gather fresh "
+                        "params — optimizer-state memory /N, same "
+                        "collective bytes. Default auto = on wherever "
+                        "the dp shard_map update runs single-host; "
+                        "degrades with a logged reason for GPipe, "
+                        "gspmd/seq, EP and multi-host meshes. Bare "
+                        "--zero-sharding means 'on' — place it AFTER "
+                        "the positional workflow/config arguments (or "
+                        "spell the value) so it cannot swallow them")
     p.add_argument("--accum", type=int, default=None, metavar="K",
                    help="gradient accumulation: compute each minibatch's "
                         "gradient as K scanned microbatches before the "
@@ -393,7 +408,8 @@ def main(argv=None) -> int:
         compile_cache=not args.no_compile_cache,
         nonfinite_guard=args.nonfinite_guard,
         verify_workflow=args.verify_workflow or "",
-        mirror=args.mirror, feed_ahead=args.feed_ahead)
+        mirror=args.mirror, feed_ahead=args.feed_ahead,
+        zero_sharding=args.zero_sharding)
     if args.verify_workflow:
         # takes precedence over every execution mode (incl. --optimize,
         # which otherwise bypasses Launcher.main entirely): the flag

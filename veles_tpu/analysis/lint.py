@@ -27,6 +27,15 @@ them (rule catalogue + one-line triggering examples in docs/ANALYSIS.md):
   unit's `stop()` — a loader that spawns threads without a stop/join
   path leaks them past Ctrl-C/teardown (the exact bug the teardown
   hardening fixed once already).
+- `stray-collective` (error): a cross-replica collective
+  (`lax.psum`/`pmean`/`all_gather`/`psum_scatter`) called outside the
+  lowering-variant registry (`ops/variants.py`) or the fused/pipeline
+  step modules. Collectives placed ad hoc in step code bypass the
+  equivalence contract, the autotuner, and the variant table every
+  record embeds — and an SPMD program whose collectives differ between
+  processes deadlocks the job. Register the collective as a variant
+  (the `grad_reduce` reduce-scatter is the precedent) or move it into
+  the step modules that own collective placement.
 
 Suppression: append `# velint: disable=RULE[,RULE2]` (or `disable=all`)
 to the offending line. CI gate: `tools/velint.py --ci` compares against
@@ -58,7 +67,25 @@ RULES: Dict[str, str] = {
     "sync-feed": "host-blocking transfer (np.asarray/jax.device_get/"
                  "unsharded device_put) inside a step-driver loop — "
                  "feed batches through loader.device_feed.DeviceFeed",
+    "stray-collective": "cross-replica collective (psum/pmean/"
+                        "all_gather/psum_scatter) outside ops/variants "
+                        "(the registry) or the fused/pipeline step "
+                        "modules",
 }
+
+#: collective primitives the stray-collective rule watches
+_COLLECTIVE_NAMES = ("psum", "pmean", "all_gather", "psum_scatter")
+
+#: modules that legitimately place collectives: the registry (where a
+#: collective is an equivalence-contracted, tunable variant) and the two
+#: step builders that own collective placement for the whole program
+_COLLECTIVE_HOMES = ("parallel/fused.py", "parallel/pipeline.py",
+                     "ops/variants.py")
+
+
+def _is_collective_home(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(h) for h in _COLLECTIVE_HOMES)
 
 #: call chains that create background threads (the loader-thread rule)
 _THREAD_CTORS = ("threading.Thread", "Thread", "ThreadPoolExecutor",
@@ -127,6 +154,7 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         self.findings: List[LintFinding] = []
         self._loader_file = _is_loader_path(path)
+        self._collective_home = _is_collective_home(path)
         #: innermost-class stack of "defines a stop() method" flags
         self._class_stop: List[bool] = []
         self._class_depth = 0
@@ -266,6 +294,18 @@ class _Linter(ast.NodeVisitor):
                        + ": background produce threads must have a "
                          "stop/join path — Workflow teardown calls "
                          "every unit's stop() (stop_units contract)")
+
+        if leaf in _COLLECTIVE_NAMES and not self._collective_home \
+                and (chain == leaf
+                     or chain.startswith(("lax.", "jax.lax."))):
+            self._emit(node, "stray-collective",
+                       f"`{chain}(...)` outside the lowering-variant "
+                       "registry and the fused/pipeline step modules: "
+                       "an ad-hoc collective bypasses the equivalence "
+                       "contract, the autotuner and the variant table "
+                       "— register it in ops/variants.py (grad_reduce "
+                       "is the precedent) or place it in the step "
+                       "builders that own collectives")
 
         if chain == "jax.jit" and self._loop_depth:
             self._emit(node, "jit-in-loop",

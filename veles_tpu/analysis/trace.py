@@ -26,7 +26,11 @@ Rules (docs/ANALYSIS.md):
   step every call;
 - `sharding-mismatch` (error): a param PartitionSpec names a mesh axis
   that does not exist or shards a dimension the axis size does not
-  divide — the exact drift class the PR-2 `out_shardings` pin fixed;
+  divide — the exact drift class the PR-2 `out_shardings` pin fixed.
+  Covers OPTIMIZER-STATE specs too: a ZeRO-sharded step's velocity/
+  moment plan (parallel.mesh.zero_plan) is checked leaf-by-leaf — the
+  flat (padded,) vector must be divisible by the data axis, split into
+  equal local slices, and must not drop elements of the leaf it encodes;
 - `pre-vma-numerics` (warn): the structured form of
   `_compat.warn_pre_vma_numerics` — GPipe / seq×TP builds on pre-vma
   jax have ~1e-3 trained-loss deviation;
@@ -240,6 +244,93 @@ def _sharding_findings(step) -> List[Finding]:
                             f"divisible by mesh axis {ax!r} "
                             f"({mesh.shape[ax]} shards): XLA would "
                             "pad-shard or reject it", site))
+    out += _optstate_findings(step, mesh)
+    return out
+
+
+def _optstate_findings(step, mesh) -> List[Finding]:
+    """Optimizer-state half of the sharding audit: a ZeRO-sharded step
+    carries its velocities/Adam moments as flat vectors split over the
+    data axis per the update-sharding plan. These checks guard the
+    PLAN CACHE (step._zero_plan_cache) — the mutable handoff every
+    consumer (specs, init, the traced update, checkpoint geometry)
+    reads — against a corrupted/stale entry; a freshly computed plan
+    satisfies them by construction, so the independent ledger is the
+    LIVE state cross-check in `_optstate_state_findings` (what a
+    restore or caller actually handed the step)."""
+    if not getattr(step, "zero_active", False):
+        return []
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    n = mesh.shape.get(DATA_AXIS, 1)
+    out: List[Finding] = []
+    for u, plan in zip(step.forwards, step.zero_plans()):
+        for k, lp in plan.items():
+            site = (f"{getattr(u, 'name', u)}.vel[{k}] "
+                    f"({lp.padded},) over {DATA_AXIS!r}")
+            if lp.padded % n:
+                out.append(Finding(
+                    "sharding-mismatch", SEV_ERROR, repr(u),
+                    f"optimizer-state leaf {k!r} plans {lp.padded} "
+                    f"elements, not divisible by the data axis "
+                    f"({n} shards): the reduce-scatter/all-gather pair "
+                    "cannot tile it", site))
+            elif lp.local * n != lp.padded:
+                out.append(Finding(
+                    "sharding-mismatch", SEV_ERROR, repr(u),
+                    f"optimizer-state leaf {k!r} plans local slices of "
+                    f"{lp.local} x {n} shards != {lp.padded} padded "
+                    "elements: shards would overlap or leave gaps",
+                    site))
+            if lp.padded < lp.size:
+                out.append(Finding(
+                    "sharding-mismatch", SEV_ERROR, repr(u),
+                    f"optimizer-state leaf {k!r} plans only {lp.padded} "
+                    f"elements for a {lp.size}-element leaf: the "
+                    "update would silently drop the tail", site))
+    return out
+
+
+def _optstate_state_findings(step, state) -> List[Finding]:
+    """Cross-check the LIVE optimizer state against the update-sharding
+    plan — the independent ledger for the plan checks above: the plan
+    is what the step will trace, the state is what `init_state()`, a
+    checkpoint restore, or the caller actually handed it. A velocity /
+    moment leaf whose stored geometry disagrees with the plan (wrong
+    flat length) would dynamic-slice out of bounds or drop tail
+    elements at update time."""
+    if not getattr(step, "zero_active", False):
+        return []
+    vel = state.get("vel") if isinstance(state, dict) else None
+    if vel is None:
+        return []
+    from veles_tpu.ops import optim
+    out: List[Finding] = []
+    cfgs = getattr(step, "cfgs", None) or [None] * len(step.forwards)
+    for u, plan, v, cfg in zip(step.forwards, step.zero_plans(), vel,
+                               cfgs):
+        if isinstance(cfg, optim.AdamConfig):
+            groups = (("m", v.get("m", {})), ("v", v.get("v", {})))
+        else:
+            groups = (("", v),)
+        for gname, leaves in groups:
+            if not isinstance(leaves, dict):
+                continue
+            for k, lp in plan.items():
+                leaf = leaves.get(k)
+                if leaf is None:
+                    continue
+                shape = tuple(np.shape(leaf))
+                label = f"{gname}.{k}" if gname else k
+                if shape != (lp.padded,):
+                    out.append(Finding(
+                        "sharding-mismatch", SEV_ERROR, repr(u),
+                        f"optimizer-state leaf {label!r} carries shape "
+                        f"{shape}, but the update-sharding plan slices "
+                        f"a ({lp.padded},) flat vector (leaf "
+                        f"{lp.shape}, {lp.size} elements): the state "
+                        "does not match the plan it will be updated "
+                        "under",
+                        f"{getattr(u, 'name', u)}.vel[{label}]"))
     return out
 
 
@@ -280,6 +371,13 @@ def audit_fused_step(step, x, y, w=None, state=None,
     if state is None:
         state = step.init_state()
     findings += _state_findings(state)
+    optstate = _optstate_state_findings(step, state)
+    findings += optstate
+    if any(f.severity == SEV_ERROR for f in optstate):
+        # state geometry disagrees with the plan the trace would slice
+        # under — tracing would crash on (or worse, silently mask) the
+        # defect just reported
+        return findings
 
     x = np.asarray(x)
     y = np.asarray(y)

@@ -45,6 +45,7 @@ class Launcher(Logger):
                  verify_workflow: str = "",
                  mirror: str = "",
                  feed_ahead: Optional[int] = None,
+                 zero_sharding: str = "auto",
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -170,6 +171,30 @@ class Launcher(Logger):
                              "fused/pipelined loops: combine with "
                              "--fused, --pp or a distributed -l/-m run")
         self.feed_ahead = feed_ahead
+        #: ZeRO weight-update sharding gate for the fused dp step
+        #: (parallel/fused.py, arxiv 2004.13336): "auto" (default) turns
+        #: it on wherever the dp shard_map update runs single-host,
+        #: "on" warns loudly when the step cannot apply it, "off" pins
+        #: the replicated update. GPipe is not covered by this build —
+        #: degrade with a logged reason instead of silently ignoring.
+        if zero_sharding not in ("on", "off", "auto"):
+            raise SystemExit(f"--zero-sharding takes on/off/auto "
+                             f"(got {zero_sharding!r})")
+        if zero_sharding == "on" and pp:
+            self.warning("zero-sharding degrades for --pp: the GPipe "
+                         "pipeline step partitions by stage, not by "
+                         "data replica — the replicated update stays "
+                         "(ZeRO covers the fused dp path this build)")
+        if zero_sharding != "auto" and not (fused or pp
+                                            or listen or master):
+            # same precedent as --feed-ahead/--autotune: the granular
+            # unit graph never consumes the knob, and silently ignoring
+            # an explicit on/off would let an operator believe the
+            # optimizer state is (or isn't) sharded
+            raise SystemExit("--zero-sharding gates the fused dp "
+                             "update: combine with --fused, --pp or a "
+                             "distributed -l/-m run")
+        self.zero_sharding = zero_sharding
         #: opt-out for the persistent XLA compile cache (the cache is
         #: also auto-skipped on axon backends — see
         #: enable_compilation_cache)
@@ -385,12 +410,20 @@ class Launcher(Logger):
             wf = self.workflow
 
             def _hb(epoch: int) -> None:
-                # the device feed's overlap counters ride the heartbeat
-                # payload so the supervisor's JSON exit report can show
-                # the input-pipeline health of the supervised child
-                # (loader/device_feed.py; None for granular runs)
+                # the device feed's overlap counters AND a per-device
+                # memory snapshot ride the heartbeat payload so the
+                # supervisor's JSON exit report shows the input-pipeline
+                # health and the measured memory footprint of the
+                # supervised child (loader/device_feed.py,
+                # parallel/memstats.py; None for granular/jax-free runs)
                 feed = getattr(wf, "feed_stats", None)
-                write_heartbeat(hb_path, epoch, feed=feed)
+                try:
+                    from veles_tpu.parallel.memstats import \
+                        device_memory_stats
+                    mem = device_memory_stats()
+                except Exception:  # noqa: BLE001 — stats never kill a beat
+                    mem = None
+                write_heartbeat(hb_path, epoch, feed=feed, mem=mem)
             installed_hooks.append(_rhooks.add_epoch_hook(_hb))
         plan = _faults.active_plan()
         if plan is not None:
@@ -522,7 +555,8 @@ class Launcher(Logger):
                         mode="auto", ep=self.ep,
                         accum_steps=self.accum,
                         nonfinite_guard=self.nonfinite_guard,
-                        feed_ahead=self.feed_ahead, **kwargs)
+                        feed_ahead=self.feed_ahead,
+                        zero_sharding=self.zero_sharding, **kwargs)
             elif self.pp:
                 if not hasattr(self.workflow, "run_pipelined"):
                     raise SystemExit(
@@ -540,7 +574,8 @@ class Launcher(Logger):
                 self.workflow.run_fused(
                     device=self.device, accum_steps=self.accum,
                     nonfinite_guard=self.nonfinite_guard,
-                    feed_ahead=self.feed_ahead, **kwargs)
+                    feed_ahead=self.feed_ahead,
+                    zero_sharding=self.zero_sharding, **kwargs)
             else:
                 if self.nonfinite_guard and hasattr(self.workflow,
                                                     "decision"):

@@ -6,6 +6,14 @@ Pure pytree-in/pytree-out functions so the whole update fuses into the
 compiled train step (the reference ran a separate weight-update kernel per
 layer; XLA fuses ours into the backward pass — and on multi-chip the update
 runs sharded, see veles_tpu/parallel).
+
+ZeRO update sharding (arxiv 2004.13336, parallel.mesh.zero_plan): the
+per-leaf rules are factored out (`sgd_leaf`/`adam_leaf`) so the replicated
+update and the shard-local 1/N-slice update are the SAME math applied to
+different slices — equivalence between the two paths is structural, not
+hoped-for. `sgd_init`/`adam_init` take the plan and then allocate only
+flat (padded,) state vectors; the caller shards them over the data axis
+(each device ends up holding one `local`-sized slice).
 """
 
 from __future__ import annotations
@@ -14,6 +22,7 @@ from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class SGDConfig(NamedTuple):
@@ -24,9 +33,47 @@ class SGDConfig(NamedTuple):
     lr_bias_mult: float = 2.0      # reference: bias lr multiplier convention
 
 
-def sgd_init(params: Any) -> Any:
-    """Velocity pytree, zeros like params."""
-    return jax.tree_util.tree_map(jnp.zeros_like, params)
+def sgd_leaf_lr(cfg: SGDConfig, ndim: int, lr_scale=1.0,
+                key: Optional[str] = None,
+                mults: Optional[Dict[str, float]] = None):
+    """Effective lr for ONE leaf: schedule scale, per-key multiplier
+    (reference per-layer lr_mult), and the bias convention — 1-D leaves
+    get the bias multiplier. `ndim` is the leaf's ORIGINAL rank, so a
+    ZeRO-flattened slice still resolves the same lr as its unflattened
+    twin."""
+    lr = cfg.lr * lr_scale
+    if mults and key in mults:
+        lr = lr * mults[key]
+    if ndim == 1 and cfg.lr_bias_mult != 1.0:
+        lr = lr * cfg.lr_bias_mult
+    return lr
+
+
+def sgd_leaf(p, g, v, cfg: SGDConfig, lr):
+    """v ← μ·v − lr·(g + λ2·w + λ1·sign(w));  w ← w + v — one leaf (or
+    one ZeRO slice of a leaf; `lr` is already fully resolved)."""
+    reg = g
+    if cfg.weight_decay:
+        reg = reg + cfg.weight_decay * p
+    if cfg.l1_decay:
+        reg = reg + cfg.l1_decay * jnp.sign(p)
+    v_new = cfg.momentum * v - lr * reg
+    return p + v_new, v_new
+
+
+def sgd_init(params: Any, plan: Any = None) -> Any:
+    """Velocity pytree, zeros like params. With a ZeRO `plan`
+    (parallel.mesh.zero_plan) each leaf becomes a flat (padded,) zeros
+    vector instead — HOST-side numpy, so no full-size leaf ever touches
+    a device: the caller's sharded device_put is the first (and only)
+    device allocation, and each replica materializes just its 1/N
+    slice. A full-size jnp.zeros here would spike the default device by
+    the whole optimizer state at init — exactly the memory ZeRO exists
+    to save."""
+    if plan is None:
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+    return jax.tree_util.tree_map(
+        lambda a, lp: np.zeros((lp.padded,), a.dtype), params, plan)
 
 
 def sgd_update(params: Any, grads: Any, velocity: Any, cfg: SGDConfig,
@@ -39,21 +86,10 @@ def sgd_update(params: Any, grads: Any, velocity: Any, cfg: SGDConfig,
     multipliers (reference per-layer lr_mult)."""
 
     def upd(path, p, g, v):
-        lr = cfg.lr * lr_scale
-        if mults:
-            key = path[0].key if path and hasattr(path[0], "key") else None
-            if key in mults:
-                lr = lr * mults[key]
-        # bias convention: 1-D params get the bias multiplier
-        if p.ndim == 1 and cfg.lr_bias_mult != 1.0:
-            lr = lr * cfg.lr_bias_mult
-        reg = g
-        if cfg.weight_decay:
-            reg = reg + cfg.weight_decay * p
-        if cfg.l1_decay:
-            reg = reg + cfg.l1_decay * jnp.sign(p)
-        v_new = cfg.momentum * v - lr * reg
-        return p + v_new, v_new
+        key = path[0].key if path and hasattr(path[0], "key") else None
+        lr = sgd_leaf_lr(cfg, p.ndim, lr_scale=lr_scale, key=key,
+                         mults=mults)
+        return sgd_leaf(p, g, v, cfg, lr)
 
     flat = jax.tree_util.tree_map_with_path(upd, params, grads, velocity)
     new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
@@ -71,27 +107,40 @@ class AdamConfig(NamedTuple):
     weight_decay: float = 0.0
 
 
-def adam_init(params: Any) -> Any:
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-    return {"m": zeros,
-            "v": jax.tree_util.tree_map(jnp.zeros_like, params),
-            "t": jnp.zeros((), jnp.int32)}
+def adam_init(params: Any, plan: Any = None) -> Any:
+    """Adam state; with a ZeRO `plan`, m/v become flat (padded,) zeros
+    (the caller shards them — see sgd_init). The step counter `t` stays
+    a replicated scalar: it is the same on every shard by construction."""
+    def zeros():
+        return sgd_init(params, plan=plan)
+    return {"m": zeros(), "v": zeros(), "t": jnp.zeros((), jnp.int32)}
+
+
+def adam_step_factors(cfg: AdamConfig, t):
+    """Bias-correction denominators for step `t` (already incremented)."""
+    b1t = 1.0 - cfg.b1 ** t.astype(jnp.float32)
+    b2t = 1.0 - cfg.b2 ** t.astype(jnp.float32)
+    return b1t, b2t
+
+
+def adam_leaf(p, g, m, v, cfg: AdamConfig, b1t, b2t, lr):
+    """One leaf (or one ZeRO slice) of the Adam rule; `lr` is the
+    schedule-scaled cfg.lr, `b1t`/`b2t` come from adam_step_factors."""
+    if cfg.weight_decay:
+        g = g + cfg.weight_decay * p
+    m_new = cfg.b1 * m + (1 - cfg.b1) * g
+    v_new = cfg.b2 * v + (1 - cfg.b2) * g * g
+    step = lr * (m_new / b1t) / (jnp.sqrt(v_new / b2t) + cfg.eps)
+    return p - step, m_new, v_new
 
 
 def adam_update(params: Any, grads: Any, state: Any, cfg: AdamConfig,
                 lr_scale: float = 1.0):
     t = state["t"] + 1
-    b1t = 1.0 - cfg.b1 ** t.astype(jnp.float32)
-    b2t = 1.0 - cfg.b2 ** t.astype(jnp.float32)
+    b1t, b2t = adam_step_factors(cfg, t)
 
     def upd(p, g, m, v):
-        if cfg.weight_decay:
-            g = g + cfg.weight_decay * p
-        m_new = cfg.b1 * m + (1 - cfg.b1) * g
-        v_new = cfg.b2 * v + (1 - cfg.b2) * g * g
-        step = cfg.lr * lr_scale * (m_new / b1t) / (
-            jnp.sqrt(v_new / b2t) + cfg.eps)
-        return p - step, m_new, v_new
+        return adam_leaf(p, g, m, v, cfg, b1t, b2t, cfg.lr * lr_scale)
 
     triples = jax.tree_util.tree_map(upd, params, grads, state["m"], state["v"])
     pick = lambda i: jax.tree_util.tree_map(  # noqa: E731

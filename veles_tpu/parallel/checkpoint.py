@@ -50,6 +50,22 @@ def _checkpointer():
     return _CKPTR
 
 
+_HOST_CKPTR = None
+
+
+def _host_checkpointer():
+    """Cached PyTreeCheckpointer for host-side (numpy) restores — the
+    ZeRO reshard path reads the saved geometry into host RAM instead of
+    materializing it replicated on every device (see
+    _vel_reshard_restore); format-compatible with what
+    StandardCheckpointer saved."""
+    global _HOST_CKPTR
+    if _HOST_CKPTR is None:
+        import orbax.checkpoint as ocp
+        _HOST_CKPTR = ocp.PyTreeCheckpointer()
+    return _HOST_CKPTR
+
+
 from veles_tpu.prng import key_impl_name as _key_impl_name  # noqa: E402
 
 
@@ -82,7 +98,10 @@ def save_state(state: Dict[str, Any], directory: str) -> str:
 def _abstract_state(step, key_impl: str) -> Dict[str, Any]:
     """ShapeDtypeStructs of the step's state (key carried as raw uint32
     data), built from the units' HOST-side shapes: no device allocation,
-    no PRNG draw — a restore target for states too big to double-buffer."""
+    no PRNG draw — a restore target for states too big to double-buffer.
+    A ZeRO-sharded step (step.zero_active) carries flat (padded,)
+    optimizer-state vectors per its update-sharding plan instead of
+    param-shaped leaves."""
     import jax.numpy as jnp
 
     from veles_tpu.ops import optim
@@ -91,10 +110,20 @@ def _abstract_state(step, key_impl: str) -> Dict[str, Any]:
          for k, a in u.param_arrays().items()}
         for u in step.forwards)
     cfgs = getattr(step, "cfgs", None) or [None] * len(params)
+    plans = (step.zero_plans() if getattr(step, "zero_active", False)
+             else (None,) * len(params))
+
+    def vel_leaves(p, plan):
+        if plan is None:
+            return p
+        return {k: jax.ShapeDtypeStruct((plan[k].padded,), p[k].dtype)
+                for k in p}
+
     vel = tuple(
-        {"m": p, "v": p, "t": jax.ShapeDtypeStruct((), jnp.int32)}
-        if isinstance(c, optim.AdamConfig) else p
-        for p, c in zip(params, cfgs))
+        {"m": vel_leaves(p, pl), "v": vel_leaves(p, pl),
+         "t": jax.ShapeDtypeStruct((), jnp.int32)}
+        if isinstance(c, optim.AdamConfig) else vel_leaves(p, pl)
+        for p, c, pl in zip(params, cfgs, plans))
     key_shape = jax.eval_shape(
         lambda: jax.random.key_data(jax.random.key(0, impl=key_impl)))
     return {"params": params, "vel": vel,
@@ -130,6 +159,15 @@ def restore_state(step, directory: str) -> Dict[str, Any]:
     # traceback buries which leaf disagreed
     err = _geometry_error(ckptr, path, target, None)
     if err is not None:
+        # one mismatch class is LEGAL and resharded in place: the
+        # optimizer-state (vel) geometry moving between ZeRO plans —
+        # a save under data-axis N restored into a step with a
+        # different N, or a zero-sharded save into a replicated step
+        # (and vice versa). Everything else still raises.
+        state = _vel_reshard_restore(ckptr, path, step, template,
+                                     key_impl)
+        if state is not None:
+            return state
         raise err
     try:
         state = ckptr.restore(path, target)
@@ -139,15 +177,21 @@ def restore_state(step, directory: str) -> Dict[str, Any]:
     return state
 
 
+def _keystr(path) -> str:
+    """Orbax-style key string for one pytree keypath — the ONE
+    stringification `_leaf_index` builds its index with and
+    `_vel_reshard_restore` looks leaves up by (they must stay
+    byte-identical or legal reshards crash on KeyError)."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
 def _leaf_index(tree) -> Dict[str, Any]:
     """Flatten a pytree to {keypath: leaf} with orbax-style key strings
     (shared diff basis for the saved metadata and the restore target)."""
     import jax.tree_util as jtu
-    out = {}
-    for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
-        out["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                     for k in path)] = leaf
-    return out
+    return {_keystr(path): leaf
+            for path, leaf in jtu.tree_flatten_with_path(tree)[0]}
 
 
 def _geometry_error(ckptr, path: str, target, cause):
@@ -188,6 +232,110 @@ def _describe(leaf) -> str:
     shape = tuple(getattr(leaf, "shape", ()) or ())
     dtype = getattr(leaf, "dtype", None)
     return f"{shape}/{dtype}"
+
+
+# -- ZeRO optimizer-state resharding (restore across a data-axis change) ------
+
+def _orig_vel_shapes(step) -> Dict[str, tuple]:
+    """{vel keypath: the leaf's ORIGINAL (unflattened) shape} for every
+    velocity/moment leaf — the invariant both the replicated and any
+    ZeRO-flattened geometry encode (the Adam step counter `t` is
+    excluded: its geometry never changes)."""
+    from veles_tpu.ops import optim
+    cfgs = getattr(step, "cfgs", None) or [None] * len(step.forwards)
+    out: Dict[str, tuple] = {}
+    for i, (u, c) in enumerate(zip(step.forwards, cfgs)):
+        for k, a in u.param_arrays().items():
+            shape = tuple(a.shape)
+            if isinstance(c, optim.AdamConfig):
+                out[f"vel/{i}/m/{k}"] = shape
+                out[f"vel/{i}/v/{k}"] = shape
+            else:
+                out[f"vel/{i}/{k}"] = shape
+    return out
+
+
+def _vel_reshard_restore(ckptr, path: str, step, template, key_impl: str):
+    """Geometry-mismatch fallback for `restore_state`: when the ONLY
+    disagreement between the checkpoint and the step's target is the
+    velocity/moment leaf geometry, and each disagreeing pair is two
+    legal encodings of the same leaf (its original shape, or a flat
+    ZeRO (padded,) vector with padded >= size), restore into the SAVED
+    geometry and reshape every such leaf into the step's plan: undo the
+    old padding, re-pad for the new data-axis size, land each leaf
+    under the step's own shardings. Returns the resharded state, or
+    None when the mismatch is a different class (caller raises the
+    original CheckpointGeometryError)."""
+    import numpy as np
+    try:
+        saved = _leaf_index(ckptr.metadata(path))
+    except Exception:  # noqa: BLE001 — unreadable: not this class
+        return None
+    want = _leaf_index(template)
+    if set(saved) != set(want):
+        return None
+    orig = _orig_vel_shapes(step)
+
+    def legal(shape, base) -> bool:
+        size = int(np.prod(base)) if base else 1
+        return tuple(shape) == base or (
+            len(shape) == 1 and int(shape[0]) >= size)
+
+    differing = []
+    for k in saved:
+        if _describe(saved[k]) == _describe(want[k]):
+            continue
+        base = orig.get(k)
+        s_dt = getattr(saved[k], "dtype", None)
+        w_dt = getattr(want[k], "dtype", None)
+        if base is None or str(s_dt) != str(w_dt) \
+                or not legal(tuple(saved[k].shape or ()), base) \
+                or not legal(tuple(want[k].shape or ()), base):
+            return None
+        differing.append(k)
+    if not differing:
+        return None     # trees agree: not a geometry problem at all
+
+    # restore into the SAVED geometry as HOST numpy (PyTree restore,
+    # restore_type=np.ndarray): the reshaping below runs on host arrays
+    # and each leaf reaches the devices exactly once, already under the
+    # step's own shardings. A replicated device restore here would
+    # materialize every FULL moment vector on EVERY device first —
+    # an HBM spike of N x the sharded footprint on exactly the models
+    # ZeRO-sharding exists to fit (zero excludes multi-host, so the
+    # whole tree is host-addressable by construction).
+    import jax.tree_util as jtu
+    import orbax.checkpoint as ocp
+    saved_target = jtu.tree_map_with_path(
+        lambda p_, leaf: jax.ShapeDtypeStruct(
+            tuple(saved[_keystr(p_)].shape or ()),
+            saved[_keystr(p_)].dtype),
+        template)
+    restore_args = jtu.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), saved_target)
+    state = _host_checkpointer().restore(path, item=saved_target,
+                                         restore_args=restore_args)
+
+    shardings = _target_shardings(step, template)
+
+    def convert(path_, leaf, tmpl, sh):
+        k = _keystr(path_)
+        tshape = tuple(tmpl.shape or ())
+        if tuple(np.shape(leaf)) != tshape:
+            base = orig[k]
+            size = int(np.prod(base)) if base else 1
+            flat = np.asarray(leaf).reshape(-1)[:size]
+            if len(tshape) == 1:        # target is a ZeRO flat vector
+                out = np.zeros(tshape[0], flat.dtype)
+                out[:size] = flat
+            else:                       # target is the original shape
+                out = flat.reshape(tshape)
+            leaf = out
+        return jax.device_put(leaf, sh)
+
+    state = jtu.tree_map_with_path(convert, state, template, shardings)
+    state["key"] = jax.random.wrap_key_data(state["key"], impl=key_impl)
+    return state
 
 
 def _target_shardings(step, template):

@@ -30,6 +30,18 @@ broadcast-psum. The EP group IS the DP group (DeepSpeed-MoE layout).
 A mesh of one device degrades to plain jit (same code path, collectives
 are no-ops) — SURVEY.md §7: build size-agnostically.
 
+ZeRO weight-update sharding ("dp" mode, on by default there; arxiv
+2004.13336 — the decomposition that became XLA's weight-update sharding):
+instead of every replica applying the full update after the grad
+all-reduce, the gradient is reduce-SCATTERED (via the `grad_reduce`
+registry op), each replica updates only its 1/N slice of params +
+momentum/Adam state under the per-leaf plan in `parallel.mesh.zero_plan`,
+and the fresh params are all-gathered for the next forward. Same bytes
+moved as the all-reduce, optimizer-state memory ÷N, and the two collective
+legs overlap with compute. Degrades (with a logged reason, see
+`zero_reason`) for local/gspmd/seq modes, EP, single-shard data axes and
+multi-host meshes — those keep the replicated update this PR left alone.
+
 Numerics match the granular unit-by-unit path (tested): grads come from
 `jax.grad` over the same `fused_apply` forward math, and the update is the
 same `ops.optim.sgd_update` the GD units use, with each layer keeping its
@@ -52,7 +64,9 @@ from veles_tpu._compat import shard_map
 from veles_tpu import prng
 from veles_tpu.ops import optim
 from veles_tpu.ops import xla as ox
-from veles_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from veles_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
+                                     zero_flatten, zero_plan,
+                                     zero_unflatten)
 
 
 def _tree_cast(tree, dtype):
@@ -134,7 +148,8 @@ class FusedTrainStep:
                  donate: bool = True,
                  compute_dtype: Optional[Any] = None,
                  ep: bool = False,
-                 input_normalize: Optional[Dict[str, Any]] = None) -> None:
+                 input_normalize: Optional[Dict[str, Any]] = None,
+                 zero_sharding: Any = "auto") -> None:
         self.mesh = mesh
         #: on-device input prologue {"scale", "offset", "mean"} (the
         #: uint8-wire contract, loader wire_format/device_feed): raw
@@ -219,10 +234,79 @@ class FusedTrainStep:
                     "ep=True but no forward unit declares ep_params — "
                     "the step would silently run plain DP")
         self.ep = ep
+        #: ZeRO update sharding (docstring above): resolved against the
+        #: mode/mesh NOW so every later consumer (state specs, init,
+        #: checkpoint geometry, auditor, reports) reads one verdict
+        self.zero_active, self.zero_reason = \
+            self._resolve_zero(zero_sharding)
+        self._zero_plan_cache = None
         self.donate = donate
         self._train_fn = None
         self._eval_fn = None
         self._train_many_fn = None
+
+    def _resolve_zero(self, req: Any) -> Tuple[bool, str]:
+        """Gate the ZeRO sharded update: active only where this build
+        covers it (explicit shard_map "dp" over a >1-shard single-host
+        data axis, no EP). `req` is the CLI surface: "on"/True forces a
+        WARNING when it cannot apply, "auto" (the default — zero IS the
+        default dp update) degrades quietly, "off"/False disables."""
+        from veles_tpu.parallel.mesh import is_multihost
+        if req in (False, "off"):
+            return False, "zero-sharding disabled by request"
+        if req not in (True, "on", "auto", None):
+            raise ValueError(f"zero_sharding must be on/off/auto "
+                             f"(got {req!r})")
+        if self.mode != "dp":
+            reason = (f"zero-sharding inactive: mode {self.mode!r} "
+                      "(covered: the explicit shard_map 'dp' update; "
+                      "gspmd relies on the partitioner, local has one "
+                      "replica)")
+        elif self.ep:
+            reason = ("zero-sharding inactive: ep=True already shards "
+                      "expert tensors over the data axis (the "
+                      "composition is not covered by this build)")
+        elif self.mesh.shape.get(DATA_AXIS, 1) < 2:
+            reason = ("zero-sharding inactive: data axis has a single "
+                      "shard (nothing to shard the update over)")
+        elif is_multihost(self.mesh):
+            reason = ("zero-sharding inactive: multi-host mesh "
+                      "(cross-process sharded optimizer state is not "
+                      "covered by this build)")
+        else:
+            return True, "active"
+        import logging
+        log = logging.getLogger("veles.fused")
+        (log.warning if req in (True, "on") else log.debug)("%s", reason)
+        return False, reason
+
+    # -- ZeRO update-sharding plan (parallel.mesh.zero_plan) ----------------
+
+    def zero_plans(self):
+        """Per-layer {param: ZeroLeaf} plan over the data axis, from the
+        units' HOST-side shapes (no device allocation) — cached: specs,
+        init, the traced update, write_back and the checkpoint geometry
+        all read the SAME plan."""
+        if self._zero_plan_cache is None:
+            n = self.mesh.shape[DATA_AXIS]
+            self._zero_plan_cache = tuple(
+                zero_plan({k: a.mem for k, a in u.param_arrays().items()},
+                          n)
+                for u in self.forwards)
+        return self._zero_plan_cache
+
+    def optimizer_state_bytes(self, state) -> Dict[int, int]:
+        """{device_id: bytes} the optimizer-state pytree (state["vel"])
+        occupies per device — the measured form of the ZeRO memory claim
+        (bench records, tools/ablate.py --zero, tests), attributed by
+        the SAME shard rule as parallel.memstats (one ledger: a bench
+        record's "device_memory" and this can never silently diverge).
+        Host (numpy) leaves occupy zero device bytes and are skipped —
+        a measurement must never ALLOCATE device memory to take."""
+        from veles_tpu.parallel.memstats import bytes_per_device
+        return bytes_per_device(
+            leaf for leaf in jax.tree_util.tree_leaves(state["vel"])
+            if isinstance(leaf, jax.Array))
 
     # -- state <-> unit Arrays ----------------------------------------------
 
@@ -231,25 +315,53 @@ class FusedTrainStep:
             {k: jnp.asarray(a.mem) for k, a in u.param_arrays().items()}
             for u in self.forwards)
 
-        def seed_vel(u, g, p, cfg):
+        zero_shard = (NamedSharding(self.mesh, P(DATA_AXIS))
+                      if self.zero_active else None)
+
+        def put_flat(flat):
+            # flat (padded,) optimizer-state vector -> sharded over the
+            # data axis: each device materializes only its 1/N slice
+            return jax.device_put(flat, zero_shard)
+
+        def seed_vel(u, g, p, cfg, plan):
             if isinstance(cfg, optim.AdamConfig):
                 # Adam moments live only in the fused state (round-trip
                 # via the sharded checkpoint, not the GD-twin Arrays)
-                return optim.adam_init(p)
+                st = optim.adam_init(p, plan=plan)
+                if plan is not None:
+                    st["m"] = {k: put_flat(a) for k, a in st["m"].items()}
+                    st["v"] = {k: put_flat(a) for k, a in st["v"].items()}
+                return st
             # resume from the GD twin's velocity buffers when present
             # (written by write_back / restored from a snapshot)
             out = {}
             for k, a in p.items():
                 vname = _vel_attr(g, k)
                 varr = getattr(g, vname) if vname else None
-                if varr is not None and varr:
+                if plan is not None:
+                    # host-side staging (np, not jnp): the sharded
+                    # device_put is the FIRST device allocation, so no
+                    # replica ever holds a full-size velocity leaf
+                    lp = plan[k]
+                    if varr is not None and varr:
+                        flat = np.zeros(lp.padded, a.dtype)
+                        flat[:lp.size] = \
+                            np.asarray(varr.mem).reshape(-1)
+                        out[k] = put_flat(flat)
+                    else:
+                        out[k] = put_flat(
+                            np.zeros(lp.padded, a.dtype))
+                elif varr is not None and varr:
                     out[k] = jnp.asarray(varr.mem)
                 else:
                     out[k] = jnp.zeros_like(a)
             return out
 
-        vel = tuple(seed_vel(u, g, p, c) for u, g, p, c in
-                    zip(self.forwards, self.gd_units, params, self.cfgs))
+        plans = (self.zero_plans() if self.zero_active
+                 else (None,) * len(params))
+        vel = tuple(seed_vel(u, g, p, c, pl) for u, g, p, c, pl in
+                    zip(self.forwards, self.gd_units, params, self.cfgs,
+                        plans))
         state = {"params": params, "vel": vel,
                  "key": prng.get().next_key(),
                  "lr_scale": jnp.float32(1.0)}
@@ -284,9 +396,11 @@ class FusedTrainStep:
                     out_shardings=NamedSharding(self.mesh, P()))
             return np.asarray(self._gather_fn(a))
 
-        for u, g, p, v, cfg in zip(self.forwards, self.gd_units,
-                                   state["params"], state["vel"],
-                                   self.cfgs):
+        plans = (self.zero_plans() if self.zero_active
+                 else (None,) * len(self.forwards))
+        for u, g, p, v, cfg, plan in zip(self.forwards, self.gd_units,
+                                         state["params"], state["vel"],
+                                         self.cfgs, plans):
             adam = isinstance(cfg, optim.AdamConfig)
             for k, arr in u.param_arrays().items():
                 if deleted(p[k]) or (not adam and deleted(v[k])):
@@ -296,10 +410,16 @@ class FusedTrainStep:
                     continue  # moments stay in the fused state pytree
                 # momentum velocities land in the GD twin so a snapshot
                 # resumes with optimizer state intact (reference parity:
-                # whole-workflow pickle includes optimizer state)
+                # whole-workflow pickle includes optimizer state) — a
+                # ZeRO-sharded velocity is gathered and unflattened to
+                # the leaf shape the twin expects
                 vname = _vel_attr(g, k)
                 if vname is not None:
-                    getattr(g, vname).reset(host(v[k]))
+                    hv = host(v[k])
+                    if plan is not None:
+                        lp = plan[k]
+                        hv = hv.reshape(-1)[:lp.size].reshape(lp.shape)
+                    getattr(g, vname).reset(hv)
 
     def local_rows(self, n: int):
         """Boolean (n,) mask of GLOBAL batch rows whose data-axis shards
@@ -552,9 +672,12 @@ class FusedTrainStep:
         (sharded over the data axis) and seq-TP megatron shards keep
         their axis local (their grads arrive via all_to_all/ppermute
         transposes, which the old shard_map does differentiate
-        correctly). No-op on vma-era jax: the psum would double-count."""
+        correctly). No-op on vma-era jax: the psum would double-count.
+        No-op under ZeRO too: the update's reduce-scatter IS the
+        reduction there — a psum here would leave nothing to scatter
+        (and double the collective bytes)."""
         from veles_tpu import _compat
-        if not axes or _compat.GRAD_TRANSPOSE_PSUM:
+        if not axes or _compat.GRAD_TRANSPOSE_PSUM or self.zero_active:
             return grads
         specs = (self._seq_param_specs() if self.mode == "seq"
                  else self._smap_param_specs())
@@ -576,7 +699,11 @@ class FusedTrainStep:
     def _apply_update(self, state, grads):
         """One optimizer step from already-reduced grads; advances the
         carried key identically on every shard (fold_in of the *unfolded*
-        state key keeps it replicated)."""
+        state key keeps it replicated). Under ZeRO the grads arrive
+        UNREDUCED per-shard partials and the sharded update performs the
+        reduction itself (reduce-scatter)."""
+        if self.zero_active:
+            return self._apply_update_zero(state, grads)
         new_params, new_vel = [], []
         for p, g, v, cfg in zip(state["params"], grads, state["vel"],
                                 self.cfgs):
@@ -590,6 +717,75 @@ class FusedTrainStep:
                 np_, nv_ = p, v
             new_params.append(np_)
             new_vel.append(nv_)
+        new_key = jax.random.fold_in(state["key"], 1)
+        return {"params": tuple(new_params), "vel": tuple(new_vel),
+                "key": new_key, "lr_scale": state["lr_scale"]}
+
+    def _apply_update_zero(self, state, grads):
+        """ZeRO weight-update sharding (arxiv 2004.13336), traced inside
+        the dp shard_map body: per param leaf, reduce-SCATTER the
+        per-shard partial gradient (registry op "grad_reduce" — the
+        quantized EQuARX variants slot in there), apply the SAME
+        per-leaf optimizer rule to this shard's 1/N slice of params over
+        its slice-only momentum/Adam state, and all-gather the fresh
+        param slices for the next forward. Same wire bytes as the psum
+        it replaces; optimizer state never materializes beyond 1/N per
+        device. On vma-era jax autodiff has already all-reduced the
+        grads of replicated params, so the scatter degenerates to a
+        local slice of the reduced grad: the memory win is kept, but the
+        step pays all-reduce + all-gather — more bytes than either the
+        replicated update or the true scatter path, and no grad_reduce
+        registry op runs (variant_table omits it there). Replacing
+        autodiff's psum with a real psum_scatter is the jax-upgrade
+        follow-on (ROADMAP)."""
+        from veles_tpu import _compat
+        from veles_tpu.ops import variants
+        reduce = variants.resolve("grad_reduce").apply
+        idx = lax.axis_index(DATA_AXIS)
+        new_params, new_vel = [], []
+        for p, g, v, cfg, plan in zip(state["params"], grads,
+                                      state["vel"], self.cfgs,
+                                      self.zero_plans()):
+            if not p:
+                new_params.append(p)
+                new_vel.append(v)
+                continue
+            adam = isinstance(cfg, optim.AdamConfig)
+            if adam:
+                t = v["t"] + 1
+                b1t, b2t = optim.adam_step_factors(cfg, t)
+                nv: Dict[str, Any] = {"m": {}, "v": {}, "t": t}
+            else:
+                nv = {}
+            np_ = {}
+            for k in p:
+                lp = plan[k]
+                flat_g = zero_flatten(g[k], lp)
+                if _compat.GRAD_TRANSPOSE_PSUM:
+                    g_loc = lax.dynamic_slice(
+                        flat_g, (idx * lp.local,), (lp.local,))
+                else:
+                    g_loc = reduce(flat_g, DATA_AXIS)
+                p_loc = lax.dynamic_slice(
+                    zero_flatten(p[k], lp), (idx * lp.local,),
+                    (lp.local,))
+                if adam:
+                    p_new, m_new, v_new = optim.adam_leaf(
+                        p_loc, g_loc, v["m"][k], v["v"][k], cfg,
+                        b1t, b2t, cfg.lr * state["lr_scale"])
+                    nv["m"][k] = m_new
+                    nv["v"][k] = v_new
+                else:
+                    lr = optim.sgd_leaf_lr(cfg, lp.ndim,
+                                           lr_scale=state["lr_scale"])
+                    p_new, v_new = optim.sgd_leaf(p_loc, g_loc, v[k],
+                                                  cfg, lr)
+                    nv[k] = v_new
+                full = lax.all_gather(p_new, DATA_AXIS, axis=0,
+                                      tiled=True)
+                np_[k] = zero_unflatten(full, lp)
+            new_params.append(np_)
+            new_vel.append(nv)
         new_key = jax.random.fold_in(state["key"], 1)
         return {"params": tuple(new_params), "vel": tuple(new_vel),
                 "key": new_key, "lr_scale": state["lr_scale"]}
@@ -702,10 +898,24 @@ class FusedTrainStep:
             if isinstance(cfg, optim.AdamConfig) else sp
             for cfg, sp in zip(self.cfgs, per_layer))
 
+    def _zero_vel_specs(self):
+        """Optimizer-state specs under the ZeRO plan: every leaf is a
+        flat (padded,) vector sharded over the data axis — the shard_map
+        body sees only this shard's slice, matching what
+        _apply_update_zero reads/writes. Adam's step counter stays
+        replicated."""
+        specs = []
+        for u, cfg in zip(self.forwards, self.cfgs):
+            sp = {k: P(DATA_AXIS) for k in u.param_arrays()}
+            specs.append({"m": sp, "v": dict(sp), "t": P()}
+                         if isinstance(cfg, optim.AdamConfig) else sp)
+        return tuple(specs)
+
     def _smap_state_spec(self):
         psp = self._smap_param_specs()
-        return {"params": psp, "vel": self._vel_specs(psp, P()),
-                "key": P(), "lr_scale": P()}
+        vsp = (self._zero_vel_specs() if self.zero_active
+               else self._vel_specs(psp, P()))
+        return {"params": psp, "vel": vsp, "key": P(), "lr_scale": P()}
 
     # -- compilation ---------------------------------------------------------
 
@@ -958,6 +1168,7 @@ class FusedTrainStep:
         tunable op its forward chain contains — what bench records and
         the supervisor's exit report embed so a measured number always
         names the lowerings that produced it."""
+        from veles_tpu import _compat
         from veles_tpu.ops import variants
         table: Dict[str, str] = {}
         for u in self.forwards:
@@ -974,6 +1185,14 @@ class FusedTrainStep:
                 else variants.resolve(op, unit=u).name
             if name is not None:
                 table[op] = name
+        if self.zero_active and not _compat.GRAD_TRANSPOSE_PSUM:
+            # the ZeRO reduce-scatter resolves through the registry like
+            # any tunable lowering: a measured number must name which
+            # grad_reduce variant moved the gradient bytes. On vma-era
+            # jax the traced path slices autodiff's own all-reduce
+            # instead (see _apply_update_zero) — no registry op runs,
+            # so reporting one would fabricate provenance.
+            table["grad_reduce"] = variants.resolve("grad_reduce").name
         return table
 
     def evaluate(self, state, x, y, w=None):

@@ -19,7 +19,8 @@ runs on 1 dev chip, an 8-device CPU test mesh, and a v5e-64 pod
 from __future__ import annotations
 
 
-from typing import Dict, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -28,6 +29,76 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+
+
+# -- ZeRO update-sharding plan (arxiv 2004.13336) ---------------------------
+#
+# The weight-update sharding decomposition: instead of every data-parallel
+# replica all-reducing the full gradient and applying the full update,
+# each replica owns a 1/N slice of every param leaf (and ONLY that slice
+# of the optimizer state), reduce-scatters the gradient, updates its
+# slice, and all-gathers the fresh params. Same bytes on the wire as the
+# all-reduce, N× less optimizer-state memory, and the two collective legs
+# overlap with compute where one monolithic all-reduce could not.
+#
+# The plan below is the static half: per-leaf slicing geometry over the
+# "data" axis. The remainder rule: a leaf whose element count the axis
+# size does not divide is zero-padded (flattened) up to the next multiple
+# — pad elements carry zero grads/state and are dropped again after the
+# all-gather, so the padding is numerically invisible. The traced half
+# (flatten/pad/slice/unflatten) lives right next to it so the geometry
+# can never drift from the plan.
+
+@dataclass(frozen=True)
+class ZeroLeaf:
+    """One param leaf's slot in the update-sharding plan: flattened,
+    zero-padded to `padded` elements, split into equal `local`-sized
+    slices along the data axis (shard k owns [k*local, (k+1)*local))."""
+
+    shape: Tuple[int, ...]   # the leaf's original (unflattened) shape
+    size: int                # prod(shape)
+    padded: int              # size rounded up to a multiple of n_shards
+    local: int               # padded // n_shards — one shard's slice
+
+    @property
+    def ndim(self) -> int:
+        """Original rank — the optimizer's bias convention (1-D leaves
+        get the bias lr multiplier) must survive the flattening."""
+        return len(self.shape)
+
+
+def zero_leaf(shape: Sequence[int], n_shards: int) -> ZeroLeaf:
+    """Plan one leaf: pad-to-divisible remainder rule along "data"."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1 (got {n_shards})")
+    shape = tuple(int(s) for s in shape)
+    size = int(np.prod(shape)) if shape else 1
+    padded = ((size + n_shards - 1) // n_shards) * n_shards
+    return ZeroLeaf(shape=shape, size=size, padded=padded,
+                    local=padded // n_shards)
+
+
+def zero_plan(tree: Any, n_shards: int) -> Any:
+    """Per-leaf update-sharding plan: map every array-like leaf of
+    `tree` (params, or anything shaped like them) to its ZeroLeaf."""
+    return jax.tree_util.tree_map(
+        lambda a: zero_leaf(np.shape(a), n_shards), tree)
+
+
+def zero_flatten(a, leaf: ZeroLeaf):
+    """Traced: leaf -> (padded,) flat vector (the remainder rule's pad
+    is zeros, so padded grads/updates contribute nothing)."""
+    import jax.numpy as jnp
+    flat = jnp.reshape(a, (-1,))
+    if leaf.padded != leaf.size:
+        flat = jnp.pad(flat, (0, leaf.padded - leaf.size))
+    return flat
+
+
+def zero_unflatten(flat, leaf: ZeroLeaf):
+    """Traced: (padded,) flat vector -> original leaf shape (drops the
+    pad)."""
+    return flat[:leaf.size].reshape(leaf.shape)
 
 
 def mesh_shape(n_devices: int, model: int = 1, seq: int = 1,

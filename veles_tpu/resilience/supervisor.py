@@ -52,12 +52,15 @@ from veles_tpu.snapshotter import Snapshotter
 # -- heartbeat protocol (writer side lives in the Launcher) -------------------
 
 def write_heartbeat(path: str, epoch: int,
-                    feed: Optional[Dict[str, Any]] = None) -> None:
+                    feed: Optional[Dict[str, Any]] = None,
+                    mem: Optional[Dict[str, Any]] = None) -> None:
     """Atomically publish liveness + the epoch counter. Atomic so a
     supervisor read never sees a torn file; the file's mtime is the
     liveness signal, the payload is the progress signal. `feed` is the
-    child's device-feed overlap counter dict (loader/device_feed.py) —
-    the supervisor surfaces the last one in its JSON exit report."""
+    child's device-feed overlap counter dict (loader/device_feed.py),
+    `mem` the child's per-device memory snapshot
+    (parallel/memstats.py) — the supervisor surfaces the last of each
+    in its JSON exit report."""
     tmp = f"{path}.{os.getpid()}.tmp"
     payload: Dict[str, Any] = {"epoch": int(epoch), "ts": time.time()}
     if feed:
@@ -65,6 +68,8 @@ def write_heartbeat(path: str, epoch: int,
         # poll interval and only the totals matter to the supervisor
         payload["feed"] = {k: v for k, v in feed.items()
                            if k != "epoch_log"}
+    if mem:
+        payload["mem"] = mem
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
@@ -77,8 +82,9 @@ def read_heartbeat(path: str) -> Dict[str, Any]:
             data = json.load(f)
         out = {"epoch": int(data.get("epoch", -1)),
                "ts": float(data.get("ts", 0.0))}
-        if isinstance(data.get("feed"), dict):
-            out["feed"] = data["feed"]
+        for extra in ("feed", "mem"):
+            if isinstance(data.get(extra), dict):
+                out[extra] = data[extra]
         return out
     except (OSError, ValueError):
         return {"epoch": -1, "ts": 0.0}
@@ -242,6 +248,13 @@ class Supervisor(Logger):
             feed = next((h["feed"] for h in hbs if h.get("feed")), None)
             if feed is not None:
                 attempt["feed"] = feed
+            # ditto the per-device memory snapshot (parallel/memstats.py
+            # via the same Launcher epoch hook): the report shows the
+            # measured footprint — e.g. the ZeRO optimizer-state delta —
+            # of the child that actually ran
+            mem = next((h["mem"] for h in hbs if h.get("mem")), None)
+            if mem is not None:
+                attempt["mem"] = mem
             self.attempts.append(attempt)
             if reason == "ok":
                 return self._finish(0, "completed")
@@ -360,12 +373,19 @@ class Supervisor(Logger):
         if self.report_path:
             report_obj = {"outcome": outcome, "exit_code": code,
                           "attempts": self.attempts}
-            # the newest attempt's device-feed counters, promoted to the
-            # top level (the scheduler-facing input-pipeline health view)
-            for a in reversed(self.attempts):
-                if a.get("feed"):
-                    report_obj["feed"] = a["feed"]
-                    break
+            # the newest AVAILABLE device-feed counters and per-device
+            # memory snapshot, promoted to the top level (the scheduler-
+            # facing input-pipeline health + memory footprint view).
+            # Each carries "from_attempt": the two can come from
+            # DIFFERENT attempts (a final attempt may die before its
+            # first mem-carrying beat), and a reader must not attribute
+            # a stale snapshot to the final attempt's configuration
+            for key in ("feed", "mem"):
+                for a in reversed(self.attempts):
+                    if a.get(key):
+                        report_obj[key] = dict(a[key])
+                        report_obj[key]["from_attempt"] = a.get("attempt")
+                        break
             try:
                 # which op lowerings the run was configured to trace.
                 # PROVENANCE: this is the supervisor process's view

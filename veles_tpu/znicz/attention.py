@@ -129,7 +129,12 @@ class MultiHeadAttention(Forward):
                              f"{self.parallel_mode!r}")
         y = o.reshape(n, s, h * d) @ params["wo"]
         if model_axis is not None:
-            # row-parallel wo: per-head-group partials sum over model
+            # row-parallel wo: per-head-group partials sum over model.
+            # Justified stray-collective: the unit's own megatron TP
+            # contract (tp_param_specs shards wo's contraction dim) —
+            # the gradient rides this psum's transpose, unplaceable by
+            # the step modules on the unit's behalf
+            # velint: disable=stray-collective
             y = jax.lax.psum(y, model_axis)
         return x + y if self.residual else y
 

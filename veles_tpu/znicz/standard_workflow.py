@@ -281,16 +281,19 @@ class StandardWorkflow(Workflow):
 
     def build_fused_step(self, mesh=None, mode: str = "auto",
                          compute_dtype=None, ep: bool = False,
-                         input_normalize=None):
+                         input_normalize=None, zero_sharding="auto"):
         """Compile the whole forward+backward+update chain into one donated
         XLA step, optionally sharded over `mesh` (data/model axes; ep=True
         additionally shards MoE expert tensors over the data axis).
         `input_normalize` is the uint8-wire prologue spec (see
-        `_wire_spec`). See parallel.fused.FusedTrainStep."""
+        `_wire_spec`); `zero_sharding` gates the ZeRO sharded weight
+        update (on by default in dp mode — CLI `--zero-sharding`). See
+        parallel.fused.FusedTrainStep."""
         from veles_tpu.parallel.fused import FusedTrainStep
         return FusedTrainStep(self, mesh=mesh, mode=mode,
                               compute_dtype=compute_dtype, ep=ep,
-                              input_normalize=input_normalize)
+                              input_normalize=input_normalize,
+                              zero_sharding=zero_sharding)
 
     def autotune(self, mesh=None, compute_dtype=None, **kwargs: Any):
         """Pick the fastest registered lowering for every tunable op this
@@ -345,7 +348,8 @@ class StandardWorkflow(Workflow):
                   accum_steps: Optional[int] = None,
                   nonfinite_guard: bool = False,
                   uint8_wire="auto",
-                  feed_ahead: Optional[int] = None) -> None:
+                  feed_ahead: Optional[int] = None,
+                  zero_sharding="auto") -> None:
         """Train with the fused step while keeping the graph semantics:
         the real Loader drives minibatches and the real Decision unit does
         the epoch/stop bookkeeping (so snapshot gating, best-error tracking
@@ -373,7 +377,8 @@ class StandardWorkflow(Workflow):
         wire = self._wire_spec(uint8_wire)
         step = self.build_fused_step(
             mesh=mesh, mode=mode, compute_dtype=compute_dtype, ep=ep,
-            input_normalize=wire["normalize"] if wire else None)
+            input_normalize=wire["normalize"] if wire else None,
+            zero_sharding=zero_sharding)
         self._run_with_step(step, accum_steps=accum_steps,
                             nonfinite_guard=nonfinite_guard,
                             wire=wire, feed_ahead=feed_ahead)

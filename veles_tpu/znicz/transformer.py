@@ -162,7 +162,12 @@ class SeqFFN(Forward):
                               x @ params["weights"] + params["bias"])
         y = hmid @ params["w2"]
         if model_axis is not None:
-            # row-parallel W2: partial products sum over the model axis
+            # row-parallel W2: partial products sum over the model axis.
+            # Justified stray-collective: the psum is this unit's OWN
+            # megatron contract (tp_param_specs shards w2's contraction
+            # dim) — its gradient arrives through this psum's transpose,
+            # which the step modules cannot place on the unit's behalf
+            # velint: disable=stray-collective
             y = lax.psum(y, model_axis)
         return x + y + params["b2"]
 
