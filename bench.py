@@ -87,6 +87,71 @@ def _mem_record():
         return {"error": str(e)[:200]}
 
 
+def _telemetry_overhead(step_time_s: float) -> dict:
+    """Measured tracing-on vs tracing-off A/B: the record proves what
+    --trace costs relative to THIS run's measured step time. `on` times
+    real begin/end span pairs into a live ring buffer; `off` times the
+    disabled-path guard the driver actually runs when no tracer is
+    installed (pre-bound handle, None check). The driver loop emits at
+    most 8 span pairs per training step (feed.next, dispatch, the
+    in-flight window, decision, prefetch + the produce trio), so
+    overhead_frac = 8 x (on - off) / step_time — the <1% tracing
+    budget, asserted by a slow-marker test. Guarded like the other
+    accounting: telemetry must never cost the measured value."""
+    try:
+        from veles_tpu.telemetry.tracer import Tracer
+        n = 2000
+        tr = Tracer(capacity=4096)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tok = tr.begin("bench.overhead", "bench")
+            tr.end(tok)
+        on_s = (time.perf_counter() - t0) / n
+        off_tr = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if off_tr is not None:
+                tok = off_tr.begin("bench.overhead", "bench")
+                off_tr.end(tok)
+        off_s = (time.perf_counter() - t0) / n
+        spans_per_step = 8
+        per_step_s = spans_per_step * max(0.0, on_s - off_s)
+        return {
+            "span_pair_us": round(on_s * 1e6, 3),
+            "disabled_guard_us": round(off_s * 1e6, 4),
+            "spans_per_step": spans_per_step,
+            "overhead_frac": (round(per_step_s / step_time_s, 6)
+                              if step_time_s > 0 else None),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
+def _mirror_bench_metrics(n_steps: int, step_time_s: float,
+                          n_examples: float, feed=None) -> None:
+    """Route the bench child's measured numbers through the ONE
+    telemetry registry and mirror the flush to the JSONL sink next to
+    the record file — the same producer every /metrics endpoint
+    scrapes, so 'the bench number' and 'the scraped number' cannot
+    diverge. Guarded: accounting never costs the measured value."""
+    try:
+        from veles_tpu.telemetry import metrics as tmetrics
+        reg = tmetrics.default_registry()
+        reg.counter("veles_step_total").inc(n_steps)
+        hist = reg.histogram("veles_step_seconds")
+        for _ in range(min(n_steps, 256)):  # bounded mirror of the
+            hist.observe(step_time_s)       # measured per-step time
+        reg.counter("veles_examples_total").inc(n_examples)
+        if step_time_s > 0:
+            reg.gauge("veles_examples_per_second").set(
+                n_examples / (n_steps * step_time_s))
+        tmetrics.mirror_feed(feed)
+        tmetrics.install_jsonl(RECORD_PATH + ".telemetry.jsonl")
+        tmetrics.flush_installed(extra={"source": "bench"})
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _audit_record(step, x_shape, y_shape=None, state=None) -> dict:
     """Jaxpr-audit summary (analysis/trace.py) embedded in the record
     next to `variants`: the measured number ships with the auditor's
@@ -274,6 +339,9 @@ def child_main() -> None:
 
     value = float(np.median(rates))
     per_chip = value / n_chips
+    step_time_s = batch / value
+    _mirror_bench_metrics(WINDOWS * STEPS_PER_WINDOW, step_time_s,
+                          float(batch) * WINDOWS * STEPS_PER_WINDOW)
     tflops = per_chip * train_flops / 1e12
     kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(kind)
@@ -317,6 +385,9 @@ def child_main() -> None:
         # per-device memory under the measured config (memstats): the
         # ZeRO optimizer-state delta is a recorded number, not a claim
         "device_memory": _mem_record(),
+        # the measured price of --trace relative to THIS step time
+        # (the <1% tracing budget, A/B on/off)
+        "telemetry": _telemetry_overhead(step_time_s),
         "train_gflops_per_sample": round(train_flops / 1e9, 3),
         "fwd_layer_gflops_per_sample": layer_gflops,
         "scaling_prediction_v5e64": scaling_rec,
@@ -429,6 +500,9 @@ def e2e_child_main() -> None:
     value = float(np.median(rates))
     feed_stats = feed.stats()
     feed.stop()   # also stops the loader's produce threads
+    _mirror_bench_metrics(WINDOWS * STEPS_PER_WINDOW, batch / value,
+                          float(batch) * WINDOWS * STEPS_PER_WINDOW,
+                          feed=feed_stats)
     rec = {
         "metric": "alexnet_e2e_samples_per_sec_per_chip",
         "value": round(value, 2),
@@ -444,6 +518,7 @@ def e2e_child_main() -> None:
         # the shared feed's overlap counters: bytes/batch (uint8 wire =
         # f32/4), time blocked on loader vs device, lookahead health
         "feed": feed_stats,
+        "telemetry": _telemetry_overhead(batch / value),
         "variants": step.variant_table(),
         "device_memory": _mem_record(),
         "device_kind": jax.devices()[0].device_kind,
@@ -554,7 +629,7 @@ RECORD_PATH = os.environ.get("BENCH_RECORD_PATH") or os.path.join(
 #: full-record keys the compact stdout line keeps verbatim
 _COMPACT_KEYS = ("metric", "value", "unit", "vs_baseline", "mfu",
                  "device_kind", "n_chips", "batch_per_chip", "variants",
-                 "degraded", "provisional", "attempts")
+                 "telemetry", "degraded", "provisional", "attempts")
 
 
 def _compact(rec, record_path) -> dict:

@@ -581,6 +581,62 @@ def test_velint_sync_feed_clean_cases():
     assert lint.lint_source(src2) == []
 
 
+def test_velint_hot_metric_lookup_in_hot_path():
+    """hot-metric (telemetry/metrics.py contract): a per-record
+    registry name lookup inside a unit run(), or a chained record on a
+    freshly looked-up handle, must pre-bind instead."""
+    src = (
+        "class U:\n"
+        "    def run(self):\n"
+        "        self.reg.counter('veles_step_total').inc()\n"
+        "        h = metrics.histogram('veles_step_seconds')\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["hot-metric"] * 2
+    assert sorted(f.line for f in findings) == [3, 4]
+
+
+def test_velint_hot_metric_record_inside_traced_fn():
+    """Even a PRE-BOUND record inside a traced function is a bug: it
+    fires once at trace time and freezes out of the compiled step."""
+    src = (
+        "import jax\n"
+        "class U:\n"
+        "    def fused_apply(self, x):\n"
+        "        self._m_steps.inc()\n"
+        "        self._m_hist.observe(0.5)\n"
+        "        return x\n"
+        "def build(f):\n"
+        "    def traced(x):\n"
+        "        m.set_total(3)\n"
+        "        return x\n"
+        "    return jax.jit(traced)\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["hot-metric"] * 3
+    assert sorted(f.line for f in findings) == [4, 5, 9]
+
+
+def test_velint_hot_metric_clean_cases():
+    """Pre-bound records in the DRIVER (not a run()/traced scope) and
+    registration at init time are the blessed idioms; np.histogram with
+    a non-string first arg never matches the lookup pattern."""
+    src = (
+        "import numpy as np\n"
+        "class W:\n"
+        "    def __init__(self, reg):\n"
+        "        self._m = reg.counter('veles_step_total')\n"
+        "    def _drive(self):\n"
+        "        while True:\n"
+        "            self._m.inc()\n"
+        "class U:\n"
+        "    def run(self):\n"
+        "        h, e = np.histogram(self.input, 10)\n"
+        "        self._m_steps.inc()\n"      # pre-bound in a hot path:
+    )                                        # allowed — no lookup
+    assert lint.lint_source(src) == []
+
+
 def test_velint_suppression_same_line_and_line_above():
     src = (
         "import numpy as np\n"

@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -98,3 +100,40 @@ def test_success_record_names_variants_and_merges_e2e(tmp_path):
     assert feed["uint8_wire"] is True
     assert feed["bytes_per_batch"] > 0 and feed["batches"] > 0
     assert full["fwd_layer_gflops_per_sample"]   # bulk stays in the file
+    # ISSUE 7 satellite: the compact line carries the measured
+    # tracing-overhead A/B, and the JSONL telemetry sink mirrors the
+    # flush next to the record file
+    assert "telemetry" in rec and "overhead_frac" in rec["telemetry"]
+    jsonl = env["BENCH_RECORD_PATH"] + ".telemetry.jsonl"
+    assert os.path.exists(jsonl)
+    row = json.loads(open(jsonl).readline())
+    assert row["metrics"]["veles_step_total"] > 0
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_under_one_percent(tmp_path):
+    """ISSUE 7 acceptance: measured tracing overhead < 1% of step time,
+    A/B asserted. The bench child records span_pair cost with a LIVE
+    tracer vs the disabled-path guard and relates 8 spans/step to the
+    measured step time; on any host where a step takes >= ~10 ms (CPU
+    smoke included) the tracer's ~1-2 us span pairs are orders of
+    magnitude under the budget. Slow-marked: runs the real child."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_RECORD_PATH"] = str(tmp_path / "rec.json")
+    env.update(BENCH_BATCH="8", BENCH_STEPS="2", BENCH_WINDOWS="1",
+               BENCH_WIDTH="0.125", BENCH_HW="67", BENCH_CHILD="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-1000:]
+    rec = json.loads([ln for ln in out.stdout.splitlines()
+                      if ln.strip()][-1])
+    tele = rec["telemetry"]
+    assert tele["spans_per_step"] == 8
+    assert tele["span_pair_us"] > 0
+    # the A/B: tracing-on span cost vs the tracing-off guard, relative
+    # to THIS run's measured step time
+    assert tele["overhead_frac"] is not None
+    assert tele["overhead_frac"] < 0.01, tele

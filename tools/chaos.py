@@ -262,6 +262,40 @@ def run_scenario(name: str, plan: str, extra, verbose: bool) -> dict:
             "elapsed": elapsed}
 
 
+def _route_telemetry(rows, cluster: bool) -> None:
+    """Route the matrix outcome through the ONE telemetry registry
+    (telemetry/metrics.py): scenario pass/fail counts and the restarts
+    the scenarios actually consumed land in the same
+    `veles_restart_total` family the supervisor and the coordinator's
+    /metrics expose — and VELES_METRICS_JSONL (if set) mirrors the
+    flush next to the matrix output. Guarded: telemetry must never
+    flip a recovery verdict."""
+    try:
+        if REPO not in sys.path:       # run as `python tools/chaos.py`:
+            sys.path.insert(0, REPO)   # sys.path[0] is tools/, not the repo
+        from veles_tpu.telemetry import metrics as tmetrics
+        jsonl = os.environ.get("VELES_METRICS_JSONL")
+        if jsonl:
+            tmetrics.install_jsonl(jsonl)
+        reg = tmetrics.default_registry()
+        outcomes = reg.counter(
+            "veles_chaos_scenarios_total",
+            "chaos scenarios by result", labelnames=("result",))
+        restarts = 0
+        for _name, _plan, r in rows:
+            outcomes.labels(
+                result="pass" if r["ok"] else "fail").inc()
+            n = r.get("restarts") if cluster else r.get("attempts")
+            if isinstance(n, int):
+                restarts += max(0, n - (0 if cluster else 1))
+        reg.counter("veles_restart_total").inc(restarts)
+        tmetrics.flush_installed(extra={
+            "source": "chaos",
+            "matrix": "cluster" if cluster else "single-host"})
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", default="",
@@ -311,6 +345,7 @@ def main() -> int:
                   f"{','.join(r['dead_hosts'] or []) or '-':<8} "
                   f"{r['elapsed']:<6.1f}")
         print()
+        _route_telemetry(rows, cluster=True)
         if failed:
             print(f"{failed} cluster scenario(s) did NOT recover",
                   file=sys.stderr)
@@ -340,6 +375,7 @@ def main() -> int:
               f"{r['final_epoch'] or '-':<7} {r['attempts'] or '-':<9} "
               f"{r['elapsed']:<6.1f}")
     print()
+    _route_telemetry(rows, cluster=False)
     if failed:
         print(f"{failed} scenario(s) did NOT recover", file=sys.stderr)
         return 1

@@ -78,6 +78,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "python -m veles_tpu.manhole <port>")
     p.add_argument("-p", "--profile", default="", metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
+    p.add_argument("--trace", default="", metavar="PATH",
+                   help="step-timeline tracing (docs/OBSERVABILITY.md): "
+                        "record driver-loop spans (feed pops, async "
+                        "dispatch, the in-flight device window, "
+                        "Decision/snapshot bookkeeping, the next "
+                        "batch's device_put) into a bounded ring "
+                        "buffer and write a Chrome-trace/Perfetto-"
+                        "loadable trace.json to PATH at the end of the "
+                        "run; a metrics JSONL sink mirrors every flush "
+                        "to PATH.metrics.jsonl. Consumed by --fused/"
+                        "--pp/-l/-m runs and --serve")
+    p.add_argument("--profile-window", default="", metavar="N:M",
+                   help="bracket driver steps N..M (inclusive) with "
+                        "jax.profiler start/stop — an on-chip capture "
+                        "window instead of profiling the whole run "
+                        "(-p DIR sets the output directory; default "
+                        "telemetry_profile/). A live run can also be "
+                        "captured via POST /profile on the web-status "
+                        "control plane. Combine with --fused/--pp/-l/-m")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
     p.add_argument("--verify-workflow", nargs="?", const="graph",
@@ -409,7 +428,8 @@ def main(argv=None) -> int:
         nonfinite_guard=args.nonfinite_guard,
         verify_workflow=args.verify_workflow or "",
         mirror=args.mirror, feed_ahead=args.feed_ahead,
-        zero_sharding=args.zero_sharding)
+        zero_sharding=args.zero_sharding,
+        trace=args.trace, profile_window=args.profile_window)
     if args.verify_workflow:
         # takes precedence over every execution mode (incl. --optimize,
         # which otherwise bypasses Launcher.main entirely): the flag

@@ -71,7 +71,19 @@ RULES: Dict[str, str] = {
                         "all_gather/psum_scatter) outside ops/variants "
                         "(the registry) or the fused/pipeline step "
                         "modules",
+    "hot-metric": "metric record in a unit run()/traced function that "
+                  "is not a pre-bound handle (registry name lookup per "
+                  "record, or any record inside a traced fn — it fires "
+                  "once at trace time)",
 }
+
+#: registry lookup method names (telemetry/metrics.py): calling one
+#: with a string name per record re-resolves the family in the hot path
+_METRIC_LOOKUPS = ("counter", "gauge", "histogram")
+
+#: record method names on metric handles; `.set` is deliberately NOT
+#: here (too generic — Bool gates, ordinary setters)
+_METRIC_RECORDS = ("inc", "observe", "set_total")
 
 #: collective primitives the stray-collective rule watches
 _COLLECTIVE_NAMES = ("psum", "pmean", "all_gather", "psum_scatter")
@@ -329,6 +341,40 @@ class _Linter(ast.NodeVisitor):
                            "np.asarray in a unit hot path forces a "
                            "device->host transfer: keep results "
                            "device-side (set_devmem) until a boundary")
+
+        # hot-metric (telemetry/metrics.py contract): in the per-
+        # minibatch hot path a metric record must go through a handle
+        # PRE-BOUND outside the method (step_handles()), never a
+        # per-record registry name lookup; inside a TRACED function
+        # even a pre-bound record is a bug — it fires once at trace
+        # time and the jaxpr never records again
+        if self._hot_depth or self._traced_depth:
+            if leaf in _METRIC_LOOKUPS and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and isinstance(node.func, ast.Attribute):
+                self._emit(node, "hot-metric",
+                           f"`{chain or leaf}({node.args[0].value!r})`"
+                           " resolves a metric family by name per "
+                           "record in a hot/traced path: pre-bind the "
+                           "handle outside (metrics.step_handles() is "
+                           "the driver precedent)")
+            elif leaf in _METRIC_RECORDS \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Call):
+                self._emit(node, "hot-metric",
+                           f"chained metric record `...{leaf}()` on a "
+                           "freshly looked-up handle in a hot/traced "
+                           "path: pre-bind the handle outside the "
+                           "method")
+        if self._traced_depth and leaf in _METRIC_RECORDS \
+                and isinstance(node.func, ast.Attribute) \
+                and not isinstance(node.func.value, ast.Call):
+            self._emit(node, "hot-metric",
+                       f"metric record `{chain or leaf}()` inside a "
+                       "TRACED function runs ONCE at trace time and "
+                       "freezes out of the compiled step: record at "
+                       "the driver/class-pass boundary instead")
 
         if self._driver_depth:
             if chain == "jax.device_get" \

@@ -46,6 +46,8 @@ class Launcher(Logger):
                  mirror: str = "",
                  feed_ahead: Optional[int] = None,
                  zero_sharding: str = "auto",
+                 trace: str = "",
+                 profile_window: str = "",
                  **kwargs: Any) -> None:
         super().__init__()
         self.snapshot_path = snapshot
@@ -195,6 +197,35 @@ class Launcher(Logger):
                              "update: combine with --fused, --pp or a "
                              "distributed -l/-m run")
         self.zero_sharding = zero_sharding
+        #: step-timeline tracing (telemetry/tracer.py): record driver
+        #: spans into the ring buffer and export a Perfetto-loadable
+        #: trace.json here at the end of the run. Only the fused/
+        #: pipelined driver loop (and the serving dispatch path) emit
+        #: spans — same validation precedent as --feed-ahead: silently
+        #: ignoring the flag would let an operator believe a trace is
+        #: being captured.
+        if trace and not (fused or pp or listen or master
+                          or serve is not None):
+            raise SystemExit(
+                "--trace records the fused/pipelined driver loop (or "
+                "the serving dispatch path): combine with --fused, "
+                "--pp, a distributed -l/-m run or --serve")
+        self.trace_path = trace
+        #: --profile-window N:M — bracket driver steps N..M with
+        #: jax.profiler start/stop (the on-chip capture path); only the
+        #: stepped training drivers consume it
+        if profile_window:
+            from veles_tpu.telemetry.tracer import ProfileController
+            try:
+                ProfileController.parse_spec(profile_window)
+            except ValueError as e:
+                raise SystemExit(f"--profile-window: {e}")
+            if not (fused or pp or listen or master):
+                raise SystemExit(
+                    "--profile-window brackets training steps of the "
+                    "fused/pipelined drivers: combine with --fused, "
+                    "--pp or a distributed -l/-m run")
+        self.profile_window = profile_window
         #: opt-out for the persistent XLA compile cache (the cache is
         #: also auto-skipped on axon backends — see
         #: enable_compilation_cache)
@@ -333,6 +364,25 @@ class Launcher(Logger):
             raise RuntimeError("Launcher.main() before load()")
         if self.verify_workflow:
             return self._run_verify()
+        # telemetry plane (docs/OBSERVABILITY.md): install the tracer
+        # BEFORE any step/server construction so every pre-bound
+        # tracer handle captures it; the metrics JSONL sink rides the
+        # trace flag (trace.json.metrics.jsonl) or VELES_METRICS_JSONL
+        from veles_tpu.telemetry import metrics as _tmetrics
+        from veles_tpu.telemetry import tracer as _ttracer
+        tracer_obj = None
+        if self.trace_path:
+            tracer_obj = _ttracer.install()
+        jsonl_path = (os.environ.get("VELES_METRICS_JSONL")
+                      or (self.trace_path + ".metrics.jsonl"
+                          if self.trace_path else ""))
+        if jsonl_path:
+            _tmetrics.install_jsonl(jsonl_path)
+        if self.profile_window:
+            ctl = _ttracer.profile_controller()
+            start, stop = ctl.parse_spec(self.profile_window)
+            ctl.arm(start, stop,
+                    self.profile_dir or ctl._default_dir())
         if self.compile_cache:
             self.enable_compilation_cache()
         self.boot_distributed()
@@ -371,9 +421,15 @@ class Launcher(Logger):
                 # loopback-only
                 host = ("127.0.0.1" if self.mode == "standalone"
                         else "0.0.0.0")
-                self._web = WebStatusServer(self.workflow, host=host,
-                                            port=self.web_port,
-                                            token=token)
+                self._web = WebStatusServer(
+                    self.workflow, host=host, port=self.web_port,
+                    token=token,
+                    # POST /profile arms an on-chip capture window on
+                    # the live driver (telemetry/tracer.py); serve-only
+                    # runs have no stepped driver to bracket
+                    profile_controller=(
+                        _ttracer.profile_controller()
+                        if self.serve_port is None else None))
                 self._web.start()
             else:
                 # workers report into the coordinator's cluster view
@@ -382,7 +438,7 @@ class Launcher(Logger):
                 host = (self.master or self.listen).rsplit(":", 1)[0]
                 self._web = HeartbeatReporter(
                     host, self.web_port, self.process_id,
-                    token=token).start()
+                    token=token, workflow=self.workflow).start()
         if self.manhole_port is not None:
             from veles_tpu.manhole import ManholeServer
             self._manhole = ManholeServer(self.workflow,
@@ -423,14 +479,26 @@ class Launcher(Logger):
                     mem = device_memory_stats()
                 except Exception:  # noqa: BLE001 — stats never kill a beat
                     mem = None
-                write_heartbeat(hb_path, epoch, feed=feed, mem=mem)
+                try:
+                    # the one-registry snapshot rides the beat too, so
+                    # the supervisor/cluster exit reports and the
+                    # coordinator's fleet /metrics see the child's step
+                    # counters without instrumenting the child further
+                    from veles_tpu.telemetry.metrics import snapshot_flat
+                    msnap = snapshot_flat()
+                except Exception:  # noqa: BLE001
+                    msnap = None
+                write_heartbeat(hb_path, epoch, feed=feed, mem=mem,
+                                metrics=msnap)
             installed_hooks.append(_rhooks.add_epoch_hook(_hb))
         plan = _faults.active_plan()
         if plan is not None:
             self.warning("fault plan active: %s", plan)
             installed_hooks.append(_rhooks.add_epoch_hook(plan.on_epoch))
         profiling = False
-        if self.profile_dir:
+        if self.profile_dir and not self.profile_window:
+            # whole-run profiler trace; with --profile-window the dir
+            # instead receives the windowed captures (telemetry/tracer)
             import jax
             jax.profiler.start_trace(self.profile_dir)
             profiling = True
@@ -606,6 +674,27 @@ class Launcher(Logger):
                 import jax
                 jax.profiler.stop_trace()
                 self.info("profiler trace -> %s", self.profile_dir)
+            # close a window the run ended inside of — ALWAYS, not
+            # only under --profile-window: POST /profile arms windows
+            # on runs launched without the flag, and an interrupt
+            # mid-window must still flush the capture (no-op when
+            # nothing is armed)
+            _ttracer.profile_controller().finalize()
+            if tracer_obj is not None:
+                try:
+                    tracer_obj.export(self.trace_path)
+                    self.info("step timeline -> %s (%d span(s), %d "
+                              "dropped)", self.trace_path,
+                              tracer_obj._n, tracer_obj.dropped)
+                except OSError as e:
+                    self.warning("trace export failed: %s", e)
+                _ttracer.uninstall()
+            # final metrics flush so short runs land at least one
+            # JSONL row (guarded: report cosmetics never mask errors)
+            try:
+                _tmetrics.flush_installed(extra={"source": "exit"})
+            except Exception:  # noqa: BLE001
+                pass
             if self._web is not None:
                 self._web.stop()
             if self._manhole is not None:

@@ -69,6 +69,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from veles_tpu.loader.base import TRAIN
+from veles_tpu.telemetry import tracer as _tracer
 
 #: how many trailing per-epoch counter rows stats() keeps
 _EPOCH_LOG_KEEP = 8
@@ -173,6 +174,9 @@ class DeviceFeed:
         self._pending_roll = False
         self._epoch_log: List[Dict[str, Any]] = []
         self._last_dtype = None
+        #: pre-bound tracer handle (None = tracing off): the hot path
+        #: pays one attribute load + None check per produce
+        self._tr = _tracer.active()
 
     @classmethod
     def for_step(cls, loader, step, ahead: int = 1) -> "DeviceFeed":
@@ -208,6 +212,14 @@ class DeviceFeed:
         else:
             b.x, b.y, b.w = x, y, w
         t2 = time.perf_counter()
+        tr = self._tr
+        if tr is not None:
+            # the trace's overlap evidence: this device_put span lies
+            # inside the driver's in-flight "step" span when batch k+1
+            # transfers under step k's executing compute
+            tr.add_span("loader.run", "feed", t0, t1)
+            tr.add_span("feed.device_put", "feed", t1, t2)
+            tr.add_span("feed.produce", "feed", t0, t2)
         b.loader_block_s = t1 - t0
         self._loader_block_s += t1 - t0
         self._put_block_s += t2 - t1

@@ -297,6 +297,135 @@ class ClusterCoordinator(Logger):
                         time.monotonic() - h["last_beat"], 3)}
                     for hid, h in sorted(self._hosts.items())}}
 
+    def metrics_exposition(self) -> str:
+        """Fleet-aggregated Prometheus exposition, built fresh per
+        scrape from the member heartbeats (no stale per-host children
+        survive a membership change): the coordinator's own
+        restart/generation counters, counters SUMMED across hosts from
+        each child's forwarded registry snapshot, gauges labeled per
+        host, and the feed/mem heartbeat payloads as fallback
+        producers for jax-free or pre-telemetry children."""
+        from veles_tpu.telemetry import metrics as tmetrics
+        reg = tmetrics.MetricsRegistry()
+        # the presence contract (step/feed/mem/restart families on
+        # every scrape endpoint), declared fleet-shaped: counters sum
+        # across hosts (unlabeled), per-host gauges carry a host label
+        # — so a child gauge name can never collide with an unlabeled
+        # standard registration
+        for name, h in (
+                ("veles_step_total", "training steps (fleet sum)"),
+                ("veles_examples_total",
+                 "training examples (fleet sum)"),
+                ("veles_feed_h2d_bytes_total",
+                 "feed H2D bytes (fleet sum)"),
+                ("veles_feed_loader_block_seconds_total",
+                 "loader-blocked seconds (fleet sum)"),
+                ("veles_feed_device_sync_seconds_total",
+                 "device-sync seconds (fleet sum)"),
+                ("veles_feed_on_demand_total",
+                 "on-demand feed pops (fleet sum)"),
+                ("veles_restart_total", "cluster gang restarts")):
+            reg.counter(name, h)
+        reg.histogram("veles_step_seconds",
+                      "per-step wall time (fleet totals; bucket "
+                      "detail lives on each host's own scrape)")
+        reg.gauge("veles_mem_live_bytes",
+                  "newest live-bytes-max per host",
+                  labelnames=("device",))
+        reg.gauge("veles_mem_live_bytes_max",
+                  "live bytes on the fleet's fullest host")
+        #: child gauges the coordinator itself owns fleet-wide — never
+        #: re-exposed per host
+        reserved = {"veles_generation", "veles_mem_live_bytes_max",
+                    "veles_restart_total"}
+        with self._lock:
+            reg.counter("veles_restart_total").set_total(self.restarts)
+            reg.gauge("veles_generation").set(float(self.generation))
+            reg.gauge("veles_cluster_hosts",
+                      "hosts that ever reported").set(
+                float(len(self._hosts)))
+            reg.gauge("veles_cluster_dead_hosts",
+                      "hosts declared dead").set(
+                float(len(self.dead_hosts)))
+            epoch_g = reg.gauge("veles_cluster_host_epoch",
+                                "newest child epoch per host",
+                                labelnames=("host",))
+            sums: Dict[str, float] = {}
+            for hid, h in sorted(self._hosts.items()):
+                rep = h["report"]
+                epoch = rep.get("epoch")
+                epoch_g.labels(host=hid).set(
+                    float(epoch) if isinstance(epoch, (int, float))
+                    and not isinstance(epoch, bool) else -1.0)
+                msnap = rep.get("metrics")
+                if isinstance(msnap, dict):
+                    for k, v in msnap.items():
+                        if not isinstance(v, (int, float)) \
+                                or isinstance(v, bool):
+                            continue
+                        if k.endswith(("_total", "_sum", "_count")):
+                            sums[k] = sums.get(k, 0.0) + float(v)
+                        elif k not in reserved \
+                                and tmetrics._NAME_RE.match(str(k)):
+                            try:
+                                reg.gauge(k, labelnames=("host",)) \
+                                    .labels(host=hid).set(float(v))
+                            except ValueError:
+                                continue   # shape collision: skip the
+                                # child key, never the whole scrape
+                elif isinstance(rep.get("feed"), dict):
+                    # pre-telemetry child on THIS host (mixed fleet
+                    # during a rolling upgrade): derive its feed family
+                    # from the raw heartbeat feed dict instead — per
+                    # host, never BOTH, since a child snapshot already
+                    # mirrors its own feed counters
+                    feed = rep["feed"]
+                    for src, dst in (
+                            ("bytes_h2d", "veles_feed_h2d_bytes_total"),
+                            ("loader_block_s",
+                             "veles_feed_loader_block_seconds_total"),
+                            ("device_sync_s",
+                             "veles_feed_device_sync_seconds_total"),
+                            ("on_demand",
+                             "veles_feed_on_demand_total")):
+                        v = feed.get(src)
+                        if isinstance(v, (int, float)) \
+                                and not isinstance(v, bool):
+                            sums[dst] = sums.get(dst, 0.0) + float(v)
+                mem = rep.get("mem")
+                if isinstance(mem, dict):
+                    reg.gauge("veles_mem_live_bytes",
+                              labelnames=("device",)).labels(
+                        device=f"host{hid}").set(
+                        float(mem.get("live_bytes_max", 0) or 0))
+            mem_max = max(
+                (float((h["report"].get("mem") or {})
+                       .get("live_bytes_max", 0) or 0)
+                 for h in self._hosts.values()), default=0.0)
+            reg.gauge("veles_mem_live_bytes_max").set(mem_max)
+        hist: Dict[str, Dict[str, float]] = {}
+        for name, total in sorted(sums.items()):
+            if name.endswith("_sum"):
+                hist.setdefault(name[:-4], {})["sum"] = total
+            elif name.endswith("_count"):
+                hist.setdefault(name[:-6], {})["count"] = total
+            elif tmetrics._NAME_RE.match(name):
+                try:
+                    reg.counter(name).set_total(total)
+                except ValueError:
+                    continue    # a child key colliding with a gauge
+        for base, legs in hist.items():
+            # flattened child histograms fold back into the histogram
+            # family (bucket detail stays with the child's own scrape)
+            if not tmetrics._NAME_RE.match(base):
+                continue
+            try:
+                reg.histogram(base).set_histogram_totals(
+                    legs.get("sum", 0.0), legs.get("count", 0.0))
+            except (ValueError, TypeError):
+                continue
+        return reg.exposition()
+
     # -- HTTP transport -------------------------------------------------------
 
     def start(self) -> "ClusterCoordinator":
@@ -339,7 +468,21 @@ class ClusterCoordinator(Logger):
                 self.end_headers()
                 self.wfile.write(body)
 
-            def do_GET(self):  # noqa: N802 — observability endpoint
+            def do_GET(self):  # noqa: N802 — observability endpoints
+                if self.path.startswith("/metrics"):
+                    # fleet-aggregated Prometheus exposition (one scrape
+                    # for the whole cluster), token-guarded like /status
+                    # — the control plane binds non-loopback
+                    if not check_shared_token(self, token):
+                        return
+                    from veles_tpu.telemetry.metrics import CONTENT_TYPE
+                    body = outer.metrics_exposition().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if not self.path.startswith("/status"):
                     self.send_response(404)
                     self.end_headers()
@@ -641,9 +784,19 @@ class ClusterMember(Logger):
                         (p.poll() for p in self._procs)]
         return "running", codes
 
-    def _epoch(self) -> int:
-        return max((read_heartbeat(p)["epoch"]
-                    for p in self._hb_paths), default=-1)
+    def _child_payload(self) -> Dict[str, Any]:
+        """The children's newest heartbeat payload: epoch plus the
+        feed/mem/metrics telemetry the Launcher's epoch hook writes —
+        forwarded in the cluster beat so the coordinator's /metrics
+        aggregates the fleet from one producer (the child registry)."""
+        hbs = [read_heartbeat(p) for p in self._hb_paths]
+        out: Dict[str, Any] = {
+            "epoch": max((h["epoch"] for h in hbs), default=-1)}
+        for key in ("feed", "mem", "metrics"):
+            v = next((h[key] for h in hbs if h.get(key)), None)
+            if v is not None:
+                out[key] = v
+        return out
 
     # -- control-plane client -------------------------------------------------
 
@@ -668,15 +821,22 @@ class ClusterMember(Logger):
         report = {"host": self.host_id, "generation": self.generation,
                   "status": status,
                   "exit_codes": [c for c in codes],
-                  "epoch": self._epoch(),
                   "snapshots": self._visible_snapshots()}
+        report.update(self._child_payload())
         from veles_tpu.http_util import http_post_json
+        from veles_tpu.telemetry import tracer as _tracer
+        tr = _tracer.active()
+        tok = tr.begin("cluster.beat", "cluster") \
+            if tr is not None else None
         try:
             return http_post_json(self.coord_host, self.coord_port,
                                   "/hb", report, token=self.token,
                                   timeout=max(5.0, self.beat_s * 3))
         except OSError:
             return None
+        finally:
+            if tok is not None:
+                tr.end(tok)
 
     # -- main loop ------------------------------------------------------------
 

@@ -53,14 +53,18 @@ from veles_tpu.snapshotter import Snapshotter
 
 def write_heartbeat(path: str, epoch: int,
                     feed: Optional[Dict[str, Any]] = None,
-                    mem: Optional[Dict[str, Any]] = None) -> None:
+                    mem: Optional[Dict[str, Any]] = None,
+                    metrics: Optional[Dict[str, Any]] = None) -> None:
     """Atomically publish liveness + the epoch counter. Atomic so a
     supervisor read never sees a torn file; the file's mtime is the
     liveness signal, the payload is the progress signal. `feed` is the
     child's device-feed overlap counter dict (loader/device_feed.py),
     `mem` the child's per-device memory snapshot
-    (parallel/memstats.py) — the supervisor surfaces the last of each
-    in its JSON exit report."""
+    (parallel/memstats.py), `metrics` the child's flat telemetry
+    snapshot (telemetry/metrics.py snapshot_flat) — the supervisor
+    surfaces the last of each in its JSON exit report, and the cluster
+    member forwards them so the coordinator's /metrics can aggregate
+    the fleet."""
     tmp = f"{path}.{os.getpid()}.tmp"
     payload: Dict[str, Any] = {"epoch": int(epoch), "ts": time.time()}
     if feed:
@@ -70,6 +74,8 @@ def write_heartbeat(path: str, epoch: int,
                            if k != "epoch_log"}
     if mem:
         payload["mem"] = mem
+    if metrics:
+        payload["metrics"] = metrics
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
@@ -82,7 +88,7 @@ def read_heartbeat(path: str) -> Dict[str, Any]:
             data = json.load(f)
         out = {"epoch": int(data.get("epoch", -1)),
                "ts": float(data.get("ts", 0.0))}
-        for extra in ("feed", "mem"):
+        for extra in ("feed", "mem", "metrics"):
             if isinstance(data.get(extra), dict):
                 out[extra] = data[extra]
         return out
@@ -177,6 +183,18 @@ class Supervisor(Logger):
         self.env = dict(env) if env is not None else dict(os.environ)
         #: optional JSON exit report (attempt log, outcome, final codes)
         self.report_path = report_path
+        # one-registry telemetry (stdlib-only module: the supervisor
+        # stays import-light): restart/generation ride the same
+        # families the coordinator and /metrics endpoints expose, and
+        # VELES_METRICS_JSONL mirrors them for offline analysis
+        from veles_tpu.telemetry import metrics as _tmetrics
+        self._m_restarts = _tmetrics.default_registry().counter(
+            "veles_restart_total")
+        self._m_generation = _tmetrics.default_registry().gauge(
+            "veles_generation")
+        jsonl = os.environ.get("VELES_METRICS_JSONL")
+        if jsonl:
+            _tmetrics.install_jsonl(jsonl)
         #: snapshot mirror spec (resilience/mirror.py): restart snapshot
         #: resolution restores from it when the local dir cannot satisfy
         #: the request (missing/corrupt) — durable-state rejoin
@@ -255,7 +273,14 @@ class Supervisor(Logger):
             mem = next((h["mem"] for h in hbs if h.get("mem")), None)
             if mem is not None:
                 attempt["mem"] = mem
+            # and the child's one-registry snapshot (step counters,
+            # loss, feed totals) — same producer as its /metrics
+            msnap = next((h["metrics"] for h in hbs
+                          if h.get("metrics")), None)
+            if msnap is not None:
+                attempt["metrics"] = msnap
             self.attempts.append(attempt)
+            self._m_generation.set(attempt_no)
             if reason == "ok":
                 return self._finish(0, "completed")
             self.warning("attempt %d failed: %s (exit codes %s, "
@@ -277,6 +302,7 @@ class Supervisor(Logger):
                     f"no epoch progress across {stagnant} consecutive "
                     f"failures (stuck at epoch {best_epoch})")
             restarts += 1
+            self._m_restarts.inc()
             delay = min(self.backoff_base * (2 ** (restarts - 1)),
                         self.backoff_max)
             delay *= 1.0 + self.jitter * random.random()
@@ -380,12 +406,23 @@ class Supervisor(Logger):
             # DIFFERENT attempts (a final attempt may die before its
             # first mem-carrying beat), and a reader must not attribute
             # a stale snapshot to the final attempt's configuration
-            for key in ("feed", "mem"):
+            for key in ("feed", "mem", "metrics"):
                 for a in reversed(self.attempts):
                     if a.get(key):
                         report_obj[key] = dict(a[key])
                         report_obj[key]["from_attempt"] = a.get("attempt")
                         break
+            try:
+                # the supervisor's OWN registry view (restarts,
+                # generation) — one producer with the child's promoted
+                # "metrics" block above; the JSONL sink (if installed)
+                # mirrors the final state too
+                from veles_tpu.telemetry import metrics as _tmetrics
+                report_obj["telemetry"] = _tmetrics.snapshot_flat()
+                _tmetrics.flush_installed(
+                    extra={"source": "supervisor", "outcome": outcome})
+            except Exception:  # noqa: BLE001 — report cosmetics must
+                pass           # never mask the exit path
             try:
                 # which op lowerings the run was configured to trace.
                 # PROVENANCE: this is the supervisor process's view

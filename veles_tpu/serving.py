@@ -99,6 +99,32 @@ class InferenceServer(Logger):
         #: requests shed with 503 (overload + drain) / timed out
         self.n_rejected = 0
         self.n_timeouts = 0
+        # telemetry plane: serving admission/latency ride the ONE
+        # process registry (telemetry/metrics.py) behind GET /metrics;
+        # instruments are pre-bound here (the hot request path never
+        # does a name lookup — the velint hot-metric contract), and the
+        # tracer handle records dispatch spans when --trace is active
+        from veles_tpu.telemetry import metrics as _tmetrics
+        from veles_tpu.telemetry import tracer as _ttracer
+        _reg = _tmetrics.default_registry()
+        self._m_requests = _reg.counter(
+            "veles_serving_requests_total", "predict requests admitted")
+        self._m_rejected = _reg.counter(
+            "veles_serving_rejected_total",
+            "requests shed (overload + drain)")
+        self._m_timeouts = _reg.counter(
+            "veles_serving_timeouts_total",
+            "queued requests that missed request_timeout_s")
+        self._m_dispatches = _reg.counter(
+            "veles_serving_dispatches_total",
+            "forward dispatches issued (coalesced batches)")
+        self._m_inflight = _reg.gauge(
+            "veles_serving_inflight", "requests currently in flight")
+        self._m_latency = _reg.histogram(
+            "veles_serving_latency_seconds",
+            "predict latency (admission to response)",
+            buckets=_tmetrics.LATENCY_BUCKETS)
+        self._tr = _ttracer.active()
         self._build()
 
     def _build(self) -> None:
@@ -150,9 +176,16 @@ class InferenceServer(Logger):
         if pad:
             x = np.concatenate([x, np.zeros((pad,) + self._sample_shape,
                                             np.float32)])
+        tr = self._tr
+        tok = tr.begin("serving.dispatch", "serving") \
+            if tr is not None else None
         with self._lock:
             self.n_dispatches += 1
-            return np.asarray(self._fn(self._state["params"], x))[:n]
+            self._m_dispatches.inc()
+            out = np.asarray(self._fn(self._state["params"], x))[:n]
+        if tok is not None:
+            tr.end(tok)
+        return out
 
     def predict(self, inputs: np.ndarray) -> Dict[str, Any]:
         x = np.asarray(inputs, np.float32)
@@ -164,18 +197,23 @@ class InferenceServer(Logger):
             raise ValueError(f"batch {len(x)} exceeds max_batch "
                              f"{self.max_batch}")
         n = len(x)
+        t_admit = time.perf_counter()
         # bounded admission: reject at the door — a server melting down
         # under a spike must shed load, not grow an unbounded queue
         with self._cv:
             if self._draining or self._stopping:
                 self.n_rejected += 1
+                self._m_rejected.inc()
                 raise ServerDraining("server draining")
             if self._inflight >= self.queue_limit:
                 self.n_rejected += 1
+                self._m_rejected.inc()
                 raise ServerOverloaded(
                     f"overloaded: {self._inflight} requests in flight "
                     f"(queue_limit {self.queue_limit})")
             self._inflight += 1
+            self._m_requests.inc()
+            self._m_inflight.set(self._inflight)
         try:
             if self.batch_window_ms > 0 and self._batcher is not None:
                 out = self._predict_batched(x)
@@ -184,7 +222,9 @@ class InferenceServer(Logger):
         finally:
             with self._cv:
                 self._inflight -= 1
+                self._m_inflight.set(self._inflight)
                 self._cv.notify_all()   # drain waiters watch this count
+            self._m_latency.observe(time.perf_counter() - t_admit)
         out = out.reshape(n, -1)
         resp: Dict[str, Any] = {"outputs": out.tolist()}
         if self._softmax:
@@ -219,6 +259,7 @@ class InferenceServer(Logger):
                     except ValueError:
                         pass    # already taken by the batcher
                     self.n_timeouts += 1
+                    self._m_timeouts.inc()
                     raise RequestTimeout(
                         f"request timed out after {timeout:.1f}s in "
                         f"queue")
@@ -328,6 +369,21 @@ class InferenceServer(Logger):
                     # BEFORE the listener closes
                     self._send(200 if payload["status"] == "ok" else 503,
                                payload)
+                elif self.path.startswith("/metrics"):
+                    # Prometheus scrape (telemetry/metrics.py): the one
+                    # process registry — serving admission/latency plus
+                    # the standard step/feed/mem/restart families
+                    # (localhost trust model, same as /info)
+                    from veles_tpu.telemetry import metrics as tmetrics
+                    tmetrics.scrape_mem()
+                    body = tmetrics.default_registry() \
+                        .exposition().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     tmetrics.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path.startswith("/info"):
                     self._send(200, srv.model_info())
                 else:

@@ -1,0 +1,399 @@
+"""Step-timeline tracing: where a step's wall-clock went, as spans.
+
+The observability gap this closes: the PR-5 H2D-under-compute overlap
+was *inferred* from counters (`loader_block_s` vs `device_sync_s`);
+nothing showed WHERE inside one step the time sat. The `Tracer` records
+host-side spans — feed pops, the async train dispatch, the in-flight
+device window, the class-pass-boundary sync, Decision/snapshot
+bookkeeping, the next batch's `device_put` — into a fixed-capacity ring
+buffer and exports them as a Chrome-trace/Perfetto-loadable
+``trace.json``, so the overlap becomes a picture: batch k+1's
+``feed.device_put`` span visibly riding under step k's ``step`` span.
+
+Design constraints (the hot-path contract):
+
+- **Zero host-sync**: spans are host timestamps only
+  (``time.perf_counter_ns``, one monotonic clock for the whole
+  process); recording never touches a device value.
+- **Pre-bound handle**: hot paths capture ``tracer.active()`` ONCE
+  (None when tracing is off) and guard each record with a plain ``is
+  not None`` check — the disabled path costs one attribute load. The
+  velint ``hot-metric`` rule enforces the same discipline for metric
+  records.
+- **Bounded memory**: a ring buffer of `capacity` events; overflow
+  overwrites the oldest and the export reports how many were dropped
+  (``otherData.dropped``) instead of growing without bound on a long
+  run.
+- **Thread-safe**: one lock around the ring append; begin/end tokens
+  carry their own timestamps so the lock is held for the append only.
+
+Profile windows (`ProfileController`): ``--profile-window N:M``
+brackets driver steps N..M (inclusive) with ``jax.profiler``
+start/stop — the on-chip capture path — and ``POST /profile`` on the
+web-status control plane arms a window on a LIVE run (the
+tunnel-watcher's remote-capture hook). The driver calls
+``controller.on_step(k)`` once per step; the disarmed path is a single
+attribute check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+#: default ring capacity (events); env-overridable for long captures
+_DEFAULT_CAPACITY = int(os.environ.get("VELES_TRACE_CAPACITY",
+                                       str(1 << 16)))
+
+
+class Tracer:
+    """Fixed-capacity span recorder with Chrome-trace export."""
+
+    def __init__(self, capacity: int = 0) -> None:
+        self.capacity = max(256, int(capacity or _DEFAULT_CAPACITY))
+        #: ring slots: (name, cat, ts_us, dur_us, tid, ph)
+        self._ring: List[Optional[Tuple]] = [None] * self.capacity
+        self._n = 0                      # total events ever recorded
+        self._lock = threading.Lock()
+        #: perf_counter_ns at construction — every ts is relative to it
+        self._epoch_ns = time.perf_counter_ns()
+        #: wall-clock twin of the epoch, for correlating with logs
+        self._epoch_unix = time.time()
+        self._pid = os.getpid()
+
+    # -- recording ------------------------------------------------------------
+
+    def begin(self, name: str, cat: str = "host") -> Tuple:
+        """Open a span; returns the token `end()` closes. No lock —
+        the token carries its own start timestamp."""
+        return (name, cat, time.perf_counter_ns(),
+                threading.get_ident())
+
+    def end(self, token: Tuple) -> None:
+        """Close a span opened by `begin()` and append it."""
+        name, cat, t0, tid = token
+        t1 = time.perf_counter_ns()
+        self._append((name, cat, (t0 - self._epoch_ns) / 1e3,
+                      (t1 - t0) / 1e3, tid, "X"))
+
+    def add_span(self, name: str, cat: str,
+                 t0_s: float, t1_s: float) -> None:
+        """Record a span from two `time.perf_counter()` readings the
+        caller already took (the feed's existing block timers) —
+        perf_counter and perf_counter_ns share one clock, so no second
+        timestamp is paid."""
+        self._append((name, cat, (t0_s * 1e9 - self._epoch_ns) / 1e3,
+                      max(0.0, (t1_s - t0_s) * 1e6),
+                      threading.get_ident(), "X"))
+
+    def instant(self, name: str, cat: str = "host") -> None:
+        """A zero-duration marker (Chrome-trace "i" event)."""
+        self._append((name, cat,
+                      (time.perf_counter_ns() - self._epoch_ns) / 1e3,
+                      0.0, threading.get_ident(), "i"))
+
+    @contextmanager
+    def span(self, name: str, cat: str = "host"):
+        tok = self.begin(name, cat)
+        try:
+            yield
+        finally:
+            self.end(tok)
+
+    def _append(self, ev: Tuple) -> None:
+        with self._lock:
+            self._ring[self._n % self.capacity] = ev
+            self._n += 1
+
+    # -- export ---------------------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    def events(self) -> List[Tuple]:
+        """Recorded events, oldest first (ring unrolled)."""
+        with self._lock:
+            n = self._n
+            if n <= self.capacity:
+                return [e for e in self._ring[:n] if e is not None]
+            head = n % self.capacity
+            return [e for e in self._ring[head:] + self._ring[:head]
+                    if e is not None]
+
+    def trace_events(self) -> List[Dict[str, Any]]:
+        """Chrome-trace event dicts (the `traceEvents` array)."""
+        out: List[Dict[str, Any]] = []
+        tids = set()
+        for name, cat, ts, dur, tid, ph in self.events():
+            tids.add(tid)
+            ev: Dict[str, Any] = {"name": name, "cat": cat, "ph": ph,
+                                  "ts": round(ts, 3),
+                                  "pid": self._pid, "tid": tid}
+            if ph == "X":
+                ev["dur"] = round(dur, 3)
+            else:
+                ev["s"] = "t"           # instant scope: thread
+            out.append(ev)
+        # thread-name metadata so Perfetto labels the tracks
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid in sorted(tids):
+            out.append({"name": "thread_name", "ph": "M",
+                        "pid": self._pid, "tid": tid,
+                        "args": {"name": names.get(tid, f"tid-{tid}")}})
+        return out
+
+    def export(self, path: str) -> str:
+        """Write the Perfetto/chrome://tracing-loadable JSON (atomic
+        replace — a killed run leaves the previous file intact, not a
+        torn one). Returns `path`."""
+        doc = {
+            "traceEvents": self.trace_events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "veles_tpu.telemetry.tracer",
+                "clock": "perf_counter_ns (us since epoch_unix)",
+                "epoch_unix": round(self._epoch_unix, 6),
+                "recorded": self._n,
+                "dropped": self.dropped,
+            },
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+# -- process-global tracer (the --trace flag's target) ------------------------
+
+_ACTIVE: Optional[Tracer] = None
+
+
+def install(capacity: int = 0) -> Tracer:
+    """Install (and return) the process tracer. Idempotent: a second
+    install returns the existing tracer so nested drivers share one
+    timeline."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = Tracer(capacity)
+    return _ACTIVE
+
+
+def active() -> Optional[Tracer]:
+    """The installed tracer, or None (tracing off). Hot paths capture
+    this ONCE and None-check per record — the pre-bound-handle
+    contract."""
+    return _ACTIVE
+
+
+def uninstall() -> Optional[Tracer]:
+    """Remove and return the process tracer (tests; idempotent)."""
+    global _ACTIVE
+    tr, _ACTIVE = _ACTIVE, None
+    return tr
+
+
+@contextmanager
+def span(name: str, cat: str = "host"):
+    """Convenience span for COLD paths (no-op when tracing is off).
+    Hot loops pre-bind `active()` instead — this helper pays a module
+    lookup per call."""
+    tr = _ACTIVE
+    if tr is None:
+        yield
+        return
+    tok = tr.begin(name, cat)
+    try:
+        yield
+    finally:
+        tr.end(tok)
+
+
+# -- profile windows ----------------------------------------------------------
+
+class ProfileController:
+    """Bracket driver steps N..M with jax.profiler start/stop.
+
+    Armed from the CLI (``--profile-window N:M``) or at runtime over
+    HTTP (``POST /profile`` on web_status -> `request()`, which opens a
+    window of K steps at the next step boundary). The driver calls
+    `on_step(k)` at the top of every iteration and `finalize()` on the
+    way out; the disarmed fast path is one attribute check, no lock.
+
+    `start_fn`/`stop_fn` default to jax.profiler (imported lazily so a
+    jax-free process can hold a controller); tests inject fakes.
+    """
+
+    def __init__(self, start_fn=None, stop_fn=None) -> None:
+        self._lock = threading.Lock()
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        #: fast-path gate: False = nothing armed, nothing running
+        self._hot = False
+        self._window: Optional[Tuple[int, int, str]] = None
+        #: HTTP-armed request: (n_steps, out_dir) pending the next step
+        self._pending: Optional[Tuple[int, str]] = None
+        self._running = False
+        self._running_dir = ""
+        #: completed window records (observability / tests)
+        self.windows: List[Dict[str, Any]] = []
+
+    # -- arming ---------------------------------------------------------------
+
+    @staticmethod
+    def parse_spec(spec: str) -> Tuple[int, int]:
+        """``"N:M"`` -> (N, M), validated. Raises ValueError."""
+        lo, sep, hi = spec.partition(":")
+        if not sep:
+            raise ValueError(f"want N:M (got {spec!r})")
+        start, stop = int(lo), int(hi)
+        if start < 0 or stop < start:
+            raise ValueError(
+                f"want 0 <= N <= M (got {start}:{stop})")
+        return start, stop
+
+    def arm(self, start: int, stop: int, out_dir: str) -> None:
+        """CLI path: capture steps `start`..`stop` inclusive."""
+        with self._lock:
+            self._window = (int(start), int(stop), out_dir)
+            self._hot = True
+
+    def request(self, n_steps: int, out_dir: str = "") -> Dict[str, Any]:
+        """HTTP path: open a window of `n_steps` steps at the next step
+        boundary of the live run. Returns the armed request (echoed to
+        the client). A window already running/armed is replaced —
+        last writer wins, like re-POSTing."""
+        n = max(1, min(int(n_steps), 100_000))
+        out = out_dir or self._default_dir()
+        with self._lock:
+            self._pending = (n, out)
+            self._hot = True
+        return {"steps": n, "dir": out}
+
+    @staticmethod
+    def _default_dir() -> str:
+        return os.environ.get("VELES_PROFILE_DIR", "telemetry_profile")
+
+    # -- driver hooks ---------------------------------------------------------
+
+    def on_step(self, step: int) -> None:
+        """Called at the top of every driver iteration with the global
+        step index about to run."""
+        if not self._hot:
+            return
+        with self._lock:
+            if self._pending is not None:
+                n, out = self._pending
+                self._pending = None
+                self._window = (step, step + n - 1, out)
+            win = self._window
+            if win is None:
+                self._hot = self._running
+                if not self._running:
+                    return
+            if win is not None and not self._running:
+                if step > win[1]:
+                    # run resumed past the window (e.g. restarted from a
+                    # later snapshot): drop it rather than arm forever
+                    self._window = None
+                    self._hot = self._pending is not None
+                elif win[0] <= step:
+                    self._begin(win[2], step)
+            elif self._running and win is not None and step > win[1]:
+                self._finish(step - 1)
+                self._window = None
+                self._hot = self._pending is not None
+
+    def finalize(self) -> None:
+        """End-of-run: close a still-open window (a window whose M
+        exceeds the run length still yields a capture)."""
+        with self._lock:
+            if self._running:
+                self._finish(-1)
+            self._window = None
+            self._pending = None
+            self._hot = False
+
+    # -- jax.profiler plumbing (lock held by callers) -------------------------
+
+    def _begin(self, out_dir: str, step: int) -> None:
+        start = self._start_fn
+        if start is None:
+            import jax
+            start = jax.profiler.start_trace
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            start(out_dir)
+        except Exception as e:  # noqa: BLE001 — profiling must never
+            # kill training (double-start, backend without profiler...)
+            self.windows.append({"error": str(e)[:200], "step": step})
+            self._log().warning("profile window failed to start at "
+                                "step %d: %s", step, e)
+            # a start that failed once fails every step of the window
+            # the same way (e.g. whole-run -p profiling already active):
+            # drop the window instead of retrying per step — a 100k-step
+            # HTTP window would otherwise flood the log and the windows
+            # list at one entry per step
+            self._window = None
+            self._hot = self._pending is not None
+            return
+        self._running = True
+        self._running_dir = out_dir
+        self._t0 = time.perf_counter()
+        self._step0 = step
+        tr = _ACTIVE
+        if tr is not None:
+            tr.instant(f"profile_window.start@{step}", "profile")
+
+    def _finish(self, step: int) -> None:
+        stop = self._stop_fn
+        if stop is None:
+            import jax
+            stop = jax.profiler.stop_trace
+        try:
+            stop()
+        except Exception as e:  # noqa: BLE001
+            self.windows.append({"error": str(e)[:200], "step": step})
+            self._log().warning("profile window failed to stop at "
+                                "step %d: %s", step, e)
+        else:
+            rec = {
+                "dir": self._running_dir, "first_step": self._step0,
+                "last_step": step,
+                "wall_s": round(time.perf_counter() - self._t0, 6)}
+            self.windows.append(rec)
+            self._log().info(
+                "profile window captured: steps %d..%s -> %s",
+                self._step0, step if step >= 0 else "<run end>",
+                self._running_dir)
+            tr = _ACTIVE
+            if tr is not None:
+                tr.instant(f"profile_window.stop@{step}", "profile")
+        self._running = False
+
+    @staticmethod
+    def _log():
+        import logging
+        return logging.getLogger("veles.telemetry")
+
+
+_CONTROLLER: Optional[ProfileController] = None
+
+
+def profile_controller() -> ProfileController:
+    """The process's profile-window controller (created on first use)."""
+    global _CONTROLLER
+    if _CONTROLLER is None:
+        _CONTROLLER = ProfileController()
+    return _CONTROLLER
+
+
+def reset_profile_controller() -> None:
+    """Drop the process controller (tests)."""
+    global _CONTROLLER
+    _CONTROLLER = None

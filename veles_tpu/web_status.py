@@ -39,7 +39,8 @@ background:#181818;border:1px solid #444;margin:1em 0"></svg>
 <span style="color:#6ae">valid</span>
 <span style="color:#ddd">&nbsp;(errors per epoch)</span></div>
 <table id="procs" style="display:none"><thead><tr><th>process</th>
-<th>host</th><th>devices</th><th>last seen</th></tr></thead>
+<th>host</th><th>devices</th><th>last seen</th><th>feed b/batch</th>
+<th>feed blocked (s)</th><th>on demand</th><th>mem max</th></tr></thead>
 <tbody></tbody></table>
 <table id="units"><thead><tr><th>unit</th><th>runs</th><th>time (s)</th>
 </tr></thead><tbody></tbody></table>
@@ -59,8 +60,17 @@ async function tick(){
   pt.style.display = workers.length ? '' : 'none';
   for (const [pid, w] of workers){
     const tr = document.createElement('tr');
+    const f = w.feed || {}, m = w.mem || {};
+    const mb = v => v == null ? '-' : (v / 1048576).toFixed(1) + ' MB';
+    const wire = f.uint8_wire ? ' u8' : '';
     tr.innerHTML = `<td>${pid}</td><td>${w.host}</td>` +
-      `<td>${w.local_devices}</td><td>${w.age_s.toFixed(1)}s ago</td>`;
+      `<td>${w.local_devices}</td><td>${w.age_s.toFixed(1)}s ago</td>` +
+      `<td>${f.bytes_per_batch == null ? '-'
+            : mb(f.bytes_per_batch) + wire}</td>` +
+      `<td>${f.loader_block_s == null ? '-'
+            : f.loader_block_s.toFixed(2)}</td>` +
+      `<td>${f.on_demand == null ? '-' : f.on_demand}</td>` +
+      `<td>${mb(m.live_bytes_max)}</td>`;
     ptb.appendChild(tr);
   }
   const tb = document.querySelector('#units tbody'); tb.innerHTML = '';
@@ -150,19 +160,48 @@ class WebStatusServer:
 
     #: accepted beat fields -> (type, max size when str)
     _BEAT_FIELDS = {"host": (str, 256), "local_devices": (int, None)}
+    #: OPTIONAL dict payloads a beat may carry (device-feed overlap
+    #: counters + memstats snapshot — PR 5/6 heartbeat fields, now
+    #: surfaced as cluster-table columns instead of dropped): sanitized
+    #: to scalar values, key count and string length capped
+    _BEAT_OPTIONAL = ("feed", "mem")
+    _BEAT_DICT_KEYS = 32
 
     def __init__(self, workflow, host: str = "127.0.0.1",
                  port: int = 8090, token: Optional[str] = None,
-                 max_workers: int = 256) -> None:
+                 max_workers: int = 256,
+                 profile_controller=None) -> None:
         self.workflow = workflow
         self.host = host
         self.port = port
         self.token = token
         self.max_workers = max_workers
+        #: the live run's profile-window controller (telemetry/tracer):
+        #: POST /profile arms an on-chip capture window on it
+        self.profile_controller = profile_controller
         #: worker heartbeats: process_id -> {host, local_devices, t}
         self.workers: Dict[str, Dict[str, Any]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def _clean_dict(cls, d: Any) -> Optional[Dict[str, Any]]:
+        """Scalars-only, size-capped copy of an optional beat dict."""
+        if not isinstance(d, dict):
+            return None
+        out: Dict[str, Any] = {}
+        for k, v in d.items():
+            if len(out) >= cls._BEAT_DICT_KEYS:
+                break
+            if isinstance(v, bool) or v is None:
+                out[str(k)[:64]] = v
+            elif isinstance(v, (int, float)):
+                out[str(k)[:64]] = v
+            elif isinstance(v, str):
+                out[str(k)[:64]] = v[:128]
+            # nested structures (epoch_log rows, per-device maps) are
+            # dropped: the table shows totals, the child owns detail
+        return out
 
     def _clean_beat(self, beat: Any) -> Optional[Dict[str, Any]]:
         """Whitelisted, size-capped copy of an incoming beat, or None."""
@@ -176,6 +215,10 @@ class WebStatusServer:
             if cap is not None and len(v) > cap:
                 v = v[:cap]
             out[k] = v
+        for k in self._BEAT_OPTIONAL:
+            v = self._clean_dict(beat.get(k))
+            if v:
+                out[k] = v
         return out
 
     def start(self) -> None:
@@ -185,9 +228,32 @@ class WebStatusServer:
         max_workers = self.max_workers
         clean = self._clean_beat
 
+        profile_ctl = self.profile_controller
+
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                if self.path.startswith("/status.json"):
+                if self.path.startswith("/metrics"):
+                    # Prometheus scrape target (telemetry/metrics.py):
+                    # the one process registry, with a scrape-time mem
+                    # refresh; token-guarded like the heartbeat POST
+                    # (the server binds non-loopback in distributed
+                    # mode and an exposition leaks run internals)
+                    from veles_tpu.http_util import check_shared_token
+                    if not check_shared_token(self, token):
+                        return
+                    from veles_tpu.telemetry import metrics as tmetrics
+                    tmetrics.scrape_mem()
+                    reg = tmetrics.default_registry()
+                    try:
+                        dec = getattr(wf, "decision", None)
+                        if dec is not None:
+                            reg.gauge("veles_epoch").set(
+                                float(dec.epoch_number))
+                    except Exception:  # noqa: BLE001 — scrape survives
+                        pass
+                    body = reg.exposition().encode()
+                    ctype = tmetrics.CONTENT_TYPE
+                elif self.path.startswith("/status.json"):
                     status = workflow_status(wf)
                     now = time.time()
                     status["workers"] = {
@@ -206,6 +272,9 @@ class WebStatusServer:
                 self.wfile.write(body)
 
             def do_POST(self) -> None:  # noqa: N802
+                if self.path.startswith("/profile"):
+                    self._do_profile()
+                    return
                 if not self.path.startswith("/heartbeat.json"):
                     self.send_response(404)
                     self.end_headers()
@@ -235,6 +304,49 @@ class WebStatusServer:
                 self.send_response(204)
                 self.end_headers()
 
+            def _do_profile(self) -> None:
+                """POST /profile {"steps": K[, "dir": PATH]} — arm a
+                jax.profiler window of K steps at the live run's next
+                step boundary (the tunnel-watcher's on-chip capture
+                path). Auth + bounded body like the heartbeat endpoint
+                (task_queue hardening precedent): arming the profiler
+                on an open port is a writable control surface."""
+                from veles_tpu.http_util import check_shared_token
+                if not check_shared_token(self, token):
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length",
+                                                  "0"))
+                except ValueError:
+                    length = -1
+                if not 0 <= length <= 4096:
+                    self.send_response(413 if length > 4096 else 400)
+                    self.end_headers()
+                    return
+                if profile_ctl is None:
+                    body = json.dumps({"error": "no stepped driver in "
+                                       "this process"}).encode()
+                    self.send_response(409)
+                else:
+                    try:
+                        req = json.loads(self.rfile.read(length)
+                                         or b"{}")
+                        steps = int(req.get("steps", 20))
+                        out_dir = str(req.get("dir", ""))[:512]
+                        if steps < 1:
+                            raise ValueError(steps)
+                    except (ValueError, TypeError, AttributeError):
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    armed = profile_ctl.request(steps, out_dir)
+                    body = json.dumps({"armed": armed}).encode()
+                    self.send_response(202)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def log_message(self, *args: Any) -> None:
                 pass  # keep the training log clean
 
@@ -258,12 +370,16 @@ class HeartbeatReporter:
 
     def __init__(self, coordinator_host: str, port: int,
                  process_id: int, interval: float = 5.0,
-                 token: Optional[str] = None) -> None:
+                 token: Optional[str] = None, workflow=None) -> None:
         self.url_host = coordinator_host
         self.port = port
         self.process_id = process_id
         self.interval = interval
         self.token = token
+        #: when given, beats carry the run's feed/mem telemetry so the
+        #: coordinator's cluster table shows input-pipeline health and
+        #: memory footprint per process, not just last-seen ages
+        self.workflow = workflow
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -274,11 +390,27 @@ class HeartbeatReporter:
             n_local = jax.local_device_count()
         except Exception:
             n_local = 0
-        body = json.dumps({
+        payload: Dict[str, Any] = {
             "process_id": self.process_id,
             "host": socket.gethostname(),
             "local_devices": n_local,
-        })
+        }
+        feed = getattr(self.workflow, "feed_stats", None)
+        if feed:
+            payload["feed"] = {k: v for k, v in feed.items()
+                               if k != "epoch_log"}
+        try:
+            from veles_tpu.parallel.memstats import device_memory_stats
+            mem = device_memory_stats()
+            if mem:
+                # totals only: the beat whitelist drops nested maps
+                payload["mem"] = {
+                    "live_bytes_max": mem.get("live_bytes_max", 0),
+                    "n_live_arrays": mem.get("n_live_arrays", 0),
+                    "peak_bytes_max": mem.get("peak_bytes_max")}
+        except Exception:   # noqa: BLE001 — stats never kill a beat
+            pass
+        body = json.dumps(payload)
         conn = http.client.HTTPConnection(self.url_host, self.port,
                                           timeout=3)
         headers = {"Content-Type": "application/json"}

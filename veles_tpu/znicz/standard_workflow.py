@@ -445,7 +445,18 @@ class StandardWorkflow(Workflow):
         from veles_tpu.loader.base import TRAIN
         from veles_tpu.loader.device_feed import DeviceFeed
         from veles_tpu.resilience.faults import active_plan
+        from veles_tpu.telemetry import metrics as _tmetrics
+        from veles_tpu.telemetry import tracer as _ttracer
         fault_plan = active_plan()   # None in production: zero per-step cost
+        # telemetry plane (docs/OBSERVABILITY.md): the tracer handle and
+        # the metric instruments are PRE-BOUND here, outside the loop —
+        # the hot path pays None checks and float adds, never a name
+        # lookup (the velint hot-metric contract). tr is None when no
+        # --trace is active; the profile controller's disarmed on_step
+        # is one attribute check.
+        tr = _ttracer.active()
+        prof = _ttracer.profile_controller()
+        mh = _tmetrics.step_handles()
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
         # the feed uploads (sharded, async) itself; the loader's granular-
@@ -492,15 +503,43 @@ class StandardWorkflow(Workflow):
             # evaluator docstring's fused-mode contract).
             acc_loss = acc_err = acc_conf = None
             acc_w = 0.0
+            step_idx = 0
+            #: the open in-flight "step" span: dispatch k .. dispatch
+            #: k+1 (or the class-pass-boundary device sync, whichever
+            #: first) — the host-visible window the device is executing
+            #: step k in, which batch k+1's feed.device_put span rides
+            #: under when the overlap works
+            step_tok = None
+            t_iter = _time.perf_counter()
+            ep_examples = 0.0
+            t_epoch = t_iter
             while not bool(dec.complete):
+                prof.on_step(step_idx)
+                if tr is not None:
+                    tok = tr.begin("feed.next", "feed")
                 b = feed.next()
+                if tr is not None:
+                    tr.end(tok)
                 x, y, w = b.x, b.y, b.w
+                if tr is not None and step_tok is not None:
+                    tr.end(step_tok)     # step k-1's window closes at
+                    step_tok = None      # the next dispatch
                 if b.minibatch_class == TRAIN:
+                    if tr is not None:
+                        tok = tr.begin("train.dispatch", "step")
                     state, (loss, n_err) = step.train(state, x, y, w)
+                    if tr is not None:
+                        tr.end(tok)
+                        step_tok = tr.begin("step", "step")
                     if fault_plan is not None and fault_plan.nan_at_step():
                         loss = float("nan")   # deterministic divergence
                 else:
+                    if tr is not None:
+                        tok = tr.begin("eval.dispatch", "step")
                     loss, n_err = step.evaluate(state, x, y, w)
+                    if tr is not None:
+                        tr.end(tok)
+                        step_tok = tr.begin("step", "step")
                     # fused-mode confusion accumulation (the granular
                     # graph's evaluator fills it per minibatch; without
                     # this the confusion plot would silently skip).
@@ -527,6 +566,14 @@ class StandardWorkflow(Workflow):
                 acc_loss = wl if acc_loss is None else acc_loss + wl
                 acc_w += bw
                 acc_err = n_err if acc_err is None else acc_err + n_err
+                step_idx += 1
+                mh.steps.inc()
+                if b.minibatch_class == TRAIN:
+                    mh.examples.inc(bw)
+                    ep_examples += bw
+                now = _time.perf_counter()
+                mh.step_seconds.observe(now - t_iter)
+                t_iter = now
                 if b.last_minibatch:
                     # Decision's improvement/stop logic only reads totals
                     # at the class-pass boundary; feeding the accumulated
@@ -536,6 +583,10 @@ class StandardWorkflow(Workflow):
                     # time into loader vs device.
                     t_sync = _time.perf_counter()
                     ev.loss = float(acc_loss) / max(acc_w, 1.0)
+                    if tr is not None and step_tok is not None:
+                        tr.end(step_tok)   # the float() drained the
+                        step_tok = None    # device: the window is over
+                    mh.loss.set(ev.loss)
                     if nonfinite_guard and not np.isfinite(ev.loss):
                         # raised BEFORE dec.run()/the snapshot branch: a
                         # poisoned state must never be snapshotted. The
@@ -556,7 +607,11 @@ class StandardWorkflow(Workflow):
                         # velint: disable=sync-feed
                         ev.confusion_matrix.mem += np.asarray(
                             acc_conf).astype(ev.confusion_matrix.mem.dtype)
-                    feed.note_device_sync(_time.perf_counter() - t_sync)
+                    t_done = _time.perf_counter()
+                    feed.note_device_sync(t_done - t_sync)
+                    if tr is not None:
+                        tr.add_span("device_sync", "step", t_sync,
+                                    t_done)
                     acc_loss = acc_err = acc_conf = None
                     acc_w = 0.0
                 else:
@@ -567,7 +622,26 @@ class StandardWorkflow(Workflow):
                     # the heartbeat, which carries these counters to the
                     # supervisor's exit report
                     self.feed_stats = feed.stats()
+                    # the one registry mirrors the feed's counters (the
+                    # feed stays the producer) and the epoch-boundary
+                    # rates; a JSONL sink (if installed) gets one line
+                    # per epoch for offline analysis
+                    _tmetrics.mirror_feed(self.feed_stats)
+                    t_ep = _time.perf_counter()
+                    if ep_examples and t_ep > t_epoch:
+                        mh.examples_per_s.set(
+                            ep_examples / (t_ep - t_epoch))
+                    ep_examples, t_epoch = 0.0, t_ep
+                if tr is not None:
+                    tok = tr.begin("decision", "bookkeeping")
                 dec.run()
+                if tr is not None:
+                    tr.end(tok)
+                if b.epoch_ended:
+                    mh.epoch.set(dec.epoch_number)
+                    _tmetrics.flush_installed(
+                        extra={"source": "driver",
+                               "epoch": int(dec.epoch_number)})
                 if getattr(self, "plotters", None) \
                         and b.epoch_ended \
                         and not _root.common.get("plotting_disabled",
@@ -584,17 +658,29 @@ class StandardWorkflow(Workflow):
                 # gating is applied here by hand: same improved-gated
                 # behavior as granular mode (run_fused's contract)
                 if self.snapshotter is not None and bool(dec.improved):
+                    if tr is not None:
+                        tok = tr.begin("snapshot", "bookkeeping")
                     step.write_back(state)
                     self.snapshotter.run()
+                    if tr is not None:
+                        tr.end(tok)
                 # NOW produce batch k+1 and issue its async put: the
                 # step dispatched above is still executing on device,
                 # so the H2D transfer hides under it — and the snapshot
                 # (if any) already pickled the pristine loader cursor
                 if not bool(dec.complete):
+                    if tr is not None:
+                        tok = tr.begin("feed.prefetch", "feed")
                     feed.prefetch()
+                    if tr is not None:
+                        tr.end(tok)
         finally:
+            if tr is not None and step_tok is not None:
+                tr.end(step_tok)
+            prof.finalize()
             feed.stop()
             self.feed_stats = feed.stats()
+            _tmetrics.mirror_feed(self.feed_stats)
             loader.on_device = prev_on_device
             if wire is not None and hasattr(loader, "set_emit") \
                     and prev_emit is not None:
