@@ -687,6 +687,56 @@ def test_lazy_trace_reexports_do_not_recurse():
 
 # == CI gates (tier-1 smoke) ==================================================
 
+def test_velint_pallas_magic_number_seeded():
+    """A tile/block int literal assigned inside a kernel function body
+    of a pallas file is a frozen tuning axis — exactly the class of
+    constant the template config spaces exist to own."""
+    src = (
+        "def _kern_call(x):\n"
+        "    row_tile = 8\n"
+        "    blk_q = 512\n"
+        "    n_blocks = 4\n"
+        "    lanes = 128\n"          # no tile/blk/block in the name
+        "    return x\n"
+    )
+    findings = lint.lint_source(src, path="veles_tpu/ops/pallas_kernels.py")
+    assert [f.rule for f in findings] == ["pallas-magic-number"] * 3
+    assert sorted(f.line for f in findings) == [2, 3, 4]
+    # suppression works like every rule
+    sup = src.replace("row_tile = 8",
+                      "row_tile = 8  # velint: disable=pallas-magic-number")
+    assert len(lint.lint_source(
+        sup, path="veles_tpu/ops/pallas_kernels.py")) == 2
+
+
+def test_velint_pallas_magic_number_clean_cases():
+    # module-level constants are the documented space bounds — exempt
+    src_mod = "_FLASH_BLK_Q = 512\n_MIN_ROW_TILE = 8\n"
+    assert lint.lint_source(
+        src_mod, path="veles_tpu/ops/pallas_kernels.py") == []
+    # signature defaults (the incumbent seeds) are exempt
+    src_sig = ("def f(x, row_tile: int = 8, blk_k=1024):\n"
+               "    return x\n")
+    assert lint.lint_source(
+        src_sig, path="veles_tpu/ops/pallas_kernels.py") == []
+    # non-literal assignments (parameters, computed tiles) are exempt
+    src_param = ("def f(x, rt):\n"
+                 "    row_tile = max(8, int(rt))\n"
+                 "    blk_q, blk_k = x.shape\n"
+                 "    return x\n")
+    assert lint.lint_source(
+        src_param, path="veles_tpu/ops/pallas_kernels.py") == []
+    # the same magic numbers OUTSIDE a pallas file are not this rule's
+    # business
+    src = "def f(x):\n    row_tile = 8\n    return x\n"
+    assert lint.lint_source(src, path="veles_tpu/ops/xla.py") == []
+    # and the REAL kernel file is clean (the refactor parameterized
+    # every axis) — the baseline must stay empty
+    assert [f for f in lint.lint_file(
+        os.path.join(REPO, "veles_tpu", "ops", "pallas_kernels.py"))
+        if f.rule == "pallas-magic-number"] == []
+
+
 def test_velint_ci_runs_clean_on_this_repo():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "velint.py"),

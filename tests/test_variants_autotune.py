@@ -140,7 +140,7 @@ def test_registry_validation():
         variants.select("no_such_op", "x")
     table = variants.selection_table(include_defaults=True)
     assert set(table) == {"lrn", "maxpool", "conv_stem", "dropout",
-                          "grad_reduce"}
+                          "grad_reduce", "flash_attn", "sgd_update"}
     # pallas variants resolve to the op's non-pallas fallback on CPU...
     variants.select("lrn", "pallas_one_pass")
     assert variants.resolve("lrn").name == "banded_matmul"
@@ -255,13 +255,24 @@ def test_cache_keys_are_batch_independent(tmp_path):
     assert set(applied) == {"lrn", "maxpool", "conv_stem", "dropout"}
 
 
-def test_autotune_cache_corrupt_file_falls_back(tmp_path):
+def test_autotune_cache_corrupt_file_falls_back(tmp_path, monkeypatch):
     cache_path = tmp_path / "autotune.json"
     cache_path.write_text("{definitely not json")
     c = at.AutotuneCache(str(cache_path))
+    warned = []
+    monkeypatch.setattr(c, "warning",
+                        lambda msg, *a: warned.append(msg % a))
     assert c.get("anything") is None          # degrade, don't raise
+    assert c.get("again") is None
+    # ...and logs ONCE, not per get (the empty dict is cached)
+    assert sum("re-tuning" in m for m in warned) == 1
     c.put("k1", {"variant": "x"})
     assert at.AutotuneCache(str(cache_path)).get("k1") == {"variant": "x"}
+    # the written file carries the explicit schema tag at the current
+    # version
+    raw = json.loads(cache_path.read_text())
+    assert raw["schema"] == at.AutotuneCache.SCHEMA
+    assert raw["version"] == at.AutotuneCache.VERSION
     # unknown layout versions likewise degrade
     cache_path.write_text(json.dumps({"version": 999, "entries": {}}))
     assert at.AutotuneCache(str(cache_path)).get("k1") is None
@@ -271,6 +282,38 @@ def test_autotune_cache_corrupt_file_falls_back(tmp_path):
     c2 = at.AutotuneCache(str(tmp_path / "c2.json"))
     c2.put(key, {"variant": "deleted_variant"})
     assert not variants.has("lrn", "deleted_variant")
+
+
+def test_autotune_cache_version_skew_degrades(tmp_path, monkeypatch):
+    """An old-schema cache (a v1 file from before the search PR, a
+    future version, or a wrong schema tag) must behave as EMPTY — log
+    once and re-tune, never crash, never serve stale-layout records."""
+    cache_path = tmp_path / "autotune.json"
+    # the exact v1 layout PR 2 wrote (no schema tag)
+    cache_path.write_text(json.dumps(
+        {"version": 1,
+         "entries": {"TPU vX|lrn|f32|cafe": {"variant": "banded_matmul",
+                                             "timings_s": {}}}}))
+    c = at.AutotuneCache(str(cache_path))
+    warned = []
+    monkeypatch.setattr(c, "warning",
+                        lambda msg, *a: warned.append(msg % a))
+    assert c.get("TPU vX|lrn|f32|cafe") is None
+    assert c.get("TPU vX|lrn|f32|cafe") is None
+    assert sum("re-tuning" in m for m in warned) == 1
+    assert "v1" in warned[0]                 # the skew is named
+    # wrong schema tag at the right version also degrades
+    cache_path.write_text(json.dumps(
+        {"schema": "someone-elses-cache",
+         "version": at.AutotuneCache.VERSION, "entries": {}}))
+    assert at.AutotuneCache(str(cache_path)).get("x") is None
+    # a put() on a skewed cache rewrites it cleanly at CURRENT version
+    c3 = at.AutotuneCache(str(cache_path))
+    c3.put("k", {"variant": "v"})
+    raw = json.loads(cache_path.read_text())
+    assert raw["schema"] == at.AutotuneCache.SCHEMA
+    assert raw["version"] == at.AutotuneCache.VERSION
+    assert at.AutotuneCache(str(cache_path)).get("k") == {"variant": "v"}
 
 
 # ---------------------------------------------------------------------------

@@ -51,7 +51,33 @@ def main(argv=None) -> int:
                    help="restrict tuning to these ops (default: all)")
     p.add_argument("--force", action="store_true",
                    help="re-time even on a cache hit")
+    p.add_argument("--budget", type=int, default=None, metavar="N",
+                   help="budgeted coordinate-descent search over the "
+                        "GENERATED kernel candidates (ops.templates): "
+                        "spend up to N trials across the template-"
+                        "backed ops — workflow ops (lrn) timed in-graph,"
+                        " below-graph ops (flash_attn, sgd_update) via "
+                        "their template microbench — priority-ordered "
+                        "by LAYER_PROFILE.json; every point equivalence-"
+                        "gated against ops.reference before timing. "
+                        "Non-template ops still get the flat enumeration")
+    p.add_argument("--profile-json", default=None, metavar="PATH",
+                   help="per-op cost shares for the search's priority "
+                        "order (default: $VELES_LAYER_PROFILE_PATH or "
+                        "LAYER_PROFILE.json — write it with "
+                        "tools/layer_profile.py)")
     args = p.parse_args(argv)
+
+    if args.budget is not None and args.budget < 1:
+        # the launcher's --autotune-budget precedent: a non-positive
+        # budget would silently skip every template-backed op AND
+        # exclude it from the flat fallback — reject it
+        p.error("--budget must be >= 1")
+    if args.profile_json and not args.budget:
+        # the --autotune-budget precedent: a flag nothing consumes is a
+        # silent no-op — the flat enumeration never reads the profile
+        p.error("--profile-json orders the budgeted search: "
+                "combine with --budget N")
 
     import jax
 
@@ -64,8 +90,9 @@ def main(argv=None) -> int:
     on_cpu = jax.default_backend() == "cpu"
 
     from veles_tpu import prng
-    from veles_tpu.ops import variants
-    from veles_tpu.ops.autotune import AutotuneCache, autotune_workflow
+    from veles_tpu.ops import templates, variants
+    from veles_tpu.ops.autotune import (AutotuneCache, autotune_workflow,
+                                        search_workflow)
     from veles_tpu.samples.alexnet import create_workflow
 
     batch = args.batch or (8 if on_cpu else 512)
@@ -84,12 +111,38 @@ def main(argv=None) -> int:
                          n_validation=batch, **kw)
     wf.initialize(device=None)
     cache = AutotuneCache(args.cache)
-    report = autotune_workflow(
-        wf, steps=steps, repeats=args.repeats, batch=batch, cache=cache,
-        force=args.force, compute_dtype=None if on_cpu else "bfloat16",
-        ops=[o for o in args.ops.split(",") if o] or None)
+    compute_dtype = None if on_cpu else "bfloat16"
+    only = [o for o in args.ops.split(",") if o] or None
+    if args.budget:
+        # budgeted search across EVERY template-backed op (lrn in-graph
+        # through the flagship step, flash_attn/sgd_update via their
+        # microbenches), then the flat enumeration for the rest
+        searched = [op for op in templates.template_ops()
+                    if only is None or op in only]
+        report = {}
+        if searched:
+            report = search_workflow(
+                wf, ops=searched, budget=args.budget, cache=cache,
+                compute_dtype=compute_dtype, steps=steps,
+                repeats=args.repeats, batch=batch, force=args.force,
+                profile_path=args.profile_json)
+        flat_ops = [op for op in (only or variants.ops())
+                    if op not in report]
+        if flat_ops:
+            report.update(autotune_workflow(
+                wf, steps=steps, repeats=args.repeats, batch=batch,
+                cache=cache, force=args.force,
+                compute_dtype=compute_dtype, ops=flat_ops))
+    else:
+        report = autotune_workflow(
+            wf, steps=steps, repeats=args.repeats, batch=batch,
+            cache=cache, force=args.force, compute_dtype=compute_dtype,
+            ops=only)
     for op, rec in sorted(report.items()):
         line = f"AUTOTUNE {op}: {rec['variant']} ({rec['source']})"
+        if rec.get("trials"):
+            line += (f"  trials={rec['trials']}/{rec.get('budget', '?')}"
+                     f"  share={rec.get('priority_share', 0):.2f}")
         if rec.get("timings_s"):
             line += "  " + "  ".join(
                 f"{k}={v if isinstance(v, str) else f'{v * 1e3:.2f}ms'}"

@@ -132,6 +132,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "winners; decisions persist in the on-disk "
                         "autotune cache, so reruns are pure cache hits "
                         "(docs/AUTOTUNE.md)")
+    p.add_argument("--autotune-budget", type=int, default=None,
+                   metavar="N",
+                   help="with --autotune: spend up to N trials per "
+                        "tuning pass on a coordinate-descent search "
+                        "over the GENERATED kernel candidates "
+                        "(ops.templates config spaces), priority-"
+                        "ordered by LAYER_PROFILE.json; every generated "
+                        "point is equivalence-gated against "
+                        "ops.reference before it may be timed "
+                        "(docs/AUTOTUNE.md)")
     p.add_argument("--tp", type=int, default=None, metavar="K",
                    help="tensor-parallel degree for distributed runs: "
                         "global mesh (data x model=K), megatron gspmd "
@@ -421,6 +431,7 @@ def main(argv=None) -> int:
         web_status=args.web_status, web_port=args.web_port,
         profile_dir=args.profile, debug_nans=args.debug_nans,
         fused=args.fused, autotune=args.autotune,
+        autotune_budget=args.autotune_budget,
         manhole=args.manhole, pp=args.pp,
         serve=args.serve, accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,

@@ -75,6 +75,10 @@ RULES: Dict[str, str] = {
                   "is not a pre-bound handle (registry name lookup per "
                   "record, or any record inside a traced fn — it fires "
                   "once at trace time)",
+    "pallas-magic-number": "hard-coded block/tile constant inside a "
+                           "Pallas kernel function body — a frozen "
+                           "tuning axis the template config space "
+                           "(ops/templates.py) cannot search",
 }
 
 #: registry lookup method names (telemetry/metrics.py): calling one
@@ -111,6 +115,18 @@ def _is_loader_path(path: str) -> bool:
     parts = re.split(r"[/\\]", path)
     return any(p == "loader" for p in parts[:-1]) \
         or "loader" in parts[-1].lower()
+
+#: the pallas-magic-number rule: tile/block-shaped names assigned an
+#: int literal inside a function body of a pallas kernel file bypass
+#: the template config space. Module-level constants are EXEMPT — they
+#: are the documented bounds/seeds of the space (pallas_kernels.py's
+#: _LANE/_MIN_ROW_TILE/... block), as are signature defaults (the
+#: incumbent seed values).
+_TILE_NAME_RE = re.compile(r"tile|blk|block", re.IGNORECASE)
+
+
+def _is_pallas_file(path: str) -> bool:
+    return "pallas" in re.split(r"[/\\]", path)[-1].lower()
 
 #: method names that ARE the per-minibatch hot path of a unit
 _HOT_METHODS = ("run", "xla_run")
@@ -167,6 +183,8 @@ class _Linter(ast.NodeVisitor):
         self.findings: List[LintFinding] = []
         self._loader_file = _is_loader_path(path)
         self._collective_home = _is_collective_home(path)
+        self._pallas_file = _is_pallas_file(path)
+        self._func_depth = 0
         #: innermost-class stack of "defines a stop() method" flags
         self._class_stop: List[bool] = []
         self._class_depth = 0
@@ -219,10 +237,12 @@ class _Linter(ast.NodeVisitor):
         traced = (name in _TRACED_METHODS or name in self._traced_names)
         self._hot_depth += hot
         self._traced_depth += traced
+        self._func_depth += 1
         # a nested def is a NEW hot/traced scope only via its own match;
         # but code inside an enclosing hot/traced body stays flagged
         # (closures run where their caller runs)
         self.generic_visit(node)
+        self._func_depth -= 1
         self._hot_depth -= hot
         self._traced_depth -= traced
 
@@ -291,6 +311,36 @@ class _Linter(ast.NodeVisitor):
                        f"`{_attr_chain(call.func)}()` outside a `with` "
                        "statement: an exception before release() wedges "
                        "every later caller")
+        self.generic_visit(node)
+
+    def _check_magic_tile(self, node, targets, value) -> None:
+        """pallas-magic-number: `<something-tile/blk/block> = <int>`
+        inside a function body of a pallas kernel file. Module-level
+        constants (the space's documented bounds/seeds) and signature
+        defaults (incumbent seeds) don't parse to this shape."""
+        if not (self._pallas_file and self._func_depth):
+            return
+        if not isinstance(value, ast.Constant) \
+                or not isinstance(value.value, int) \
+                or isinstance(value.value, bool):
+            return
+        for t in targets:
+            if isinstance(t, ast.Name) and _TILE_NAME_RE.search(t.id):
+                self._emit(
+                    node, "pallas-magic-number",
+                    f"`{t.id} = {value.value}` hard-codes a block/tile "
+                    "choice inside a kernel body: make it a parameter "
+                    "fed from the template config space "
+                    "(ops/templates.py) — or a module-level named "
+                    "constant if it is a hardware bound, not a knob")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_magic_tile(node, node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_magic_tile(node, [node.target], node.value)
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:

@@ -36,6 +36,7 @@ class Launcher(Logger):
                  web_status: bool = False, web_port: int = 8090,
                  profile_dir: str = "", debug_nans: bool = False,
                  fused: bool = False, autotune: bool = False,
+                 autotune_budget: Optional[int] = None,
                  manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
                  accum: Optional[int] = None, report: str = "",
@@ -79,7 +80,18 @@ class Launcher(Logger):
             # and then be ignored by the run
             raise SystemExit("--autotune tunes the fused-step lowerings: "
                              "combine with --fused or --pp")
+        if autotune_budget is not None and not autotune:
+            # the --feed-ahead/--zero-sharding precedent: a budget that
+            # nothing consumes is a silent no-op — reject it
+            raise SystemExit("--autotune-budget bounds the generated-"
+                             "candidate search of --autotune: combine "
+                             "with --autotune")
+        if autotune_budget is not None and autotune_budget < 1:
+            raise SystemExit("--autotune-budget must be >= 1")
         self.autotune = autotune
+        #: trial budget for the generated-candidate search (ops.templates
+        #: spaces); None = flat enumeration of hand-written variants only
+        self.autotune_budget = autotune_budget
         #: serve-only mode: skip training, expose the (typically
         #: snapshot-restored) model over HTTP on this port (0 = auto)
         if serve is not None and (pp or fused or listen or master):
@@ -530,7 +542,8 @@ class Launcher(Logger):
                         f"--autotune: {type(self.workflow).__name__} has "
                         "no fused step (StandardWorkflow-family only)")
                 self.workflow.initialize(device=self.device, **kwargs)
-                tune_rep = self.workflow.autotune()
+                tune_rep = self.workflow.autotune(
+                    budget=self.autotune_budget)
                 self.info("autotune: %s", {
                     op: f"{r['variant']} ({r['source']})"
                     for op, r in sorted(tune_rep.items())})

@@ -1,20 +1,35 @@
-"""Persistent autotuner over the lowering-variant registry.
+"""Persistent autotuner over the lowering-variant registry, plus the
+budgeted search over GENERATED candidates (ops.templates).
 
-For each tunable op a workflow actually contains, time every registered
-candidate lowering IN-GRAPH — a short donated `train_repeat` microbench of
-the whole fused step, the same scanned hot loop bench.py measures — pick
-the fastest, `variants.select()` it, and persist the decision in an
-on-disk JSON cache keyed by (device_kind, op, shapes, dtypes,
-params-hash, compute_dtype). A cache hit selects the stored winner with
-ZERO tuning cost; corrupt or missing cache files degrade to re-tuning,
-never to an error. On CPU the pallas candidates run in interpret mode, so
-the whole subsystem is tier-1-testable without a chip.
+Two tiers, one cache:
 
-Entry points: `autotune_workflow(wf)` (also exposed as
-`StandardWorkflow.autotune()` and the CLI's `--autotune`), and
-`tools/autotune.py` for the flagship AlexNet step — the systematic
-replacement for the hand-flipped `tools/ablate.py` / `ablate_lrn.py`
-one-offs.
+1. Flat enumeration (PR 2): for each tunable op a workflow contains,
+   time every registered hand-written candidate IN-GRAPH — a short
+   donated `train_repeat` microbench of the whole fused step, the same
+   scanned hot loop bench.py measures — pick the fastest.
+2. Budgeted search (`budget=N` / CLI `--autotune-budget N`): ops with a
+   registered `KernelTemplate` get coordinate descent over the template
+   config space, seeded from the hand-written incumbents, spending a
+   trial budget ordered by the per-op cost shares in LAYER_PROFILE.json
+   (tools/layer_profile.py — where the roofline gap lives). Every
+   generated candidate must carry a PASSING ops.reference equivalence
+   record (ops.templates ledger) BEFORE it is timeable — `_timed_trial`
+   refuses ungated candidates structurally. Trials route through the
+   telemetry plane: `veles_autotune_trials_total{op,outcome}` and a
+   per-trial span when `--trace` is live.
+
+Decisions persist in an on-disk JSON cache keyed by (device_kind, op,
+config-hash, compute_dtype), schema-versioned: a mismatched or corrupt
+cache logs once and re-tunes, never errors. A cache hit selects the
+stored winner with ZERO timing cost (generated winners re-materialize
+from their name). On CPU the pallas candidates run in interpret mode, so
+the whole subsystem — search included — is tier-1-testable without a
+chip.
+
+Entry points: `autotune_workflow(wf)` (= `StandardWorkflow.autotune()` =
+CLI `--autotune [--autotune-budget N]`), `search_workflow` (budgeted
+search incl. ops below the unit graph: flash_attn, sgd_update), and
+`tools/autotune.py [--budget N]` for the flagship AlexNet step.
 """
 
 from __future__ import annotations
@@ -24,13 +39,14 @@ import hashlib
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from veles_tpu.logger import Logger
 from veles_tpu.ops import variants
 
 __all__ = ["AutotuneCache", "autotune_workflow", "discover_tunables",
-           "op_cache_key", "default_cache_path"]
+           "op_cache_key", "default_cache_path", "search_workflow",
+           "search_op", "priority_order", "default_profile_path"]
 
 
 def default_cache_path() -> str:
@@ -41,11 +57,16 @@ def default_cache_path() -> str:
 
 class AutotuneCache(Logger):
     """On-disk JSON decision cache. Flat {key: record} mapping; records
-    carry the winning variant plus the timings that chose it. A corrupt
-    or unreadable file behaves as empty (the tuner re-times and the next
-    `put` rewrites it atomically)."""
+    carry the winning variant plus the timings (and, for searched ops,
+    the trial trace) that chose it. The file is explicitly schema-tagged
+    (`{"schema": ..., "version": ...}`): a corrupt file, an unknown
+    schema or a version skew (old cache under new code or vice versa)
+    logs ONCE and behaves as empty — the tuner re-times and the next
+    `put` rewrites the file atomically at the current version. Never an
+    error."""
 
-    VERSION = 1
+    SCHEMA = "veles-autotune"
+    VERSION = 2
 
     def __init__(self, path: Optional[str] = None) -> None:
         super().__init__()
@@ -59,13 +80,20 @@ class AutotuneCache(Logger):
             with open(self.path) as f:
                 raw = json.load(f)
             entries = raw.get("entries")
-            if raw.get("version") != self.VERSION \
+            if raw.get("schema", self.SCHEMA) != self.SCHEMA \
+                    or raw.get("version") != self.VERSION \
                     or not isinstance(entries, dict):
-                raise ValueError("unrecognized cache layout")
+                raise ValueError(
+                    f"schema/version skew (want {self.SCHEMA} "
+                    f"v{self.VERSION}, file says "
+                    f"{raw.get('schema', '<none>')} "
+                    f"v{raw.get('version')})")
             self._data = entries
         except FileNotFoundError:
             self._data = {}
         except (OSError, ValueError, AttributeError) as e:
+            # once per cache object: _data caches the empty dict, so a
+            # long tuning session doesn't spam this per get()
             self.warning("autotune cache %s unreadable (%s): re-tuning",
                          self.path, e)
             self._data = {}
@@ -81,8 +109,8 @@ class AutotuneCache(Logger):
         tmp = f"{self.path}.tmp.{os.getpid()}"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(tmp, "w") as f:
-            json.dump({"version": self.VERSION, "entries": data}, f,
-                      indent=1, sort_keys=True)
+            json.dump({"schema": self.SCHEMA, "version": self.VERSION,
+                       "entries": data}, f, indent=1, sort_keys=True)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)   # atomic: readers never see a torn file
@@ -189,20 +217,42 @@ def apply_cached(wf, *, compute_dtype=None,
     """Select previously persisted winners for this workflow's tunable
     ops WITHOUT any timing (cache hits only; misses keep the current
     selection). The cheap way for bench/serving runs to inherit a
-    tuning session's decisions. Returns {op: variant} of what applied."""
+    tuning session's decisions — searched winners included: per op the
+    SEARCHED key (workflow sigs + template space signature) is probed
+    first, then the flat-tuner key, and the template-only ops below the
+    unit graph (flash_attn, sgd_update) apply by their space key.
+    Generated winners re-materialize from their cached name. Returns
+    {op: variant} of what applied."""
     import jax
+
+    from veles_tpu.ops import templates
 
     if not getattr(wf, "is_initialized", False):
         wf.initialize(device=None)
     cache = cache or AutotuneCache(cache_path)
     device_kind = jax.devices()[0].device_kind
     compute_dtype = _resolve_compute_dtype(compute_dtype)
-    applied: Dict[str, str] = {}
+    keys: Dict[str, List[str]] = {}
     for op, sigs in discover_tunables(wf).items():
-        hit = cache.get(op_cache_key(device_kind, op, sigs, compute_dtype))
-        if hit is not None and variants.has(op, hit.get("variant")):
-            variants.select(op, hit["variant"])
-            applied[op] = hit["variant"]
+        ks = []
+        space = templates.space_signature(op)
+        if space:
+            ks.append(op_cache_key(device_kind, op, sigs + space,
+                                   compute_dtype))
+        ks.append(op_cache_key(device_kind, op, sigs, compute_dtype))
+        keys[op] = ks
+    for op in templates.template_ops():
+        keys.setdefault(op, [op_cache_key(
+            device_kind, op, templates.space_signature(op),
+            compute_dtype)])
+    applied: Dict[str, str] = {}
+    for op, ks in keys.items():
+        for key in ks:
+            hit = cache.get(key)
+            if hit is not None and variants.has(op, hit.get("variant")):
+                variants.select(op, hit["variant"])
+                applied[op] = hit["variant"]
+                break
     return applied
 
 
@@ -212,16 +262,24 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
                       cache: Optional[AutotuneCache] = None,
                       cache_path: Optional[str] = None,
                       force: bool = False,
-                      ops: Optional[List[str]] = None
+                      ops: Optional[List[str]] = None,
+                      budget: Optional[int] = None,
+                      profile_path: Optional[str] = None
                       ) -> Dict[str, Dict[str, Any]]:
     """Tune every tunable op the workflow contains; leave the winners
     selected in the registry; return a per-op report:
 
-        {op: {"variant": name, "source": "cache"|"tuned",
+        {op: {"variant": name, "source": "cache"|"tuned"|"searched",
               "timings_s": {...}(tuned only), "key": cache-key}}
 
     Ops are tuned sequentially, each candidate timed with every OTHER op
     held at its current selection. `force=True` re-times cache hits.
+
+    With `budget=N` (CLI `--autotune-budget N`), ops that have a
+    registered template (ops.templates) switch from flat enumeration to
+    the budgeted coordinate-descent search over GENERATED candidates,
+    priority-ordered and budget-weighted by the per-op cost shares in
+    LAYER_PROFILE.json; ops without a template keep the enumeration.
     """
     import jax
 
@@ -235,10 +293,33 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
     if ops:
         tunables = {k: v for k, v in tunables.items() if k in ops}
     report: Dict[str, Dict[str, Any]] = {}
+    searchable: List[str] = []
+    if budget:
+        from veles_tpu.ops import templates
+        searchable = [op for op in tunables
+                      if templates.templates_for(op)
+                      and op in templates.CONTRACTS]
+        if "sgd_update" in templates.CONTRACTS \
+                and any(not getattr(g, "optimizer", "sgd") == "adam"
+                        for g in getattr(wf, "gds", ())):
+            # the fused step's SGD leg resolves the sgd_update registry
+            # op (FusedTrainStep._sgd_variant), so its template space
+            # belongs in this workflow's search even though no forward
+            # unit names it — timed via the template microbench
+            searchable.append("sgd_update")
+    if searchable:
+        # ONE search implementation: delegate the template-backed ops
+        # to search_workflow (priority order, budget split, in-graph
+        # timing) instead of re-implementing its loop here
+        report.update(search_workflow(
+            wf, ops=searchable, budget=budget, cache=cache,
+            compute_dtype=compute_dtype, profile_path=profile_path,
+            mesh=mesh, steps=steps, repeats=repeats, batch=batch,
+            force=force))
     ctx = variants.pallas_interpret() if on_cpu \
         else contextlib.nullcontext()
     with ctx:
-        for op in sorted(tunables):
+        for op in sorted(set(tunables) - set(searchable)):
             key = op_cache_key(device_kind, op, tunables[op],
                                compute_dtype)
             hit = None if force else cache.get(key)
@@ -247,8 +328,12 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
                 report[op] = {"variant": hit["variant"],
                               "source": "cache", "key": key}
                 continue
+            # the flat enumeration is the CLOSED hand-written set:
+            # generated (template-materialized) variants only enter
+            # through the budgeted search, never the enumeration — a
+            # prior search in this process must not widen this path
             cands = [v.name for v in variants.variants_for(op)
-                     if v.tunable
+                     if v.tunable and not v.generated
                      and (not v.pallas or variants.pallas_ok())]
             prev = variants.selected(op)
             timings: Dict[str, Any] = {}
@@ -282,4 +367,324 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
                             "steps": steps, "tuned_at": time.time()})
             report[op] = {"variant": winner, "source": "tuned",
                           "timings_s": rounded, "key": key}
+    return report
+
+
+# ===========================================================================
+# Budgeted search over generated candidates (ops.templates)
+# ===========================================================================
+
+
+def default_profile_path() -> str:
+    return os.environ.get("VELES_LAYER_PROFILE_PATH",
+                          "LAYER_PROFILE.json")
+
+
+def priority_order(ops: List[str],
+                   profile_path: Optional[str] = None
+                   ) -> List[tuple]:
+    """[(op, share), ...] most-expensive-first, from the per-op cost
+    shares tools/layer_profile.py persists (LAYER_PROFILE.json, env
+    VELES_LAYER_PROFILE_PATH; on chip the PR-7 `--profile-window`
+    capture feeds the same file). Ops the profile doesn't name keep
+    their relative order with share 0 — no profile degrades to the
+    given order, never to an error. This is how the budget is spent on
+    the ops that own the roofline gap (ROOFLINE.md)."""
+    shares: Dict[str, float] = {}
+    path = profile_path or default_profile_path()
+    try:
+        with open(path) as f:
+            prof = json.load(f)
+        raw = prof.get("ops", {})
+        shares = {str(k): float(v) for k, v in raw.items()
+                  if isinstance(v, (int, float))}
+    except (OSError, ValueError, AttributeError):
+        pass
+    return sorted(((op, shares.get(op, 0.0)) for op in ops),
+                  key=lambda kv: -kv[1])
+
+
+def incumbent_floor(op: str) -> int:
+    """Per-op minimum trials: every hand-written incumbent plus at
+    least one generated point. Without this, an op with 2+ incumbents
+    (flash_attn: xla_mha + pallas) at a zero profile share would spend
+    its whole floor on incumbents and never probe its space."""
+    hand = [v for v in variants.variants_for(op)
+            if v.tunable and not v.generated]
+    return len(hand) + 1
+
+
+def allocate_budget(ordered: List[tuple], budget: int,
+                    floors: Optional[Dict[str, int]] = None
+                    ) -> Dict[str, int]:
+    """Split a total trial budget across ops proportionally to their
+    profile shares, with a per-op floor (`floors`, default 2; the
+    search passes `incumbent_floor`) so a zero-share op still gets its
+    incumbents timed AND at least one generated point probed."""
+    if not ordered:
+        return {}
+
+    def floor_of(op: str) -> int:
+        return max(1, (floors or {}).get(op, 2))
+
+    total_share = sum(s for _, s in ordered)
+    out: Dict[str, int] = {}
+    remaining = budget - sum(floor_of(op) for op, _ in ordered)
+    if remaining < 0:
+        # budget too small to floor everyone: highest-share ops win
+        left = budget
+        for op, _ in ordered:
+            out[op] = min(floor_of(op), left)
+            left -= out[op]
+        return out
+    for op, share in ordered:
+        frac = (share / total_share) if total_share > 0 \
+            else 1.0 / len(ordered)
+        out[op] = floor_of(op) + int(remaining * frac)
+    # hand leftover integer-division trials to the highest-share op
+    leak = budget - sum(out.values())
+    if leak > 0:
+        out[ordered[0][0]] += leak
+    return out
+
+
+def _trials_counter():
+    """veles_autotune_trials_total{op,outcome} on the one PR-7 metrics
+    registry; lazily bound (the search is not a hot path — velint's
+    hot-metric rule does not apply here)."""
+    from veles_tpu.telemetry import metrics as tm
+    return tm.default_registry().counter(
+        "veles_autotune_trials_total",
+        "budgeted-search candidate evaluations by outcome "
+        "(timed / equiv_fail / error)", labelnames=("op", "outcome"))
+
+
+def search_op(op: str, *, budget: int,
+              cache: Optional[AutotuneCache] = None,
+              cache_path: Optional[str] = None,
+              compute_dtype: Any = None,
+              force: bool = False, repeats: int = 2,
+              workflow_sigs: Optional[List[Dict]] = None,
+              in_graph_timer: Optional[Callable[[], float]] = None
+              ) -> Dict[str, Any]:
+    """Budgeted coordinate-descent search over one op's candidate set:
+    the hand-written tunable variants first (the incumbents), then the
+    template config space, moving one axis at a time from the template
+    seed. Every candidate is gated through the ops.reference equivalence
+    ledger BEFORE timing — `_timed_trial` raises on an ungated name, so
+    the search is structurally unable to time an unverified point.
+    Winner is selected in the registry and persisted (with the full
+    trial trace) under the same per-(device_kind, op, config-hash,
+    compute_dtype) key family as the flat tuner.
+
+    `in_graph_timer` times the CURRENT registry selection inside the
+    caller's fused step (the PR-2 protocol — pass a closure over
+    `_time_variant`); without one, the template's microbench times the
+    candidate's `apply` directly (ops below the unit graph: flash_attn,
+    sgd_update)."""
+    import jax
+
+    from veles_tpu.ops import templates
+    cache = cache or AutotuneCache(cache_path)
+    device_kind = jax.devices()[0].device_kind
+    compute_dtype = _resolve_compute_dtype(compute_dtype)
+    sigs = list(workflow_sigs or []) + templates.space_signature(op)
+    key = op_cache_key(device_kind, op, sigs, compute_dtype)
+    hit = None if force else cache.get(key)
+    if hit is not None and variants.has(op, hit.get("variant")):
+        variants.select(op, hit["variant"])
+        return {"variant": hit["variant"], "source": "cache",
+                "key": key, "trials": 0}
+    if budget < 1:
+        # a too-small total budget can allocate an op zero trials:
+        # that is a SKIP (current selection stands), not an error —
+        # the tool's report must not read like a failed tune
+        return {"variant": variants.effective(op), "source": "skipped",
+                "key": key, "trials": 0, "trace": [], "budget": budget}
+
+    from veles_tpu.telemetry import tracer as vtrace
+    counter = _trials_counter()
+    prev = variants.selected(op)
+    timings: Dict[str, float] = {}
+    trace: List[Dict[str, Any]] = []
+    state = {"trials": 0}
+
+    def _timed_trial(name: str) -> float:
+        """Time ONE gated candidate. The ledger check is the structural
+        gate: no passing equivalence record, no timing — ever."""
+        if not templates.passed(op, name):
+            raise templates.UngatedCandidateError(
+                f"{op}/{name}: refusing to time a candidate with no "
+                "passing ops.reference equivalence record")
+        if in_graph_timer is not None:
+            variants.select(op, name)
+            return in_graph_timer()
+        return templates.bench_candidate(
+            op, variants.get(op, name).apply, repeats)
+
+    def trial(name: str) -> Optional[float]:
+        """Evaluate one candidate (gate, then time). None = skipped
+        (dup / budget exhausted / failed); seconds otherwise. Every
+        evaluation — including equivalence failures — consumes budget:
+        the budget bounds WORK, not successes."""
+        if name in timings \
+                or any(t["variant"] == name for t in trace):
+            return timings.get(name)
+        if state["trials"] >= budget:
+            return None
+        state["trials"] += 1
+        rec: Dict[str, Any] = {"variant": name}
+        with vtrace.span(f"autotune.trial:{op}/{name}", "autotune"):
+            try:
+                eq = templates.check_equivalence(op, name)
+                if eq["status"] != "pass":
+                    rec.update(outcome="equiv_fail",
+                               error=eq.get("error", ""))
+                    counter.labels(op=op, outcome="equiv_fail").inc()
+                else:
+                    t = _timed_trial(name)
+                    timings[name] = t
+                    rec.update(outcome="timed", time_s=round(t, 6))
+                    counter.labels(op=op, outcome="timed").inc()
+            except templates.UngatedCandidateError:
+                raise   # structural bug, never swallowed as a trial error
+            except Exception as e:  # noqa: BLE001 — one broken candidate
+                # (a backend-rejected kernel) must not abort the search
+                rec.update(outcome="error", error=f"{e!s:.200}")
+                counter.labels(op=op, outcome="error").inc()
+        trace.append(rec)
+        return timings.get(name)
+
+    # 1. incumbents: the hand-written tunable variants seed the search
+    for v in variants.variants_for(op):
+        if v.tunable and not v.generated \
+                and (not v.pallas or variants.pallas_ok()):
+            trial(v.name)
+
+    # 2. coordinate descent per template, from the template's seed.
+    # Under microbench timing, configs that alias to the SAME effective
+    # kernel at the bench shapes (template.bench_key — flash's fit()
+    # clamp) are skipped after the first: the budget times distinct
+    # kernels and the cached winner names a config that truly executed.
+    seen_bench: Dict[Any, str] = {}
+
+    def gen_trial(t, cfg) -> Optional[float]:
+        name = t.name(cfg)
+        if in_graph_timer is None and t.bench_key is not None:
+            bk = t.bench_key(cfg)
+            if seen_bench.setdefault(bk, name) != name:
+                return None          # aliases an already-tried point
+        return trial(name)
+
+    for t in templates.templates_for(op):
+        cur = dict(t.seed)
+        best_t = gen_trial(t, cur)
+        improved = True
+        while improved and state["trials"] < budget:
+            improved = False
+            for axis in t.axes:
+                if state["trials"] >= budget:
+                    break
+                best_choice = cur[axis.name]
+                for c in axis.choices:
+                    if c == best_choice:
+                        continue
+                    tt = gen_trial(t, {**cur, axis.name: c})
+                    if tt is not None and (best_t is None
+                                           or tt < best_t):
+                        best_t, improved = tt, True
+                        cur[axis.name] = c
+        # descent converged: spend the REMAINING budget exploring
+        # still-unseen points of the space in deterministic order (the
+        # budget bounds work; leaving trials unspent would just narrow
+        # coverage for free) — duplicates/aliases skip without cost
+        for cfg in t.configs():
+            if state["trials"] >= budget:
+                break
+            gen_trial(t, cfg)
+
+    if not timings:
+        if prev is None:
+            variants.clear_selection(op)
+        else:
+            variants.select(op, prev)
+        return {"variant": variants.effective(op), "source": "error",
+                "trace": trace, "key": key, "trials": state["trials"]}
+
+    winner = min(timings, key=timings.get)
+    variants.select(op, winner)
+    win_v = variants.get(op, winner)
+    cfg = None
+    if win_v.generated:
+        for t in templates.templates_for(op):
+            cfg = t.parse(winner)
+            if cfg is not None:
+                break
+    record = {
+        "variant": winner, "config": cfg,
+        "timings_s": {k: round(v, 6) for k, v in timings.items()},
+        "trace": trace,
+        "equivalence": {t_["variant"]: ("fail" if t_["outcome"]
+                                        == "equiv_fail" else "pass")
+                        for t_ in trace},
+        "budget": budget, "trials": state["trials"],
+        "timer": "in_graph" if in_graph_timer is not None
+        else "microbench",
+        "device_kind": device_kind, "repeats": repeats,
+        "tuned_at": time.time(),
+    }
+    cache.put(key, record)
+    return {**record, "source": "searched", "key": key}
+
+
+def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
+                    budget: int = 32,
+                    cache: Optional[AutotuneCache] = None,
+                    cache_path: Optional[str] = None,
+                    compute_dtype: Any = None,
+                    profile_path: Optional[str] = None,
+                    mesh=None, steps: int = 4, repeats: int = 2,
+                    batch: Optional[int] = None,
+                    force: bool = False) -> Dict[str, Dict[str, Any]]:
+    """Budgeted search across every template-backed op: workflow ops
+    (lrn, …) time IN-GRAPH through `wf`'s fused step, ops below the unit
+    graph (flash_attn, sgd_update) through their template microbench.
+    Priority order and budget split come from LAYER_PROFILE.json. The
+    per-op reports include the full trial trace; winners are selected
+    and persisted like any autotune decision."""
+    import jax
+
+    from veles_tpu.ops import templates
+    cache = cache or AutotuneCache(cache_path)
+    # an explicitly EMPTY ops list means "search nothing" (an --ops
+    # restriction that names no template op) — only None means "all"
+    all_ops = templates.template_ops() if ops is None else list(ops)
+    all_ops = [op for op in all_ops
+               if templates.templates_for(op)
+               and op in templates.CONTRACTS]
+    wf_sigs: Dict[str, List[Dict]] = {}
+    if wf is not None:
+        if not getattr(wf, "is_initialized", False):
+            wf.initialize(device=None)
+        wf_sigs = discover_tunables(wf)
+    on_cpu = jax.default_backend() == "cpu"
+    ordered = priority_order(all_ops, profile_path)
+    shares = allocate_budget(
+        ordered, budget,
+        floors={op: incumbent_floor(op) for op, _ in ordered})
+    report: Dict[str, Dict[str, Any]] = {}
+    ctx = variants.pallas_interpret() if on_cpu \
+        else contextlib.nullcontext()
+    with ctx:
+        for op, share in ordered:
+            timer = None
+            if wf is not None and op in wf_sigs:
+                timer = (lambda: _time_variant(
+                    wf, mesh, compute_dtype, steps, repeats, batch))
+            report[op] = search_op(
+                op, budget=shares[op], cache=cache,
+                compute_dtype=compute_dtype, force=force,
+                repeats=repeats, workflow_sigs=wf_sigs.get(op),
+                in_graph_timer=timer)
+            report[op]["priority_share"] = share
     return report

@@ -26,6 +26,30 @@ from jax.experimental.pallas import tpu as pltpu
 
 _FORCE_INTERPRET = False  # tests set this on CPU
 
+# ---------------------------------------------------------------------------
+# Tuning-axis defaults and hardware bounds. Every per-kernel block/tile
+# choice below is a PARAMETER fed from the template config spaces in
+# ops/templates.py (the budgeted autotuner searches them); these module
+# constants are the documented seeds/bounds of those spaces, not
+# per-call-site magic numbers (velint rule `pallas-magic-number` keeps it
+# that way).
+# ---------------------------------------------------------------------------
+
+#: VPU/MXU lane width — hardware-fixed, NOT a tuning axis
+_LANE = 128
+#: f32 min sublane tile: the floor every row blocking is clamped to
+_MIN_ROW_TILE = 8
+#: LRN row-tile heuristic bounds: start at the min sublane tile, stop
+#: growing at ~1MB VMEM blocks (see _lrn_call docstring)
+_LRN_TILE_MAX = 4096
+_LRN_VMEM_BLOCK_BYTES = 1 << 20
+#: fused-SGD row blocking seed (the pre-search hand-written value)
+_SGD_ROW_TILE = 8
+#: flash-attention block seeds (tuned by hand on v5e 2026-07-29; the
+#: search explores the full blk_q x blk_k x kv_order space around them)
+_FLASH_BLK_Q = 512
+_FLASH_BLK_K = 1024
+
 
 def available() -> bool:
     """True when the default backend can run compiled Pallas TPU kernels."""
@@ -62,16 +86,17 @@ def _sgd_kernel(p_ref, g_ref, v_ref, scal_ref, p_out, v_out):
     p_out[:] = p_ref[:] + v_new
 
 
-def sgd_update_pallas(p, g, v, lr: float, momentum: float = 0.0,
-                      weight_decay: float = 0.0):
+def sgd_update_pallas(p, g, v, lr, momentum=0.0, weight_decay=0.0,
+                      row_tile: int = _SGD_ROW_TILE):
     """Returns (p_new, v_new). Shapes arbitrary; computed as a flattened
-    (rows, 128) grid with one row-block per program."""
+    (rows, 128) grid with one row-block per program. `row_tile` is the
+    row blocking (a searched tuning axis — ops/templates.py); the
+    scalars may be traced (the fused step passes a scheduled lr)."""
     shape, dtype = p.shape, p.dtype
     n = p.size
-    lane = 128
-    cols = lane
+    cols = _LANE
     rows = -(-n // cols)
-    row_tile = 8
+    row_tile = max(_MIN_ROW_TILE, int(row_tile))
     padded = rows + ((-rows) % row_tile)
 
     def flat(a):
@@ -80,7 +105,9 @@ def sgd_update_pallas(p, g, v, lr: float, momentum: float = 0.0,
         return a.reshape(padded, cols).astype(jnp.float32)
 
     p2, g2, v2 = flat(p), flat(g), flat(v)
-    scal = jnp.asarray([lr, momentum, weight_decay], jnp.float32)
+    scal = jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(momentum, jnp.float32),
+                      jnp.asarray(weight_decay, jnp.float32)])
     grid = (padded // row_tile,)
     spec = pl.BlockSpec((row_tile, cols), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
@@ -134,27 +161,42 @@ def _lrn_bwd_kernel(x_ref, e_ref, out_ref, *, half: int, k: float,
                   - 2.0 * alpha * beta * x * tsum).astype(out_ref.dtype)
 
 
-def _lrn_call(kernel, args, c: int, k, alpha, beta, n: int):
+def _lrn_row_tile(n_rows: int, c: int, itemsize: int) -> int:
+    """The hand-written heuristic: grow the tile until blocks reach
+    ~1MB of VMEM. Conv-activation LRN inputs have a few hundred thousand
+    rows (AlexNet L1: 1024·55·55), so a min-sublane tile dies of grid
+    overhead (measured 3.5× slower than XLA); large tiles amortize it."""
+    rt = _MIN_ROW_TILE
+    while rt < _LRN_TILE_MAX and rt * 2 <= max(n_rows, _MIN_ROW_TILE) \
+            and rt * 2 * c * itemsize <= _LRN_VMEM_BLOCK_BYTES:
+        rt *= 2
+    return rt
+
+
+def _lrn_call(kernel, args, c: int, k, alpha, beta, n: int,
+              row_tile: Optional[int] = None, io_dtype: str = "native"):
     """Common wrapper: flatten leading dims to rows, one row-block per
     program, full channel width per block (windows stay in-block).
 
-    HBM traffic is the whole game (LRN is bandwidth-bound): blocks move
-    in the caller's dtype (bf16 under the fused step — HALF the bytes of
-    the old force-f32 wrapper) and are promoted to f32 only inside VMEM.
+    HBM traffic is the whole game (LRN is bandwidth-bound). The two
+    tuning axes the search owns (ops/templates.py):
+    - `row_tile`: rows per block; None = the ~1MB-VMEM heuristic
+      (_lrn_row_tile), which is the hand-written incumbent.
+    - `io_dtype`: "native" moves blocks in the caller's dtype (bf16
+      under the fused step — HALF the bytes of the old force-f32
+      wrapper) and promotes to f32 only inside VMEM; "f32" stages
+      f32 blocks through HBM (more traffic, no in-kernel casts).
     Scalars are compile-time constants (lets the pow decompose into
-    sqrt/rsqrt — see _pow_neg). Row tile sized for ~1MB VMEM blocks:
-    conv-activation LRN inputs have a few hundred thousand rows (AlexNet
-    L1: 1024·55·55), so an 8-row tile dies of grid overhead (measured
-    3.5× slower than XLA); large tiles amortize it."""
+    sqrt/rsqrt — see _pow_neg)."""
     x = args[0]
     rows_shape = x.shape[:-1]
-    x2s = [a.reshape(-1, c) for a in args]
+    blk_dt = jnp.float32 if io_dtype == "f32" else x.dtype
+    x2s = [a.reshape(-1, c).astype(blk_dt) for a in args]
     n_rows = x2s[0].shape[0]
-    itemsize = max(jnp.dtype(x.dtype).itemsize, 2)
-    row_tile = 8
-    while row_tile < 4096 and row_tile * 2 <= max(n_rows, 8) \
-            and row_tile * 2 * c * itemsize <= 1024 * 1024:
-        row_tile *= 2
+    if row_tile is None:
+        itemsize = max(jnp.dtype(blk_dt).itemsize, 2)
+        row_tile = _lrn_row_tile(n_rows, c, itemsize)
+    row_tile = max(_MIN_ROW_TILE, int(row_tile))
     x2s_p, rows = zip(*(_pad_rows(a, row_tile) for a in x2s))
     padded = x2s_p[0].shape[0]
     spec = pl.BlockSpec((row_tile, c), lambda i: (i, 0),
@@ -162,41 +204,51 @@ def _lrn_call(kernel, args, c: int, k, alpha, beta, n: int):
     out = pl.pallas_call(
         functools.partial(kernel, half=n // 2, k=float(k),
                           alpha=float(alpha), beta=float(beta)),
-        out_shape=jax.ShapeDtypeStruct((padded, c), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((padded, c), blk_dt),
         grid=(padded // row_tile,),
         in_specs=[spec] * len(x2s_p),
         out_specs=spec,
         interpret=_interpret(),
     )(*x2s_p)
-    return out[:rows[0]].reshape(rows_shape + (c,))
+    return out[:rows[0]].reshape(rows_shape + (c,)).astype(x.dtype)
 
 
 def lrn_forward_pallas(x, k: float = 2.0, alpha: float = 1e-4,
-                       beta: float = 0.75, n: int = 5):
-    return _lrn_call(_lrn_fwd_kernel, (x,), x.shape[-1], k, alpha, beta, n)
+                       beta: float = 0.75, n: int = 5,
+                       row_tile: Optional[int] = None,
+                       io_dtype: str = "native"):
+    return _lrn_call(_lrn_fwd_kernel, (x,), x.shape[-1], k, alpha, beta,
+                     n, row_tile=row_tile, io_dtype=io_dtype)
 
 
 def lrn_backward_pallas(x, err_y, k: float = 2.0, alpha: float = 1e-4,
-                        beta: float = 0.75, n: int = 5):
+                        beta: float = 0.75, n: int = 5,
+                        row_tile: Optional[int] = None,
+                        io_dtype: str = "native"):
     return _lrn_call(_lrn_bwd_kernel, (x, err_y), x.shape[-1],
-                     k, alpha, beta, n)
+                     k, alpha, beta, n, row_tile=row_tile,
+                     io_dtype=io_dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
 def lrn_pallas(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
-               n: int = 5):
+               n: int = 5, row_tile: Optional[int] = None,
+               io_dtype: str = "native"):
     """Differentiable fused LRN: Pallas forward AND backward (one VMEM
     pass each vs several XLA reduce_windows). Measured on v5e 2026-07-29:
-    LRN was ~26% of the AlexNet fused-step time on the XLA path."""
-    return lrn_forward_pallas(x, k, alpha, beta, n)
+    LRN was ~26% of the AlexNet fused-step time on the XLA path.
+    `row_tile`/`io_dtype` are the searched tuning axes (both passes use
+    the same point — one decision per candidate)."""
+    return lrn_forward_pallas(x, k, alpha, beta, n, row_tile, io_dtype)
 
 
-def _lrn_fwd_rule(x, k, alpha, beta, n):
-    return lrn_forward_pallas(x, k, alpha, beta, n), x
+def _lrn_fwd_rule(x, k, alpha, beta, n, row_tile, io_dtype):
+    return lrn_forward_pallas(x, k, alpha, beta, n, row_tile, io_dtype), x
 
 
-def _lrn_bwd_rule(k, alpha, beta, n, x, g):
-    return (lrn_backward_pallas(x, g, k, alpha, beta, n),)
+def _lrn_bwd_rule(k, alpha, beta, n, row_tile, io_dtype, x, g):
+    return (lrn_backward_pallas(x, g, k, alpha, beta, n, row_tile,
+                                io_dtype),)
 
 
 lrn_pallas.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
@@ -208,15 +260,21 @@ lrn_pallas.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
+                  m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                  reverse_kv: bool = False):
     """Grid (B·H, q_blocks, k_blocks) with KV innermost: each step streams
     ONE (blk_k, d) K/V tile through VMEM (O(blk) footprint — long-context
     safe) and folds it into the online-softmax scratch; the last KV step
     writes the normalized output block plus the per-row logsumexp (the
-    backward's softmax residual)."""
+    backward's softmax residual). `reverse_kv` visits KV tiles
+    last-to-first (the index map streams tile nk−1−t at step t) — the
+    online softmax is order-invariant, so numerics match to fp rounding;
+    the axis exists for the search to probe prefetch locality."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    # the KV tile actually resident this step (≠ ki under reverse_kv)
+    kt = (nk - 1 - ki) if reverse_kv else ki
     q = q_ref[0]                      # (blk_q, d)
     kb = k_ref[0]                     # (blk_k, d)
     vb = v_ref[0]
@@ -233,12 +291,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             q_idx = qi * blk_q \
                 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            k_idx = ki * blk_k \
+            k_idx = kt * blk_k \
                 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(k_idx <= q_idx, s, -1e30)
         m = m_scr[:]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if causal:
+            # a row whose visited tiles are ALL masked so far has
+            # m_new == -1e30, where exp(s - m_new) = 1, not 0 — only
+            # reachable under reverse_kv (forward order always sees the
+            # k_idx == q_idx entry first); guard is free under fwd
+            p = jnp.where(s <= -1e29, 0.0, p)
         a = jnp.exp(m - m_new)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * a + p.sum(axis=1, keepdims=True)
@@ -249,7 +313,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # a KV tile whose first key is beyond this Q tile's last query is
         # fully masked — skip its two dots entirely (~half the grid at
         # large S; this is the hot path the kernel exists for)
-        pl.when(ki * blk_k <= qi * blk_q + blk_q - 1)(compute)
+        pl.when(kt * blk_k <= qi * blk_q + blk_q - 1)(compute)
     else:
         compute()
 
@@ -359,16 +423,27 @@ def _kspec(blk_k, d):
                         memory_space=pltpu.VMEM)
 
 
-def _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k):
-    """(B·H, S, D) f32 in -> (out, lse); lse is (B·H, S, 1)."""
+def _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
+                    kv_order: str = "fwd"):
+    """(B·H, S, D) f32 in -> (out, lse); lse is (B·H, S, 1). `kv_order`
+    "rev" streams KV tiles last-to-first (searched axis)."""
     bh, s, d = qf.shape
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
+    rev = kv_order == "rev"
+    nk = s // blk_k
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               reverse_kv=rev)
+    if rev:
+        kvspec = pl.BlockSpec((1, blk_k, d),
+                              lambda b, i, t: (b, nk - 1 - t, 0),
+                              memory_space=pltpu.VMEM)
+    else:
+        kvspec = _kspec(blk_k, d)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)),
-        grid=(bh, s // blk_q, s // blk_k),
-        in_specs=[_qspec(blk_q, d), _kspec(blk_k, d), _kspec(blk_k, d)],
+        grid=(bh, s // blk_q, nk),
+        in_specs=[_qspec(blk_q, d), kvspec, kvspec],
         out_specs=(_qspec(blk_q, d), _qspec(blk_q, 1)),
         scratch_shapes=[
             pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
@@ -380,17 +455,19 @@ def _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attn(qf, kf, vf, scale, causal, blk_q, blk_k):
-    return _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attn(qf, kf, vf, scale, causal, blk_q, blk_k, kv_order):
+    return _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
+                           kv_order)[0]
 
 
-def _flash_attn_fwd(qf, kf, vf, scale, causal, blk_q, blk_k):
-    out, lse = _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k)
+def _flash_attn_fwd(qf, kf, vf, scale, causal, blk_q, blk_k, kv_order):
+    out, lse = _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
+                               kv_order)
     return out, (qf, kf, vf, out, lse)
 
 
-def _flash_attn_bwd(scale, causal, blk_q, blk_k, res, do):
+def _flash_attn_bwd(scale, causal, blk_q, blk_k, kv_order, res, do):
     qf, kf, vf, out, lse = res
     bh, s, d = qf.shape
     do = do.astype(jnp.float32)
@@ -434,8 +511,9 @@ _flash_attn.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 
 def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
-                           causal: bool = False, blk_q: int = 512,
-                           blk_k: int = 1024):
+                           causal: bool = False, blk_q: int = _FLASH_BLK_Q,
+                           blk_k: int = _FLASH_BLK_K,
+                           kv_order: str = "fwd"):
     """Intra-chip blocked attention, DIFFERENTIABLE (custom-VJP pair of
     Pallas kernels). q/k/v: (B, S, H, D) -> (B, S, H, D). Requires
     S % 128 == 0 (pad upstream). Grid (B·H, S/blk_q, S/blk_k), KV
@@ -445,7 +523,9 @@ def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
     forward), dK/dV streams Q tiles on the transposed grid. Forward block
     defaults tuned on v5e (2026-07-29: 22 ms vs 51 ms for the XLA einsum
     path at B1·S16384·H8·D64 causal — 2.3× — while small-S workloads
-    should just use ops.attention)."""
+    should just use ops.attention). `blk_q`/`blk_k`/`kv_order` are the
+    searched tuning axes (ops/templates.py); kv_order applies to the
+    forward's KV streaming (the backward keeps its own fixed orders)."""
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -466,5 +546,5 @@ def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
     out = _flash_attn(heads_first(q).astype(jnp.float32),
                       heads_first(k).astype(jnp.float32),
                       heads_first(v).astype(jnp.float32),
-                      float(scale), causal, blk_q, blk_k)
+                      float(scale), causal, blk_q, blk_k, kv_order)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -388,6 +388,46 @@ def lrn_backward(x: np.ndarray, err_y: np.ndarray, k: float = 2.0,
 
 
 # ---------------------------------------------------------------------------
+# fused SGD+momentum update (parity: veles/znicz/nn_units.py weight-update
+# kernels; the golden for the `sgd_update` lowering variants)
+# ---------------------------------------------------------------------------
+
+def sgd_momentum_update(p: np.ndarray, g: np.ndarray, v: np.ndarray,
+                        lr: float, momentum: float = 0.0,
+                        weight_decay: float = 0.0,
+                        l1_decay: float = 0.0):
+    """One leaf of the reference update rule:
+    v ← μ·v − lr·(g + λ2·w + λ1·sign(w));  w ← w + v."""
+    reg = g + weight_decay * p + l1_decay * np.sign(p)
+    v_new = momentum * v - lr * reg
+    return p + v_new, v_new
+
+
+# ---------------------------------------------------------------------------
+# multi-head attention (NO 2015 parity — the reference framework has no
+# attention anywhere, SURVEY.md §5.7; this numpy model is the golden the
+# `flash_attn` lowering variants are equivalence-gated against)
+# ---------------------------------------------------------------------------
+
+def mha_forward(q: np.ndarray, k: np.ndarray, v: np.ndarray,
+                scale: float = None, causal: bool = False) -> np.ndarray:
+    """Plain softmax attention in numpy. q/k/v: (B, S, H, D) ->
+    (B, S, H, D)."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    sc = np.einsum("bqhd,bkhd->bhqk", q, k).astype(np.float64) * scale
+    if causal:
+        mask = np.tril(np.ones((s, s), bool))
+        sc = np.where(mask[None, None], sc, -np.inf)
+    sc -= sc.max(axis=-1, keepdims=True)
+    p = np.exp(sc)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float64)) \
+        .astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
 # dropout (parity: veles/znicz/dropout.py)
 # ---------------------------------------------------------------------------
 

@@ -53,13 +53,16 @@ class Variant:
     (see the per-op sections below); `pallas` marks lowerings that need a
     compiled Pallas path (gated by `pallas_ok()`, interpret mode on CPU);
     `tunable=False` marks resolution-only pseudo-variants (e.g. dropout
-    "auto") the autotuner must not time as candidates."""
+    "auto") the autotuner must not time as candidates; `generated=True`
+    marks template-materialized candidates (ops.templates) — search-
+    produced points whose name encodes their config."""
 
     op: str
     name: str
     apply: Callable[..., Any]
     pallas: bool = False
     tunable: bool = True
+    generated: bool = False
     doc: str = ""
 
 
@@ -112,18 +115,29 @@ def _spec(op: str) -> _OpSpec:
                        f"(registered: {sorted(_OPS)})") from None
 
 
-def get(op: str, name: str) -> Variant:
+def _lookup(op: str, name: Any) -> Optional[Variant]:
+    """Registered variant, or a template point materialized on demand —
+    the path a persisted generated-winner name takes in a fresh process
+    (ops.templates names are parseable back into their config)."""
     spec = _spec(op)
-    try:
-        return spec.variants[name]
-    except KeyError:
+    v = spec.variants.get(name)
+    if v is None and isinstance(name, str) and "[" in name:
+        from veles_tpu.ops import templates
+        v = templates.materialize(op, name)
+    return v
+
+
+def get(op: str, name: str) -> Variant:
+    v = _lookup(op, name)
+    if v is None:
         raise KeyError(
             f"unknown variant {name!r} for op {op!r} "
-            f"(registered: {sorted(spec.variants)})") from None
+            f"(registered: {sorted(_spec(op).variants)})")
+    return v
 
 
 def has(op: str, name: Any) -> bool:
-    return op in _OPS and name in _OPS[op].variants
+    return op in _OPS and _lookup(op, name) is not None
 
 
 def select(op: str, name: str) -> None:
@@ -351,6 +365,60 @@ register(Variant("grad_reduce", "bf16", _grad_reduce_bf16,
                      "back in the gradient dtype; equivalence contract "
                      "at the trained-loss tolerance stated in "
                      "docs/SCALING.md"))
+
+
+# -- blocked flash attention (intra-chip tile loop) -------------------------
+#    apply(q, k, v, scale=None, causal=False) -> (B, S, H, D);
+#    differentiable (the pallas variants are custom-VJP kernel pairs).
+#    MultiHeadAttention consults resolve("flash_attn") on its local path
+#    when the flash gate says long-S beats the einsum; generated
+#    candidates over blk_q x blk_k x kv_order come from ops.templates.
+
+def _flash_xla_mha(q, k, v, scale=None, causal=False):
+    from veles_tpu.ops import attention as oa
+    return oa.mha_forward(q, k, v, scale=scale, causal=causal)
+
+
+def _flash_pallas(q, k, v, scale=None, causal=False):
+    from veles_tpu.ops import pallas_kernels as pk
+    return pk.flash_attention_pallas(q, k, v, scale=scale, causal=causal)
+
+
+register_op(
+    "flash_attn", default="pallas", fallback="xla_mha",
+    doc="intra-chip blocked attention for long-S local heads (2.3x the "
+        "XLA einsum at S=16384 on v5e); the generated candidates search "
+        "blk_q/blk_k/KV-stream order")
+register(Variant("flash_attn", "xla_mha", _flash_xla_mha,
+                 doc="the einsum golden model (ops.attention.mha_forward"
+                     "); right for short S — O(S^2) score matrix"))
+register(Variant("flash_attn", "pallas", _flash_pallas, pallas=True,
+                 doc="hand-written incumbent: blk 512/1024, forward KV "
+                     "order (= templates seed)"))
+
+
+# -- fused SGD weight update (the step's optimizer leg) ---------------------
+#    apply(params, grads, vel, cfg, lr_scale=1.0, mults=None) ->
+#    (new_params, new_vel), one LAYER pytree at a time (the fused step
+#    resolves this per layer in _apply_update; ZeRO keeps its own
+#    slice-wise path). Generated pallas candidates block the flattened
+#    (rows, 128) update grid by rows (ops.templates).
+
+def _sgd_xla_tree(params, grads, vel, cfg, lr_scale=1.0, mults=None):
+    from veles_tpu.ops import optim
+    return optim.sgd_update(params, grads, vel, cfg, lr_scale=lr_scale,
+                            mults=mults)
+
+
+register_op(
+    "sgd_update", default="xla_tree", fallback="xla_tree",
+    doc="fused SGD+momentum+weight-decay update; XLA fuses the tree "
+        "rule into the backward, the pallas candidates trade that for "
+        "one explicit VMEM pass over 3 buffers with searched row "
+        "blocking")
+register(Variant("sgd_update", "xla_tree", _sgd_xla_tree,
+                 doc="per-leaf jnp rule (ops.optim.sgd_update); fuses "
+                     "into the compiled step"))
 
 
 # -- dropout mask RNG -------------------------------------------------------

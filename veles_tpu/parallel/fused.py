@@ -696,14 +696,33 @@ class FusedTrainStep:
             out.append(red)
         return tuple(out)
 
+    def _sgd_variant(self):
+        """The sgd_update registry variant this step traces — ONE
+        resolution rule for the update itself (_apply_update) and the
+        reported table (variant_table), so a record can never name a
+        variant the step didn't trace. GSPMD falls back: a pallas_call
+        cannot be auto-partitioned (same gate as the unit path)."""
+        import types
+
+        from veles_tpu.ops import variants
+        return variants.resolve(
+            "sgd_update",
+            unit=types.SimpleNamespace(
+                allow_pallas=self.mode != "gspmd"))
+
     def _apply_update(self, state, grads):
         """One optimizer step from already-reduced grads; advances the
         carried key identically on every shard (fold_in of the *unfolded*
         state key keeps it replicated). Under ZeRO the grads arrive
         UNREDUCED per-shard partials and the sharded update performs the
-        reduction itself (reduce-scatter)."""
+        reduction itself (reduce-scatter). The SGD leg resolves through
+        the `sgd_update` registry op (default xla_tree IS
+        optim.sgd_update; the search-generated pallas row-blocked
+        candidates slot in when selected — GSPMD falls back, a
+        pallas_call cannot be auto-partitioned)."""
         if self.zero_active:
             return self._apply_update_zero(state, grads)
+        sgd_apply = self._sgd_variant().apply
         new_params, new_vel = [], []
         for p, g, v, cfg in zip(state["params"], grads, state["vel"],
                                 self.cfgs):
@@ -711,8 +730,8 @@ class FusedTrainStep:
                 np_, nv_ = optim.adam_update(p, g, v, cfg,
                                              lr_scale=state["lr_scale"])
             elif p:
-                np_, nv_ = optim.sgd_update(p, g, v, cfg,
-                                            lr_scale=state["lr_scale"])
+                np_, nv_ = sgd_apply(p, g, v, cfg,
+                                     lr_scale=state["lr_scale"])
             else:
                 np_, nv_ = p, v
             new_params.append(np_)
@@ -1193,6 +1212,11 @@ class FusedTrainStep:
             # instead (see _apply_update_zero) — no registry op runs,
             # so reporting one would fabricate provenance.
             table["grad_reduce"] = variants.resolve("grad_reduce").name
+        if not self.zero_active and any(
+                isinstance(c, optim.SGDConfig) for c in self.cfgs):
+            # the replicated SGD leg resolves through the registry (see
+            # _apply_update); ZeRO's slice-wise update does not.
+            table["sgd_update"] = self._sgd_variant().name
         return table
 
     def evaluate(self, state, x, y, w=None):

@@ -20,6 +20,7 @@ import numpy as np
 
 from veles_tpu.memory import Array
 from veles_tpu.ops import attention as oa
+from veles_tpu.ops import variants
 from veles_tpu.znicz.nn_units import (Forward, GradientDescentVJP,
                                       register_gd)
 
@@ -28,6 +29,11 @@ class MultiHeadAttention(Forward):
     """Self-attention block: input (N, S, E) -> output (N, S, E).
     Params: wq/wk/wv (E, H·D), wo (H·D, E). `parallel_mode` selects the
     in-mesh kernel for the fused path: "local" | "ring" | "ulysses"."""
+
+    #: lowering-variant registry op the LOCAL long-S path consults at
+    #: trace time (candidates: xla_mha | pallas | the search-generated
+    #: pallas[blk_q=..,blk_k=..,kv_order=..] points from ops.templates)
+    variant_op = "flash_attn"
 
     def __init__(self, workflow=None, n_heads: int = 4,
                  head_dim: int = None, causal: bool = True,
@@ -81,12 +87,53 @@ class MultiHeadAttention(Forward):
     def _flash_ok(self, s: int) -> bool:
         if self.use_flash == "off":
             return False
-        from veles_tpu.ops import pallas_kernels as pk
         if self.use_flash == "on":
             return True
-        # auto: long sequences on a real TPU; the kernel fits its blocks
-        # to any S divisible by 128
-        return pk.available() and s >= 4096 and s % 128 == 0
+        # auto: long sequences where a pallas path can run (a real TPU,
+        # or interpret mode — the CPU autotune/search context); the
+        # kernel fits its blocks to any S divisible by 128
+        return variants.pallas_ok() and s >= 4096 and s % 128 == 0
+
+    def _flash_variant(self):
+        """The registry variant the local long-S path traces. use_flash
+        ="on" forces the effective selection past the pallas_ok() gate
+        (interpreter-mode tests drive the kernel on CPU); "auto" resolves
+        normally, so GSPMD (allow_pallas cleared by the step) and
+        pallas-less backends fall back to the einsum."""
+        if self.use_flash == "on" and getattr(self, "allow_pallas", True):
+            return variants.get("flash_attn",
+                                variants.effective("flash_attn"))
+        return variants.resolve("flash_attn", unit=self)
+
+    def variant_signature(self):
+        """Autotune cache-key payload (None = not tunable as configured:
+        per-unit override, non-local parallel mode, flash forced off, or
+        a sequence the flash gate would never route to the kernel).
+        Batch dim excluded — tune-then-inherit, like every op."""
+        if getattr(self, "variant_override", None) is not None \
+                or not self.input:
+            return None
+        if self.parallel_mode != "local" or self.use_flash == "off":
+            return None
+        n, s, e = self.input.shape
+        if self.use_flash != "on" \
+                and not (s >= 4096 and s % 128 == 0):
+            return None
+        return {"sample_shape": [s, e], "heads": self.n_heads,
+                "head_dim": self.head_dim, "causal": self.causal}
+
+    def variant_effective(self):
+        """The flash_attn variant this unit would actually trace — the
+        einsum path when the gate keeps the kernel out — or None when no
+        flash decision exists for this configuration (sequence-parallel
+        modes run the ring/Ulysses kernels)."""
+        if self.parallel_mode != "local" \
+                or self.seq_axis_name is not None or not self.input:
+            return None
+        s = self.input.shape[1]
+        if not self._flash_ok(s):
+            return "xla_mha"
+        return self._flash_variant().name
 
     # -- pure forward ---------------------------------------------------------
 
@@ -111,12 +158,14 @@ class MultiHeadAttention(Forward):
         k = (x @ params["wk"]).reshape(n, s, h, d)
         v = (x @ params["wv"]).reshape(n, s, h, d)
         if axis_name is None or self.parallel_mode == "local":
-            # the Pallas kernel is a custom-VJP fwd/bwd pair, so the
-            # differentiated fused/GD paths use it too when the gate says
-            # it beats the XLA einsum (long S on a real TPU)
+            # the Pallas kernels are custom-VJP fwd/bwd pairs, so the
+            # differentiated fused/GD paths use them too when the gate
+            # says long S beats the XLA einsum. WHICH kernel (hand-
+            # written blocks or a search-generated point) is the
+            # registry's call at trace time.
             if allow_flash and self._flash_ok(s):
-                from veles_tpu.ops import pallas_kernels as pk
-                o = pk.flash_attention_pallas(q, k, v, causal=self.causal)
+                o = self._flash_variant().apply(q, k, v,
+                                                causal=self.causal)
             else:
                 o = oa.mha_forward(q, k, v, causal=self.causal)
         elif self.parallel_mode == "ring":
