@@ -757,6 +757,11 @@ def test_verify_workflow_cli_clean_sample():
         capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "verify-workflow: 0 error(s)" in out.stdout
+    # the ISSUE-10 concurrency section: passes 4/5 run over the
+    # installed package and report through the same findings stream
+    # (0 on the shipped tree — the empty-baseline contract)
+    assert "concurrency pass over the installed package " \
+           "(0 finding(s))" in out.stdout
 
 
 def test_verify_workflow_cli_audit_mode():

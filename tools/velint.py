@@ -1,8 +1,12 @@
 #!/usr/bin/env python
-"""velint — the project lint gate (analysis pass 3; docs/ANALYSIS.md).
+"""velint — the project static gate (analysis passes 3-5;
+docs/ANALYSIS.md).
 
-Default run lints `veles_tpu/` + `tools/` and exits nonzero on ANY
-unsuppressed finding. `--ci` is the ratchet gate: it compares against
+Default run lints `veles_tpu/` + `tools/` + `bench.py` — the per-file
+AST rules (pass 3), the whole-program concurrency pass (pass 4:
+shared-state races, lock-order cycles, wait-under-lock) and the
+protocol pass (pass 5: HTTP endpoint token/body contracts, thread-owner
+stop() teardown) — and exits nonzero on ANY unsuppressed finding. `--ci` is the ratchet gate: it compares against
 the checked-in baseline (`tools/velint_baseline.json`) and fails only on
 NEW findings, so a legacy finding never blocks an unrelated PR while a
 fresh one always does. `--write-baseline` regenerates the baseline from
@@ -27,7 +31,15 @@ import sys
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
+from veles_tpu.analysis import concurrency  # noqa: E402
 from veles_tpu.analysis import lint  # noqa: E402
+from veles_tpu.analysis import protocol  # noqa: E402
+
+#: the gate's passes: the per-file AST lint plus the whole-program
+#: concurrency (shared-state races, lock order) and protocol (endpoint
+#: contracts, thread-owner teardown) passes — ONE findings stream, one
+#: ratchet baseline, one suppression syntax
+PASSES = ("lint", "concurrency", "protocol")
 
 #: bench.py rides along since the sync-feed rule exists exactly to keep
 #: step-driver loops (the bench protocol included) on the DeviceFeed
@@ -58,6 +70,9 @@ def main(argv=None) -> int:
     paths = args.paths or [os.path.join(_REPO_ROOT, d)
                            for d in DEFAULT_PATHS]
     findings = lint.lint_paths(paths, root=_REPO_ROOT)
+    findings += concurrency.analyze_paths(paths, root=_REPO_ROOT)
+    findings += protocol.analyze_paths(paths, root=_REPO_ROOT)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     if args.write_baseline:
         lint.write_baseline(args.baseline, findings)
@@ -75,6 +90,7 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps({"findings": [f.as_dict() for f in reported],
                           "total": len(findings),
+                          "passes": list(PASSES),
                           "new": len(reported) if args.ci else None}))
     else:
         for f in reported:

@@ -1,7 +1,7 @@
 """Static analysis for veles_tpu: make wiring, tracing and hot-path
 mistakes checkable BEFORE anything runs — on CPU, in CI.
 
-Three passes (docs/ANALYSIS.md has the full rule catalogue):
+Five passes (docs/ANALYSIS.md has the full rule catalogue):
 
 - `graph`  — workflow-graph verifier over a constructed `Workflow`
   (dangling/shadowed aliases, AND-gate cycles, unreachable units,
@@ -12,14 +12,22 @@ Three passes (docs/ANALYSIS.md has the full rule catalogue):
   retrace hazards). `jax.make_jaxpr` only: no compile, no devices.
 - `lint`   — `velint`, the project AST lint (`tools/velint.py --ci` is
   the ratchet-only CI gate).
+- `concurrency` — whole-program thread-root/race analysis, lock-order
+  cycle detection, wait-under-lock (rides the velint gate).
+- `protocol` — HTTP endpoint contracts (shared token, bounded bodies)
+  and the project-wide thread-owner stop() teardown contract (rides
+  the velint gate).
 
-`findings.Finding` is the shared record all passes emit. `graph`/`lint`
-import without jax; `trace` is loaded lazily so import-light consumers
-(the supervisor's exit report) can guard it.
+`findings.Finding` is the shared record the workflow-facing passes
+emit; `concurrency`/`protocol` emit `lint.LintFinding` so they share
+velint's baseline and suppression machinery. `graph`/`lint`/
+`concurrency`/`protocol` import without jax; `trace` is loaded lazily
+so import-light consumers (the supervisor's exit report) can guard it.
 """
 
 from __future__ import annotations
 
+from veles_tpu.analysis import concurrency, protocol  # noqa: F401
 from veles_tpu.analysis.findings import (SEV_ERROR, SEV_WARN,  # noqa: F401
                                          Finding, errors, summarize)
 from veles_tpu.analysis.graph import (WorkflowVerifyError,  # noqa: F401

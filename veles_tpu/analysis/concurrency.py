@@ -1,0 +1,943 @@
+"""Concurrency analysis (pass 4): shared-state races and lock order.
+
+VELES's workflow engine is thread-heavy by heritage — DeviceFeed rides a
+PrefetchingLoader thread pool, Supervisor/ClusterMember run heartbeat
+loops, task_queue leases work to threaded workers, the telemetry tracer
+appends from every thread, and five stdlib HTTP planes serve on
+`ThreadingHTTPServer` daemon threads. Every review pass since PR 4 has
+hand-caught the same concurrency bug classes; this pass mechanizes them
+as a whole-program AST analysis (no execution, no jax — importable by
+the velint CLI):
+
+- `shared-write-no-lock` (error): build a THREAD-ROOT graph per class —
+  `Thread(target=self.m)` / `threading.Timer(..., self.m)` targets,
+  `executor.submit(self.m)` callees, nested `BaseHTTPRequestHandler`
+  `do_*` methods (mapped to the outer class through the `outer = self`
+  closure idiom), plus the implicit "main" root (public methods the
+  owning thread calls) — and compute per-root attribute read/write
+  sets with lock-context propagation. A mutable attribute written from
+  one root and read/written from another (or written from a
+  self-concurrent root: handler/pool entries run on many threads at
+  once) with an EMPTY common lock guard is flagged.
+- `lock-order-cycle` (error): a global lock-acquisition-order graph —
+  an edge A -> B whenever B is acquired while A is held (nested `with`
+  blocks, propagated through intra-class helper calls) — with Tarjan
+  SCC detection. Any cycle (including a self-loop: re-acquiring a
+  non-reentrant `Lock` you already hold) is a potential deadlock.
+- `wait-holding-lock` (error): `x.wait(...)` on a condition/event while
+  holding a DIFFERENT lock — the waiter blocks every other thread that
+  needs that lock, including the one that would have signalled.
+
+Guard-inference model (documented in docs/ANALYSIS.md, tested in
+tests/test_concurrency_analysis.py):
+
+- A lock is an attribute assigned `threading.Lock()/RLock()/Condition()/
+  Semaphore()` anywhere in the class, or whose name looks lock-ish
+  (`lock`, `mutex`, `cond`, `cv`, `sem`). `with self.X:` (including
+  through a closure alias `lk = self._lock`) puts X in the held set;
+  helper methods called under the `with` inherit it — so a helper that
+  only ever runs under one lock is correctly treated as guarded.
+- Setup happens-before: accesses in `__init__`/`__setstate__`/
+  `__getstate__`/`initialize`/`load_data` (and in private methods
+  called ONLY from those), plus accesses lexically BEFORE the first
+  thread-creation/start in a thread-creating method, precede
+  concurrency and are exempt.
+- Flag publication: attributes whose every post-setup write is a bare
+  `True`/`False`/`None` constant (stop flags, tombstones) are exempt —
+  a single GIL-atomic reference store.
+- Thread-safe types: attributes holding `Lock`/`Event`/`Condition`/
+  `Semaphore`/`Queue`/`SimpleQueue`/`Barrier` objects are exempt (their
+  methods carry their own synchronization).
+
+Known blind spots (by design — static, per-class):
+- cross-OBJECT lock nesting (a method holding its lock calling into
+  another object that locks) is not tracked; `Condition.wait()`
+  releasing its lock inside a `with` is not modeled;
+- attributes reached via `getattr(self, "name")`, dict aliases mutated
+  through a second alias hop, and monkey-patched methods are invisible;
+- only MODULE-TOP-LEVEL classes are analyzed (plus nested
+  `BaseHTTPRequestHandler` handlers, which map to their outer class):
+  a thread-owning class defined inside a factory function or another
+  class body is skipped;
+- happens-before edges other than the setup heuristics above (e.g. a
+  write after `join()`) are not proven — suppress with justification
+  (`# velint: disable=shared-write-no-lock`) when the ordering is real.
+
+Findings are `lint.LintFinding` records so they ride `tools/velint.py
+--ci` (same ratchet baseline, same `# velint: disable=` suppressions);
+`lock_order_edges_source`/`lock_order_edges_paths` expose the static
+order graph for the runtime witness fixture in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from veles_tpu.analysis.lint import (LintFinding, _attr_chain,
+                                     _suppressed, read_py_files)
+
+RULES: Dict[str, str] = {
+    "shared-write-no-lock": "attribute written from one thread root and "
+                            "accessed from another with no common lock "
+                            "guard",
+    "lock-order-cycle": "locks acquired in inconsistent nested order "
+                        "(potential deadlock; Tarjan cycle over the "
+                        "acquisition-order graph)",
+    "wait-holding-lock": ".wait() on a condition/event while holding a "
+                         "different lock (blocks the signaller)",
+}
+
+_LOCK_NAME_RE = re.compile(r"lock|mutex|mtx|(^|_)cond|(^|_)cv($|_|\d)|sem",
+                           re.IGNORECASE)
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+#: ctors whose instances synchronize internally — attrs holding one are
+#: exempt from the race rule
+_SAFE_CTORS = _LOCK_CTORS + ("Event", "Queue", "SimpleQueue", "LifoQueue",
+                             "PriorityQueue", "Barrier", "local")
+#: method names that MUTATE their receiver (container write)
+_MUTATORS = frozenset((
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "update", "add",
+    "setdefault", "sort", "reverse", "rotate"))
+#: methods that run before any thread exists (the framework contract:
+#: construct/unpickle/initialize happen on the owning thread, before
+#: produce pools / servers are started)
+_SETUP_METHODS = frozenset(("__init__", "__new__", "__setstate__",
+                            "__getstate__", "__del__", "initialize",
+                            "load_data"))
+_THREAD_CTOR_LEAVES = ("Thread", "Timer")
+_HANDLER_BASE = "BaseHTTPRequestHandler"
+
+#: env marker: a local name aliasing the enclosing instance (`outer =
+#: self`, `srv = self`)
+_SELF = ("self",)
+
+
+# == project model ============================================================
+
+@dataclass
+class ClassModel:
+    name: str
+    path: str
+    node: ast.ClassDef
+    bases: List[str]
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    #: nested BaseHTTPRequestHandler classes declared inside a method:
+    #: (handler ClassDef, alias env of the enclosing method)
+    handlers: List[Tuple[ast.ClassDef, Dict[str, object]]] = \
+        field(default_factory=list)
+
+
+@dataclass
+class Project:
+    #: top-level classes: name -> [ClassModel] (collisions kept)
+    by_name: Dict[str, List[ClassModel]] = field(default_factory=dict)
+    classes: List[ClassModel] = field(default_factory=list)
+    #: path -> source lines (suppression checks)
+    lines: Dict[str, List[str]] = field(default_factory=dict)
+
+
+def _base_names(node: ast.ClassDef) -> List[str]:
+    out = []
+    for b in node.bases:
+        chain = _attr_chain(b)
+        if chain:
+            out.append(chain.rsplit(".", 1)[-1])
+    return out
+
+
+def _method_env(fn: ast.AST) -> Dict[str, object]:
+    """Closure aliases a nested handler class captures from its
+    enclosing method: `outer = self` -> _SELF, `workers = self.workers`
+    -> ("attr", "workers")."""
+    env: Dict[str, object] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        v = node.value
+        if isinstance(v, ast.Name) and v.id == "self":
+            env[t.id] = _SELF
+        elif isinstance(v, ast.Attribute) \
+                and isinstance(v.value, ast.Name) and v.value.id == "self":
+            env[t.id] = ("attr", v.attr)
+    return env
+
+
+def collect_project(files: Dict[str, str]) -> Project:
+    """Parse `files` (path -> source) into the class table the passes
+    share. Files that fail to parse are skipped (velint reports the
+    syntax error separately)."""
+    proj = Project()
+    for path, source in sorted(files.items()):
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        proj.lines[path] = source.splitlines()
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cm = ClassModel(node.name, path, node, _base_names(node))
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    cm.methods[item.name] = item
+                    for sub in ast.walk(item):
+                        if isinstance(sub, ast.ClassDef) \
+                                and _HANDLER_BASE in _base_names(sub):
+                            cm.handlers.append((sub, _method_env(item)))
+            proj.by_name.setdefault(cm.name, []).append(cm)
+            proj.classes.append(cm)
+    return proj
+
+
+def method_chains(cm: ClassModel, proj: Project,
+                  _seen: Optional[Set[int]] = None
+                  ) -> Dict[str, List[Tuple[ast.FunctionDef, str]]]:
+    """Flattened method table (name -> [(funcdef, defining path), ...]
+    base-first): bases left-to-right (same-module preferred on name
+    collisions), subclass definitions appended last — a linear MRO
+    approximation good enough for this codebase's hierarchies. The last
+    entry is the effective method; the one before it is what that
+    method's `super().m()` reaches."""
+    if _seen is None:
+        _seen = set()
+    if id(cm) in _seen:
+        return {}
+    _seen.add(id(cm))
+    out: Dict[str, List[Tuple[ast.FunctionDef, str]]] = {}
+    for bname in cm.bases:
+        cands = proj.by_name.get(bname) or []
+        if not cands:
+            continue
+        base = next((c for c in cands if c.path == cm.path), cands[0])
+        for name, chain in method_chains(base, proj, _seen).items():
+            out.setdefault(name, []).extend(
+                e for e in chain if e not in out.get(name, []))
+    for name, fn in cm.methods.items():
+        out.setdefault(name, []).append((fn, cm.path))
+    return out
+
+
+def flat_methods(cm: ClassModel, proj: Project
+                 ) -> Dict[str, Tuple[ast.FunctionDef, str]]:
+    """The effective (post-override) method table."""
+    return {name: chain[-1]
+            for name, chain in method_chains(cm, proj).items()}
+
+
+# == per-class analysis =======================================================
+
+@dataclass
+class _Access:
+    attr: str
+    kind: str                 # "read" | "write"
+    root: str
+    locks: frozenset
+    path: str
+    line: int
+    constant: bool = False    # write of a bare True/False/None
+    setup: bool = False
+
+
+@dataclass
+class _Root:
+    rid: str
+    fn: ast.AST
+    path: str
+    env: Dict[str, object]
+    self_name: Optional[str]   # None inside handler methods
+    handler: Optional[ast.ClassDef] = None
+    concurrent: bool = False   # runs on many threads at once
+
+
+def _first_arg(fn) -> Optional[str]:
+    for dec in getattr(fn, "decorator_list", ()):
+        if _attr_chain(dec).rsplit(".", 1)[-1] == "staticmethod":
+            return None
+    args = fn.args.args
+    return args[0].arg if args else None
+
+
+def _is_const_flag(value: ast.AST) -> bool:
+    return isinstance(value, ast.Constant) \
+        and value.value in (True, False, None)
+
+
+class _ClassAnalysis:
+    """One flattened class: roots, accesses, lock edges, waits."""
+
+    def __init__(self, cm: ClassModel, proj: Project) -> None:
+        self.cm = cm
+        self.proj = proj
+        self.method_chain = method_chains(cm, proj)
+        self.methods = {n: c[-1] for n, c in self.method_chain.items()}
+        self.handler_methods: Dict[int, Dict[str, ast.FunctionDef]] = {
+            id(h): {m.name: m for m in h.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+            for h, _env in cm.handlers}
+        self.lock_attrs: Dict[str, str] = {}    # attr -> ctor leaf
+        self.safe_attrs: Set[str] = set()
+        self._infer_attr_types()
+        self.spawn_line: Dict[int, int] = {}    # id(fn) -> first spawn
+        self.roots: List[_Root] = self._find_roots()
+        self.accesses: Dict[str, List[_Access]] = {}
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.waits: List[Tuple[str, frozenset, str, int]] = []
+        self.root_concurrent: Dict[str, bool] = {
+            r.rid: r.concurrent for r in self.roots}
+
+    # -- attribute typing -----------------------------------------------------
+
+    def _infer_attr_types(self) -> None:
+        for _name, (fn, _path) in self.methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == _first_arg(fn)):
+                    continue
+                if isinstance(node.value, ast.Call):
+                    leaf = _attr_chain(node.value.func).rsplit(".", 1)[-1]
+                    if leaf in _LOCK_CTORS:
+                        self.lock_attrs[t.attr] = leaf
+                        self.safe_attrs.add(t.attr)
+                    elif leaf in _SAFE_CTORS:
+                        self.safe_attrs.add(t.attr)
+
+    # -- root discovery -------------------------------------------------------
+
+    def _find_roots(self) -> List[_Root]:
+        roots: List[_Root] = []
+        entry_methods: Set[str] = set()
+        for name, (fn, path) in self.methods.items():
+            locals_ = {n.name: n for n in ast.walk(fn)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and n is not fn}
+            self_name = _first_arg(fn)
+            env = _method_env(fn)
+            ctor_lines: List[int] = []
+            start_lines: List[int] = []
+            for node, in_loop in _walk_with_loops(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = _attr_chain(node.func).rsplit(".", 1)[-1]
+                if leaf in ("start", "start_thread"):
+                    start_lines.append(node.lineno)
+                target = None
+                if leaf in _THREAD_CTOR_LEAVES:
+                    for kw in node.keywords:
+                        if kw.arg in ("target", "function"):
+                            target = kw.value
+                    if target is None and leaf == "Timer" \
+                            and len(node.args) >= 2:
+                        target = node.args[1]
+                    ctor_lines.append(node.lineno)
+                elif leaf == "submit" and node.args:
+                    target = node.args[0]
+                    ctor_lines.append(node.lineno)
+                    start_lines.append(node.lineno)
+                else:
+                    continue
+                concurrent = in_loop or leaf == "submit"
+                if isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name) \
+                        and (target.value.id == self_name
+                             or env.get(target.value.id) is _SELF) \
+                        and target.attr in self.methods:
+                    mfn, mpath = self.methods[target.attr]
+                    entry_methods.add(target.attr)
+                    roots.append(_Root(
+                        f"thread:{target.attr}", mfn, mpath,
+                        _method_env(mfn), _first_arg(mfn),
+                        concurrent=concurrent))
+                elif isinstance(target, ast.Name) \
+                        and target.id in locals_:
+                    roots.append(_Root(
+                        f"thread:{target.id}", locals_[target.id], path,
+                        env, self_name, concurrent=concurrent))
+            if ctor_lines:
+                # concurrency begins at the first `.start()`/`submit`,
+                # not at the Thread ctor: writes before the spawn are
+                # single-threaded publication and exempt
+                self.spawn_line[id(fn)] = min(start_lines or ctor_lines)
+        for hcls, henv in self.cm.handlers:
+            for m in self.handler_methods[id(hcls)].values():
+                if m.name.startswith("do_"):
+                    roots.append(_Root(
+                        f"handler:{hcls.name}.{m.name}", m, self.cm.path,
+                        henv, None, handler=hcls, concurrent=True))
+        if roots:
+            for name, (fn, path) in self.methods.items():
+                if name in _SETUP_METHODS or name in entry_methods \
+                        or name.startswith("_"):
+                    # private helpers are NOT independent entries: they
+                    # contribute through their callers' lock context
+                    # (a helper that only runs under one lock is thus
+                    # correctly treated as guarded); externally-invoked
+                    # privates are a documented blind spot
+                    continue
+                roots.append(_Root("main", fn, path, _method_env(fn),
+                                   _first_arg(fn)))
+        return roots
+
+    # -- the walker -----------------------------------------------------------
+
+    def run(self, races: bool = True) -> None:
+        """Visit every root (races + edges); for classes WITHOUT thread
+        roots, still walk every method for the lock-order graph."""
+        if self.roots:
+            for root in self.roots:
+                self._walk_root(root)
+        else:
+            for name, (fn, path) in self.methods.items():
+                root = _Root("main", fn, path, _method_env(fn),
+                             _first_arg(fn))
+                self._root = root
+                self._seen: Set[Tuple[int, frozenset]] = set()
+                self._record = False
+                self._enter_fn(fn, path, name, frozenset())
+            for hcls, henv in self.cm.handlers:
+                for m in self.handler_methods[id(hcls)].values():
+                    root = _Root(f"handler:{hcls.name}.{m.name}", m,
+                                 self.cm.path, henv, None, handler=hcls)
+                    self._root = root
+                    self._seen = set()
+                    self._record = False
+                    self._enter_fn(m, self.cm.path, m.name, frozenset())
+
+    def _walk_root(self, root: _Root) -> None:
+        self._root = root
+        self._seen = set()
+        self._record = True
+        name = getattr(root.fn, "name", root.rid)
+        self._enter_fn(root.fn, root.path, name, frozenset())
+
+    def _enter_fn(self, fn, path: str, mname: str,
+                  locks: frozenset) -> None:
+        key = (id(fn), locks)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        in_handler = (self._root.handler is not None
+                      and fn in self.handler_methods.get(
+                          id(self._root.handler), {}).values())
+        if fn is self._root.fn:
+            # root entry: closures inherit the enclosing method's
+            # self/env; handler entries see only the closure aliases
+            self_name, env = self._root.self_name, self._root.env
+        elif in_handler:
+            self_name, env = None, self._root.env
+        else:
+            self_name, env = _first_arg(fn), _method_env(fn)
+        ctx = {
+            "fn": fn, "path": path, "mname": mname,
+            "self": self_name, "env": env,
+            "setup": mname in _SETUP_METHODS,
+            "spawn": self.spawn_line.get(id(fn)),
+        }
+        self._stmts(fn.body, locks, ctx)
+
+    # resolution ---------------------------------------------------------------
+
+    def _chain(self, node, ctx) -> Optional[str]:
+        """Attr chain relative to the OUTER instance ('' -> None)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        if node.id == ctx["self"] and ctx["self"] is not None:
+            return ".".join(reversed(parts)) if parts else None
+        al = ctx["env"].get(node.id)
+        if al is _SELF:
+            return ".".join(reversed(parts)) if parts else None
+        if isinstance(al, tuple) and al[0] == "attr":
+            return ".".join([al[1]] + list(reversed(parts)))
+        return None
+
+    def _as_lock(self, expr, ctx) -> Optional[str]:
+        chain = self._chain(expr, ctx)
+        if not chain:
+            return None
+        head = chain.split(".", 1)[0]
+        if head in self.lock_attrs or _LOCK_NAME_RE.search(chain):
+            return chain
+        return None
+
+    # recording ----------------------------------------------------------------
+
+    def _rec(self, attr_chain: str, kind: str, node, locks, ctx,
+             constant: bool = False) -> None:
+        if not self._record:
+            return
+        attr = attr_chain.split(".", 1)[0]
+        if attr in self.safe_attrs:
+            return
+        setup = ctx["setup"] or (ctx["spawn"] is not None
+                                 and node.lineno < ctx["spawn"])
+        self.accesses.setdefault(attr, []).append(_Access(
+            attr, kind, self._root.rid, locks, ctx["path"],
+            node.lineno, constant, setup))
+
+    def _edge(self, held: frozenset, acquired: str, node, ctx) -> None:
+        me = f"{self.cm.name}.{acquired}"
+        for h in held:
+            self.edges.setdefault(
+                (f"{self.cm.name}.{h}", me),
+                (ctx["path"], node.lineno))
+
+    # statements ---------------------------------------------------------------
+
+    def _stmts(self, body, locks: frozenset, ctx) -> None:
+        for s in body:
+            self._stmt(s, locks, ctx)
+
+    def _stmt(self, s, locks: frozenset, ctx) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return                      # closures/nested: roots or skip
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            inner = locks
+            for item in s.items:
+                guard = self._as_lock(item.context_expr, ctx)
+                self._expr(item.context_expr, inner, ctx)
+                if guard is not None:
+                    self._edge(inner, guard, item.context_expr, ctx)
+                    inner = inner | {guard}
+            self._stmts(s.body, inner, ctx)
+            return
+        if isinstance(s, (ast.If, ast.While)):
+            self._expr(s.test, locks, ctx)
+            self._stmts(s.body, locks, ctx)
+            self._stmts(s.orelse, locks, ctx)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._target(s.target, locks, ctx)
+            self._expr(s.iter, locks, ctx)
+            self._stmts(s.body, locks, ctx)
+            self._stmts(s.orelse, locks, ctx)
+            return
+        if isinstance(s, ast.Try):
+            self._stmts(s.body, locks, ctx)
+            for h in s.handlers:
+                self._stmts(h.body, locks, ctx)
+            self._stmts(s.orelse, locks, ctx)
+            self._stmts(s.finalbody, locks, ctx)
+            return
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                self._target(t, locks, ctx, value=s.value)
+            self._expr(s.value, locks, ctx)
+            return
+        if isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._target(s.target, locks, ctx, value=s.value)
+                self._expr(s.value, locks, ctx)
+            return
+        if isinstance(s, ast.AugAssign):
+            chain = self._chain(s.target, ctx)
+            if chain:
+                self._rec(chain, "read", s, locks, ctx)
+                self._rec(chain, "write", s, locks, ctx)
+            self._expr(s.value, locks, ctx)
+            return
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                self._target(t, locks, ctx)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(child, locks, ctx)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, locks, ctx)
+
+    def _target(self, t, locks, ctx, value=None) -> None:
+        """A store/delete target: attribute -> write; subscript on an
+        attribute -> container write."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._target(el, locks, ctx, value=None)
+            return
+        if isinstance(t, ast.Subscript):
+            chain = self._chain(t.value, ctx)
+            if chain:
+                self._rec(chain, "write", t, locks, ctx)
+            else:
+                self._expr(t.value, locks, ctx)
+            self._expr(t.slice, locks, ctx)
+            return
+        if isinstance(t, ast.Name):
+            # a store to a local name — even one aliasing an attribute
+            # (`tr = self._tr`) — re-binds the LOCAL, not the attribute
+            return
+        chain = self._chain(t, ctx)
+        if chain:
+            self._rec(chain, "write", t, locks, ctx,
+                      constant=value is not None
+                      and _is_const_flag(value))
+
+    def _expr(self, e, locks: frozenset, ctx) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._call(e, locks, ctx)
+            return
+        if isinstance(e, ast.Attribute):
+            chain = self._chain(e, ctx)
+            if chain:
+                self._rec(chain, "read", e, locks, ctx)
+                return
+            self._expr(e.value, locks, ctx)
+            return
+        if isinstance(e, ast.Name):
+            al = ctx["env"].get(e.id)
+            if isinstance(al, tuple) and al[0] == "attr" \
+                    and al[1] not in self.methods:
+                self._rec(al[1], "read", e, locks, ctx)
+            return
+        if isinstance(e, (ast.Lambda,)):
+            return                      # deferred body: blind spot
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, locks, ctx)
+
+    def _call(self, node: ast.Call, locks: frozenset, ctx) -> None:
+        fnode = node.func
+        leaf = fnode.attr if isinstance(fnode, ast.Attribute) else (
+            fnode.id if isinstance(fnode, ast.Name) else "")
+        # handler-internal helper: self.m() where self is the HANDLER
+        if self._root.handler is not None \
+                and isinstance(fnode, ast.Attribute) \
+                and isinstance(fnode.value, ast.Name) \
+                and fnode.value.id == "self":
+            hm = self.handler_methods.get(id(self._root.handler), {})
+            if fnode.attr in hm:
+                for a in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    self._expr(a, locks, ctx)
+                self._enter_fn(hm[fnode.attr], ctx["path"],
+                               fnode.attr, locks)
+                return
+        # super().m(...): the definition the final override shadows
+        # (linear-MRO approximation — one super hop, which is all this
+        # codebase uses)
+        if isinstance(fnode, ast.Attribute) \
+                and isinstance(fnode.value, ast.Call) \
+                and isinstance(fnode.value.func, ast.Name) \
+                and fnode.value.func.id == "super":
+            mchain = self.method_chain.get(fnode.attr) or []
+            if len(mchain) >= 2:
+                for a in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    self._expr(a, locks, ctx)
+                mfn, mpath = mchain[-2]
+                self._enter_fn(mfn, mpath, fnode.attr, locks)
+                return
+        chain = self._chain(fnode, ctx)
+        if chain is not None and "." not in chain \
+                and chain in self.methods:
+            # intra-class call: propagate the held-lock context
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                self._expr(a, locks, ctx)
+            mfn, mpath = self.methods[chain]
+            self._enter_fn(mfn, mpath, chain, locks)
+            return
+        # aliased bound method (`clean = self._clean_beat`)
+        if isinstance(fnode, ast.Name):
+            al = ctx["env"].get(fnode.id)
+            if isinstance(al, tuple) and al[0] == "attr" \
+                    and al[1] in self.methods:
+                for a in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    self._expr(a, locks, ctx)
+                mfn, mpath = self.methods[al[1]]
+                self._enter_fn(mfn, mpath, al[1], locks)
+                return
+        if isinstance(fnode, ast.Attribute):
+            recv = self._chain(fnode.value, ctx)
+            if recv:
+                if leaf == "wait":
+                    # recorded regardless of thread roots: waiting
+                    # under someone else's lock is a hazard for
+                    # whichever thread ends up calling this
+                    others = frozenset(
+                        h for h in locks if h != recv
+                        and h.split(".", 1)[0] != recv.split(".", 1)[0])
+                    if others:
+                        self.waits.append((recv, others, ctx["path"],
+                                           node.lineno))
+                self._rec(recv, "write" if leaf in _MUTATORS
+                          else "read", node, locks, ctx)
+            else:
+                self._expr(fnode.value, locks, ctx)
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            self._expr(a, locks, ctx)
+
+    # verdicts -----------------------------------------------------------------
+
+    def race_findings(self) -> List[LintFinding]:
+        out: List[LintFinding] = []
+        if not self.roots:
+            return out
+        for attr, recs in sorted(self.accesses.items()):
+            accs = [a for a in recs if not a.setup and not (
+                a.kind == "write" and a.constant)]
+            writes = [a for a in accs if a.kind == "write"]
+            if not writes:
+                continue
+            conflict = None
+            for w in writes:
+                other = next((a for a in accs
+                              if a.root != w.root), None)
+                if other is not None:
+                    conflict = (w, other)
+                    break
+                if self.root_concurrent.get(w.root):
+                    other = next(
+                        (a for a in accs
+                         if a is not w and a.root == w.root), None)
+                    if other is not None:
+                        conflict = (w, other)
+                        break
+            if conflict is None:
+                continue
+            common = frozenset.intersection(
+                *(a.locks for a in accs)) if accs else frozenset()
+            if common:
+                continue
+            anchor = min(
+                (a for a in writes if not a.locks),
+                key=lambda a: (a.path, a.line),
+                default=min(writes, key=lambda a: (a.path, a.line)))
+            w, other = conflict
+            locks_seen = sorted({lk for a in accs for lk in a.locks})
+            out.append(LintFinding(
+                anchor.path, anchor.line, 0, "shared-write-no-lock",
+                f"{self.cm.name}.{attr} is written from {w.root} "
+                f"({os.path.basename(w.path)}:{w.line}) and "
+                f"{other.kind} from {other.root} "
+                f"({os.path.basename(other.path)}:{other.line}) with "
+                f"no common lock guard"
+                + (f" (locks seen: {', '.join(locks_seen)})"
+                   if locks_seen else "")
+                + " — guard every access with one lock, or prove the "
+                  "happens-before and suppress with justification"))
+        return out
+
+    def wait_findings(self) -> List[LintFinding]:
+        out = []
+        seen: Set[Tuple] = set()
+        for recv, others, path, line in self.waits:
+            if (recv, path, line) in seen:
+                continue        # multiple roots visit one site
+            seen.add((recv, path, line))
+            out.append(LintFinding(
+                path, line, 0, "wait-holding-lock",
+                f"{self.cm.name}: .wait() on {recv} while holding "
+                f"{', '.join(sorted(others))} — the waiter blocks "
+                "every thread needing that lock, including the one "
+                "that would signal; release it before waiting"))
+        return out
+
+
+def _walk_with_loops(fn) -> Iterable[Tuple[ast.AST, bool]]:
+    """(node, inside_a_loop_of_fn) pairs, skipping nested defs for the
+    loop flag purpose is irrelevant — used only for root discovery."""
+    def go(node, in_loop):
+        yield node, in_loop
+        enter = in_loop or isinstance(node, (ast.For, ast.While,
+                                             ast.AsyncFor))
+        for child in ast.iter_child_nodes(node):
+            yield from go(child, enter)
+    yield from go(fn, False)
+
+
+# == lock-order graph =========================================================
+
+def _tarjan_cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]
+                   ) -> List[List[str]]:
+    """SCCs of size > 1, plus self-loop nodes, over the order graph."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    for (a, b) in edges:
+        if a == b:
+            sccs.append([a])
+    return sccs
+
+
+def _cycle_findings(edges: Dict[Tuple[str, str], Tuple[str, int]],
+                    reentrant: Set[str]) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for scc in _tarjan_cycles(edges):
+        if len(scc) == 1:
+            node = scc[0]
+            if node in reentrant:
+                continue
+            path, line = edges[(node, node)]
+            out.append(LintFinding(
+                path, line, 0, "lock-order-cycle",
+                f"{node} is acquired while already held — a "
+                "non-reentrant Lock self-deadlocks on nested "
+                "acquisition (use RLock, or restructure so the outer "
+                "scope passes control down without re-locking)"))
+            continue
+        cyc_edges = sorted((k, v) for k, v in edges.items()
+                           if k[0] in scc and k[1] in scc)
+        (a, b), (path, line) = cyc_edges[0]
+        order = " -> ".join(scc + [scc[0]])
+        sites = "; ".join(f"{x}->{y} at {os.path.basename(p)}:{ln}"
+                          for (x, y), (p, ln) in cyc_edges)
+        out.append(LintFinding(
+            path, line, 0, "lock-order-cycle",
+            f"inconsistent lock acquisition order {order}: two threads "
+            f"taking opposite edges deadlock ({sites}) — pick ONE "
+            "global order and acquire in it everywhere"))
+    return out
+
+
+# == entry points =============================================================
+
+def _analyze_project(proj: Project) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    reentrant: Set[str] = set()
+    for cm in proj.classes:
+        ana = _ClassAnalysis(cm, proj)
+        ana.run()
+        for attr, ctor in ana.lock_attrs.items():
+            if ctor == "RLock":
+                reentrant.add(f"{cm.name}.{attr}")
+        for k, v in ana.edges.items():
+            edges.setdefault(k, v)
+        findings += ana.race_findings()
+        findings += ana.wait_findings()
+    findings += _cycle_findings(edges, reentrant)
+    # dedupe (two subclasses flattening one base anchor identically)
+    seen: Set[Tuple] = set()
+    unique: List[LintFinding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                             f.message)):
+        key = (f.path, f.line, f.rule,
+               f.message.split(" is ", 1)[-1] if f.rule ==
+               "shared-write-no-lock" else f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(f)
+    out = []
+    for f in unique:
+        lines = proj.lines.get(f.path)
+        if lines is not None and _suppressed(f, lines):
+            continue
+        out.append(f)
+    return out
+
+
+def analyze_files(files: Dict[str, str]) -> List[LintFinding]:
+    """Run the concurrency pass over `files` (path -> source)."""
+    return _analyze_project(collect_project(files))
+
+
+def analyze_source(source: str,
+                   path: str = "<module>") -> List[LintFinding]:
+    """Single-module convenience (fixtures/tests)."""
+    return analyze_files({path: source})
+
+
+def analyze_paths(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[LintFinding]:
+    """Whole-program pass over every .py under `paths`; reported paths
+    are relative to `root` (baseline stability), like lint_paths."""
+    findings = analyze_files(read_py_files(paths))
+    if root:
+        for f in findings:
+            f.path = os.path.relpath(f.path, root)
+    return findings
+
+
+def lock_order_edges_source(source: str, path: str = "<module>"
+                            ) -> Set[Tuple[str, str]]:
+    """The static acquisition-order edges (ClassName.lock pairs) — the
+    runtime witness fixture cross-validates observed acquisition order
+    against this graph."""
+    proj = collect_project({path: source})
+    edges: Set[Tuple[str, str]] = set()
+    for cm in proj.classes:
+        ana = _ClassAnalysis(cm, proj)
+        ana.run()
+        edges |= set(ana.edges)
+    return edges
+
+
+def lock_order_edges_paths(paths: Sequence[str]) -> Set[Tuple[str, str]]:
+    proj = collect_project(read_py_files(paths))
+    edges: Set[Tuple[str, str]] = set()
+    for cm in proj.classes:
+        ana = _ClassAnalysis(cm, proj)
+        ana.run()
+        edges |= set(ana.edges)
+    return edges

@@ -17,9 +17,12 @@ them (rule catalogue + one-line triggering examples in docs/ANALYSIS.md):
   to `jax.jit`/`self.jit`/`shard_map`/`jax.grad`/...). The call runs
   once at trace time and freezes into the jaxpr as a constant — the
   step silently stops varying.
-- `lock-no-with` (error): a bare `<x>.acquire()` call statement on a
-  lock-named attribute: an exception between acquire and release wedges
-  every later caller. Use `with lock:`.
+- `lock-no-with` (error): an `.acquire()` call on a lock-named
+  attribute with no paired `finally: <x>.release()` in the same
+  function: an exception between acquire and release wedges every
+  later caller. Use `with lock:`, or release in a `finally`. (One
+  implementation of the ISSUE-10 acquire-release rule — the old
+  bare-statement case is the subsumed special case.)
 - `loader-thread` (error): a `threading.Thread` / `ThreadPoolExecutor`
   constructed in LOADER code (path under `loader/`) by a class that
   defines no `stop()` method. Loaders own background prefetch threads,
@@ -60,7 +63,8 @@ RULES: Dict[str, str] = {
     "jit-in-loop": "jax.jit constructed inside a for/while loop body",
     "trace-time": "time.time()/random.* inside a traced function "
                   "(freezes into the jaxpr at trace time)",
-    "lock-no-with": "lock .acquire() outside a with statement",
+    "lock-no-with": "lock .acquire() with no `with` block and no "
+                    "paired `finally: .release()`",
     "loader-thread": "thread/executor created in loader code by a "
                      "class with no stop() (stop_units teardown "
                      "contract)",
@@ -231,7 +235,12 @@ class _Linter(ast.NodeVisitor):
         self._class_stop.pop()
         self._class_depth -= 1
 
+    def visit_Module(self, node: ast.Module) -> None:
+        self._check_acquire_release(node)
+        self.generic_visit(node)
+
     def _visit_function(self, node) -> None:
+        self._check_acquire_release(node)
         name = getattr(node, "name", "<lambda>")
         hot = (self._class_depth > 0 and name in _HOT_METHODS)
         traced = (name in _TRACED_METHODS or name in self._traced_names)
@@ -299,19 +308,106 @@ class _Linter(ast.NodeVisitor):
             self.path, getattr(node, "lineno", 0),
             getattr(node, "col_offset", 0), rule, message))
 
-    def visit_Expr(self, node: ast.Expr) -> None:
-        # bare statement `x.acquire()` — a with-statement never parses to
-        # this, so every hit is an unguarded acquire
-        call = node.value
-        if isinstance(call, ast.Call) \
-                and isinstance(call.func, ast.Attribute) \
-                and call.func.attr == "acquire" \
-                and "lock" in _attr_chain(call.func.value).lower():
-            self._emit(node, "lock-no-with",
-                       f"`{_attr_chain(call.func)}()` outside a `with` "
-                       "statement: an exception before release() wedges "
-                       "every later caller")
-        self.generic_visit(node)
+    def _check_acquire_release(self, scope) -> None:
+        """lock-no-with, the ONE acquire-release implementation
+        (ISSUE 10): every `.acquire()` on a lock-named chain must be
+        PAIRED with a `finally: <chain>.release()` that actually covers
+        it — the acquire sits inside the try body, or the try/finally
+        is the very next statement (optionally behind one `if got:`
+        wrapper, the timeout-acquire idiom). A finally-release
+        elsewhere in the function does NOT pair (the scope-global
+        version silently passed `acquire(); work(); release()` whenever
+        any other try/finally released the same lock). `with lock:`
+        never parses to `.acquire()`, so the blessed idiom is naturally
+        clean. Nested defs are each their own scope."""
+        def release_chains(t: ast.Try) -> frozenset:
+            out = set()
+            for stmt in t.finalbody:
+                for c in ast.walk(stmt):
+                    if isinstance(c, ast.Call) \
+                            and isinstance(c.func, ast.Attribute) \
+                            and c.func.attr == "release":
+                        out.add(_attr_chain(c.func.value))
+            return frozenset(out)
+
+        def acquires_in(node):
+            """Acquire calls in `node`'s expression subtree (nested
+            defs skipped)."""
+            stack = [node]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "acquire":
+                    chain = _attr_chain(n.func.value)
+                    if "lock" in chain.lower():
+                        yield n, chain
+                stack.extend(ast.iter_child_nodes(n))
+
+        def next_pairs(nxt, chain) -> bool:
+            """Does the FOLLOWING statement cover `chain`? The
+            try/finally itself, or `if got:` whose body holds one (the
+            timeout-acquire idiom)."""
+            if isinstance(nxt, ast.Try) and chain in release_chains(nxt):
+                return True
+            if isinstance(nxt, ast.If):
+                return any(isinstance(b, ast.Try)
+                           and chain in release_chains(b)
+                           for b in nxt.body)
+            return False
+
+        def emit(call, chain) -> None:
+            self._emit(call, "lock-no-with",
+                       f"`{chain}.acquire()` with no paired "
+                       f"`finally: {chain}.release()` covering it: an "
+                       "exception between acquire and release wedges "
+                       "every later caller — use `with lock:` or "
+                       "acquire-then-try/finally")
+
+        def scan(stmts, covered: frozenset) -> None:
+            for i, s in enumerate(stmts):
+                nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                if isinstance(s, ast.Try):
+                    scan(s.body, covered | release_chains(s))
+                    for h in s.handlers:
+                        scan(h.body, covered)
+                    scan(s.orelse, covered)
+                    scan(s.finalbody, covered)
+                elif isinstance(s, (ast.If, ast.While)):
+                    for call, chain in acquires_in(s.test):
+                        if chain not in covered:
+                            emit(call, chain)
+                    scan(s.body, covered)
+                    scan(s.orelse, covered)
+                elif isinstance(s, (ast.For, ast.AsyncFor)):
+                    for call, chain in acquires_in(s.iter):
+                        if chain not in covered:
+                            emit(call, chain)
+                    scan(s.body, covered)
+                    scan(s.orelse, covered)
+                elif isinstance(s, (ast.With, ast.AsyncWith)):
+                    for item in s.items:
+                        for call, chain in acquires_in(
+                                item.context_expr):
+                            if chain not in covered:
+                                emit(call, chain)
+                    scan(s.body, covered)
+                elif isinstance(s, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    continue            # their own scope / class body
+                else:
+                    for call, chain in acquires_in(s):
+                        if chain in covered \
+                                or (nxt is not None
+                                    and next_pairs(nxt, chain)):
+                            continue
+                        emit(call, chain)
+
+        scan(getattr(scope, "body", []), frozenset())
 
     def _check_magic_tile(self, node, targets, value) -> None:
         """pallas-magic-number: `<something-tile/blk/block> = <int>`
@@ -497,28 +593,50 @@ def lint_file(path: str) -> List[LintFinding]:
         return lint_source(f.read(), path)
 
 
-def lint_paths(paths: Iterable[str],
-               root: Optional[str] = None) -> List[LintFinding]:
-    """Lint every .py under `paths` (files or directories). Reported
-    paths are relative to `root` when given, so baselines are stable
-    across checkouts."""
-    findings: List[LintFinding] = []
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    """Every .py under `paths` (files or directories), sorted —
+    shared by velint and the concurrency/protocol passes so all the
+    gates walk one file set."""
+    out: List[str] = []
     for p in paths:
-        files: List[str] = []
         if os.path.isdir(p):
+            files: List[str] = []
             for dirpath, dirnames, filenames in os.walk(p):
                 dirnames[:] = [d for d in dirnames
                                if d != "__pycache__"]
                 files += [os.path.join(dirpath, fn)
                           for fn in sorted(filenames)
                           if fn.endswith(".py")]
+            out += sorted(files)
         elif p.endswith(".py"):
-            files.append(p)
-        for fn in sorted(files):
-            rel = os.path.relpath(fn, root) if root else fn
-            for f in lint_file(fn):
-                f.path = rel
-                findings.append(f)
+            out.append(p)
+    return out
+
+
+def read_py_files(paths: Iterable[str]) -> Dict[str, str]:
+    """{path: source} over every readable .py under `paths` — the one
+    loader the whole-program passes (concurrency/protocol) share."""
+    files: Dict[str, str] = {}
+    for fn in iter_py_files(paths):
+        try:
+            with open(fn, encoding="utf-8") as f:
+                files[fn] = f.read()
+        except OSError:
+            continue
+    return files
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> List[LintFinding]:
+    """Lint every .py under `paths` (files or directories). Reported
+    paths are relative to `root` when given, so baselines are stable
+    across checkouts."""
+    findings: List[LintFinding] = []
+    for fn in iter_py_files(paths):
+        rel = os.path.relpath(fn, root) if root else fn
+        for f in lint_file(fn):
+            f.path = rel
+            findings.append(f)
     return findings
 
 

@@ -1,0 +1,262 @@
+"""Protocol analysis (pass 5): HTTP endpoint contracts + thread-owner
+teardown contract.
+
+Five stdlib HTTP planes (web_status, cluster coordinator, mirror store,
+serving, task_queue) share one hardening convention that PRs 4-7 each
+re-derived by hand in review: a handler that READS a request body must
+(a) verify the shared token (`http_util.check_shared_token`) and
+(b) bound the body before `rfile.read`-ing it (413/400 on abuse, never
+an unbounded read an attacker sizes for you). And every class that
+spawns threads must expose the `stop()` teardown contract velint's
+`loader-thread` rule enforces for loader code — generalized
+project-wide here. This pass mechanizes all three as AST checks:
+
+- `endpoint-unauthed` (error): a `do_*` method of a
+  `BaseHTTPRequestHandler` subclass that (transitively, through the
+  handler's own `self._helper()` methods) reads `self.rfile` without
+  any `check_shared_token(...)` call on the way. The check passes
+  trivially when no token is configured, so wiring it is free — the
+  rule asks that the WIRING exist, the deployment decides the policy.
+- `endpoint-unbounded-body` (error): a `self.rfile.read(...)` whose
+  length argument is missing, or derives from `Content-Length` with no
+  visible bound — no `min(...)` in its computation and no comparison
+  (`if length > cap: ... return`) against it anywhere in the method.
+  The blessed idioms (`min(int(cl), CAP)`; validate-then-read;
+  chunked `read(min(1 << 20, remaining))`) are all recognized.
+- `thread-no-stop` (error): a class (flattened over its bases) that
+  constructs `threading.Thread`/`Timer`/`ThreadPoolExecutor` and
+  defines no `stop()` method anywhere in the hierarchy. Loader paths
+  are exempt — velint's `loader-thread` rule already owns those (one
+  finding per bug, not two).
+
+Known blind spots: token checks hidden behind non-`self` helper
+functions other than `check_shared_token` itself are invisible (wrap
+the shared helper instead); boundedness is recognized, not proven — a
+`min(x, 2**62)` "bound" passes. Findings are `lint.LintFinding`
+records: they ride `tools/velint.py --ci` (ratchet baseline) and honor
+`# velint: disable=RULE` suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from veles_tpu.analysis.concurrency import (Project, _attr_chain,
+                                            collect_project, flat_methods)
+from veles_tpu.analysis.lint import (LintFinding, _suppressed,
+                                     read_py_files)
+
+RULES: Dict[str, str] = {
+    "endpoint-unauthed": "HTTP handler reads the request body without "
+                         "a check_shared_token() call",
+    "endpoint-unbounded-body": "rfile.read() with no visible bound on "
+                               "the Content-Length",
+    "thread-no-stop": "class spawns threads/executors but defines no "
+                      "stop() teardown (stop_units contract, "
+                      "project-wide)",
+}
+
+_HANDLER_BASE = "BaseHTTPRequestHandler"
+_THREAD_CTORS = ("Thread", "Timer", "ThreadPoolExecutor")
+_AUTH_NAMES = ("check_shared_token",)
+
+
+def _is_loader_path(path: str) -> bool:
+    import re
+    parts = re.split(r"[/\\]", path)
+    return any(p == "loader" for p in parts[:-1]) \
+        or "loader" in parts[-1].lower()
+
+
+# -- endpoint contracts -------------------------------------------------------
+
+def _handler_classes(tree: ast.Module) -> List[ast.ClassDef]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            bases = [_attr_chain(b).rsplit(".", 1)[-1]
+                     for b in node.bases if _attr_chain(b)]
+            if _HANDLER_BASE in bases:
+                out.append(node)
+    return out
+
+
+def _own_calls(fn) -> List[ast.Call]:
+    return [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+
+
+def _rfile_reads(fn) -> List[ast.Call]:
+    """`self.rfile.read(...)` call sites lexically in `fn`."""
+    out = []
+    for call in _own_calls(fn):
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "read" \
+                and "rfile" in _attr_chain(call.func.value).split("."):
+            out.append(call)
+    return out
+
+
+def _has_auth_call(fn) -> bool:
+    for call in _own_calls(fn):
+        leaf = _attr_chain(call.func).rsplit(".", 1)[-1]
+        if leaf in _AUTH_NAMES:
+            return True
+    return False
+
+
+def _self_callees(fn) -> Set[str]:
+    out = set()
+    for call in _own_calls(fn):
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self":
+            out.add(call.func.attr)
+    return out
+
+
+def _bounded_names(fn) -> Set[str]:
+    """Names the method visibly bounds: assigned through a `min(...)`,
+    or appearing in any comparison (the validate-then-read idiom)."""
+    bounded: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if any(isinstance(c, ast.Call)
+                   and _attr_chain(c.func).rsplit(".", 1)[-1] == "min"
+                   for c in ast.walk(node.value)):
+                bounded.add(node.targets[0].id)
+        elif isinstance(node, ast.Compare):
+            for c in ast.walk(node):
+                if isinstance(c, ast.Name):
+                    bounded.add(c.id)
+    return bounded
+
+
+def _read_is_bounded(call: ast.Call, bounded: Set[str]) -> bool:
+    if not call.args:
+        return False
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant):
+        return True
+    for c in ast.walk(arg):
+        if isinstance(c, ast.Call) \
+                and _attr_chain(c.func).rsplit(".", 1)[-1] == "min":
+            return True
+        if isinstance(c, ast.Name) and c.id in bounded:
+            return True
+    return False
+
+
+def endpoint_findings(tree: ast.Module, path: str) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for cls in _handler_classes(tree):
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+
+        def closure(entry: str) -> Set[str]:
+            seen: Set[str] = set()
+            todo = [entry]
+            while todo:
+                m = todo.pop()
+                if m in seen or m not in methods:
+                    continue
+                seen.add(m)
+                todo += [c for c in _self_callees(methods[m])]
+            return seen
+
+        for name, fn in sorted(methods.items()):
+            if not name.startswith("do_"):
+                continue
+            reach = closure(name)
+            reads = [(methods[m], r) for m in sorted(reach)
+                     for r in _rfile_reads(methods[m])]
+            if not reads:
+                continue
+            if not any(_has_auth_call(methods[m]) for m in reach):
+                out.append(LintFinding(
+                    path, fn.lineno, fn.col_offset, "endpoint-unauthed",
+                    f"{cls.name}.{name} reads the request body with no "
+                    "check_shared_token() call on the path: every "
+                    "body-accepting endpoint must verify the shared "
+                    "token (http_util.check_shared_token — passes "
+                    "trivially when no token is configured)"))
+            for owner, read in reads:
+                if not _read_is_bounded(read, _bounded_names(owner)):
+                    out.append(LintFinding(
+                        path, read.lineno, read.col_offset,
+                        "endpoint-unbounded-body",
+                        f"{cls.name}.{owner.name}: rfile.read() with "
+                        "no visible bound on Content-Length — clamp "
+                        "with min(length, CAP) or validate-then-413 "
+                        "before reading (an unbounded read lets the "
+                        "client size your allocation)"))
+    return out
+
+
+# -- thread-owner teardown ----------------------------------------------------
+
+def thread_owner_findings(proj: Project) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for cm in proj.classes:
+        if _is_loader_path(cm.path):
+            continue        # velint loader-thread owns loader paths
+        methods = flat_methods(cm, proj)
+        if "stop" in methods:
+            continue
+        site = None
+        for _name, (fn, fpath) in sorted(methods.items()):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    leaf = _attr_chain(node.func).rsplit(".", 1)[-1]
+                    if leaf in _THREAD_CTORS:
+                        cand = (fpath, node.lineno, leaf)
+                        if site is None or cand[:2] < site[:2]:
+                            site = cand
+        if site is not None:
+            fpath, line, leaf = site
+            out.append(LintFinding(
+                fpath, line, 0, "thread-no-stop",
+                f"{cm.name} constructs {leaf}(...) but defines no "
+                "stop() anywhere in its hierarchy: thread owners must "
+                "expose the stop()/join teardown contract (the "
+                "project-wide generalization of velint's loader-thread "
+                "rule)"))
+    return out
+
+
+# -- entry points -------------------------------------------------------------
+
+def analyze_files(files: Dict[str, str]) -> List[LintFinding]:
+    proj = collect_project(files)
+    findings: List[LintFinding] = []
+    for path in sorted(files):
+        try:
+            tree = ast.parse(files[path], filename=path)
+        except SyntaxError:
+            continue
+        findings += endpoint_findings(tree, path)
+    findings += thread_owner_findings(proj)
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        lines = proj.lines.get(f.path)
+        if lines is not None and _suppressed(f, lines):
+            continue
+        out.append(f)
+    return out
+
+
+def analyze_source(source: str,
+                   path: str = "<module>") -> List[LintFinding]:
+    return analyze_files({path: source})
+
+
+def analyze_paths(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[LintFinding]:
+    findings = analyze_files(read_py_files(paths))
+    if root:
+        for f in findings:
+            f.path = os.path.relpath(f.path, root)
+    return findings

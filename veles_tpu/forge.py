@@ -149,11 +149,18 @@ class ForgeServer:
     whatever a client publishes."""
 
     def __init__(self, directory: str, port: int = 0,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1", token=None) -> None:
         import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+        from veles_tpu.http_util import check_shared_token
+
         self.directory = directory
+        #: optional shared token for PUBLISHING (X-Veles-Token,
+        #: constant-time compare — the endpoint-contract convention;
+        #: None keeps the trusted-network model). GETs stay open: the
+        #: trust hazard is accepting packages, not serving them.
+        self.token = token
         os.makedirs(directory, exist_ok=True)
         store = Forge(directory)
         outer = self
@@ -206,6 +213,11 @@ class ForgeServer:
                     shutil.copyfileobj(f, self.wfile)
 
             def do_PUT(self):
+                # publish = accept a pickle: verify the shared token
+                # before reading anything (trivially true when no token
+                # is configured — the wiring is the contract)
+                if not check_shared_token(self, outer.token):
+                    return
                 path = self._pkg_path()
                 try:
                     n = int(self.headers.get("Content-Length", -1))

@@ -363,6 +363,28 @@ class Launcher(Logger):
                 print(f"verify-workflow: audit traced the fused step "
                       f"({len(audit_finds)} finding(s))", flush=True)
                 findings += audit_finds
+        # concurrency section: the whole-program thread/endpoint
+        # contracts (analysis passes 4/5) over the installed package —
+        # the same findings tools/velint.py --ci ratchets on, surfaced
+        # here so one --verify-workflow run answers "is this tree
+        # statically sound" end to end (graph + environment + races +
+        # protocol). Converted to the shared Finding record; errors
+        # count toward the exit code like every other pass.
+        import veles_tpu as _pkg
+        from veles_tpu.analysis import concurrency as _conc
+        from veles_tpu.analysis import protocol as _proto
+        from veles_tpu.analysis.findings import Finding as _Finding
+        pkg_dir = os.path.dirname(os.path.abspath(_pkg.__file__))
+        conc = _conc.analyze_paths([pkg_dir],
+                                   root=os.path.dirname(pkg_dir))
+        conc += _proto.analyze_paths([pkg_dir],
+                                     root=os.path.dirname(pkg_dir))
+        print(f"verify-workflow: concurrency pass over the installed "
+              f"package ({len(conc)} finding(s))", flush=True)
+        findings += [_Finding(rule=f.rule, severity=f.severity,
+                              unit=f"{f.path}:{f.line}",
+                              message=f.message)
+                     for f in conc]
         for f in findings:
             print(f.format(), flush=True)
         n_err = sum(1 for f in findings if f.severity == "error")

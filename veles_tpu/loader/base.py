@@ -24,6 +24,7 @@ oversampled with replacement), epoch length unchanged.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, List, Optional
 
 import numpy as np
@@ -203,6 +204,13 @@ class Loader(AcceleratedUnit, IDistributable):
         at_end = self._cursor >= len(self._schedule)
         self.epoch_ended <<= at_end
         if at_end:
+            # Produce-thread readers (the hflip coin hash) never run
+            # across an epoch boundary: fill_minibatch's lookahead
+            # stops at the schedule end and PrefetchingLoader.run
+            # clears every pending future at rollover, so epoch_number
+            # is stable while any producer is live — a happens-before
+            # the static pass cannot see (docs/ANALYSIS.md blind spots).
+            # velint: disable=shared-write-no-lock
             self.epoch_number += 1
             self._start_epoch()
 
@@ -263,7 +271,11 @@ class PrefetchingLoader(Loader):
         self.local_rows_fn = None
         #: decoded-row counter (tests/observability)
         self.rows_decoded = 0
-        self._count_lock = None
+        #: guards rows_decoded increments from pool workers; created
+        #: HERE (and re-created on unpickle), never lazily on the
+        #: produce threads — two workers racing the lazy `if None:
+        #: create` each made their own lock and lost increments
+        self._count_lock = threading.Lock()
 
     def initialize(self, device=None, **kwargs: Any):
         # a restored loader keeps its pickled flip seed (and must NOT
@@ -346,9 +358,6 @@ class PrefetchingLoader(Loader):
     def _count_rows(self, n: int) -> None:
         # _produce runs on pool worker threads: a bare += would lose
         # increments under interleaving
-        import threading
-        if self._count_lock is None:
-            self._count_lock = threading.Lock()
         with self._count_lock:
             self.rows_decoded += n
 
@@ -415,6 +424,12 @@ class PrefetchingLoader(Loader):
         without an `emit` knob or when the format is unchanged."""
         if getattr(self, "emit", None) in (None, emit):
             return
+        # Negotiation happens between runs on the driver thread; every
+        # pending produce future is cancelled and the lookahead queue
+        # cleared below, so no consumer ever observes a half-switched
+        # wire — and a worst-case mid-write read is a torn-free str
+        # whose result is discarded with the cancelled future.
+        # velint: disable=shared-write-no-lock
         self.emit = emit
         for _, fut in self._pending.values():
             fut.cancel()
@@ -425,6 +440,12 @@ class PrefetchingLoader(Loader):
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
         self._pending.clear()
+
+    def __setstate__(self, d):
+        super().__setstate__(d)
+        # pickled as None (locks don't pickle); re-created on the
+        # unpickling thread, before any produce pool exists
+        self._count_lock = threading.Lock()
 
     def __getstate__(self):
         d = super().__getstate__()

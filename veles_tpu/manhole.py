@@ -66,9 +66,12 @@ class ManholeServer(Logger):
         return self
 
     def _accept_loop(self) -> None:
+        sock = self._sock   # local capture: stop() nulls the attribute
+        # after close(), and `None.accept()` would kill this thread
+        # with an AttributeError the OSError handler never sees
         while not self._stopping:
             try:
-                conn, addr = self._sock.accept()
+                conn, addr = sock.accept()
             except OSError:
                 return          # socket closed by stop()
             threading.Thread(target=self._serve_conn, args=(conn,),

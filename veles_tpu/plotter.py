@@ -158,6 +158,11 @@ class GraphicsRenderer(Logger):
         if self._tb_writer is None:
             try:
                 from torch.utils.tensorboard import SummaryWriter
+                # The only cross-thread reader is stop()'s _tb_close,
+                # which runs strictly after the render thread's join()
+                # succeeded (feeder_done gate) — a join-ordered
+                # happens-before the static pass cannot see.
+                # velint: disable=shared-write-no-lock
                 self._tb_writer = SummaryWriter(self.tensorboard_dir)
             except Exception as e:  # noqa: BLE001 — optional sink
                 self.warning("tensorboard sink unavailable (%s); "

@@ -71,13 +71,22 @@ class InferenceServer(Logger):
                  max_batch: int = 64,
                  batch_window_ms: float = 2.0,
                  queue_limit: int = 64,
-                 request_timeout_s: float = 30.0) -> None:
+                 request_timeout_s: float = 30.0,
+                 token: Optional[str] = None,
+                 max_body: int = 32 << 20) -> None:
         super().__init__()
         self.workflow = workflow
         self.host = host
         self.port = port
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
+        #: optional shared token (X-Veles-Token, constant-time compare —
+        #: the endpoint-contract convention every control plane wires;
+        #: None keeps the localhost trust model wide open)
+        self.token = token
+        #: request-body cap: /predict refuses larger payloads with 413
+        #: instead of letting the client size the allocation
+        self.max_body = max_body
         #: admission bound: requests in flight (queued or dispatching)
         #: beyond this are answered 503 immediately
         self.queue_limit = queue_limit
@@ -179,9 +188,13 @@ class InferenceServer(Logger):
         tr = self._tr
         tok = tr.begin("serving.dispatch", "serving") \
             if tr is not None else None
-        with self._lock:
+        with self._cv:
+            # stat counters live under _cv like every other counter
+            # health() reads — one guard per variable, not one per
+            # code path (the shared-write-no-lock contract)
             self.n_dispatches += 1
             self._m_dispatches.inc()
+        with self._lock:
             out = np.asarray(self._fn(self._state["params"], x))[:n]
         if tok is not None:
             tr.end(tok)
@@ -215,7 +228,9 @@ class InferenceServer(Logger):
             self._m_requests.inc()
             self._m_inflight.set(self._inflight)
         try:
-            if self.batch_window_ms > 0 and self._batcher is not None:
+            # _predict_batched re-checks the batcher under _cv — reading
+            # self._batcher unlocked here raced stop()'s teardown write
+            if self.batch_window_ms > 0:
                 out = self._predict_batched(x)
             else:
                 out = self._forward_rows(x)
@@ -239,10 +254,17 @@ class InferenceServer(Logger):
         with self._cv:
             # re-check under the lock: a batcher that already drained and
             # exited would leave this item waiting forever
-            if self._stopping or self._batcher is None:
+            if self._stopping:
                 raise RuntimeError("server stopping")
-            self._pending.append(item)
-            self._cv.notify()
+            if self._batcher is None:
+                direct = True   # never start()ed (or cleanly stopped):
+                # nothing to coalesce with — dispatch directly
+            else:
+                direct = False
+                self._pending.append(item)
+                self._cv.notify()
+        if direct:
+            return self._forward_rows(x)
         timeout = self.request_timeout_s or None
         if not item["done"].wait(timeout):
             # deadline missed: mark abandoned so the batcher drops it if
@@ -352,6 +374,8 @@ class InferenceServer(Logger):
 
     def start(self) -> "InferenceServer":
         srv = self
+        token = self.token
+        from veles_tpu.http_util import check_shared_token
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, payload: Dict[str, Any]) -> None:
@@ -373,7 +397,10 @@ class InferenceServer(Logger):
                     # Prometheus scrape (telemetry/metrics.py): the one
                     # process registry — serving admission/latency plus
                     # the standard step/feed/mem/restart families
-                    # (localhost trust model, same as /info)
+                    # (token-guarded when a token is configured; the
+                    # exposition leaks run internals)
+                    if not check_shared_token(self, token):
+                        return
                     from veles_tpu.telemetry import metrics as tmetrics
                     tmetrics.scrape_mem()
                     body = tmetrics.default_registry() \
@@ -393,8 +420,23 @@ class InferenceServer(Logger):
                 if not self.path.startswith("/predict"):
                     self._send(404, {"error": "unknown endpoint"})
                     return
+                # the endpoint contract every control plane wires
+                # (task_queue/web_status/cluster precedent): verify the
+                # shared token (trivially true when none is configured)
+                # and bound the body BEFORE reading it
+                if not check_shared_token(self, token):
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    self._send(400, {"error": "bad Content-Length"})
+                    return
+                if not 0 <= n <= srv.max_body:
+                    self._send(413 if n > srv.max_body else 400,
+                               {"error": f"body must be 0..{srv.max_body}"
+                                         " bytes"})
+                    return
+                try:
                     req = json.loads(self.rfile.read(n))
                     resp = srv.predict(req["inputs"])
                 except (ValueError, KeyError, TypeError) as e:
@@ -462,5 +504,8 @@ class InferenceServer(Logger):
                 # cannot spawn a racing duplicate
                 self.warning("batcher still draining at stop()")
             else:
-                self._batcher = None
-                self._stopping = False
+                # teardown writes under _cv: handler threads re-check
+                # both fields under the same lock in _predict_batched
+                with self._cv:
+                    self._batcher = None
+                    self._stopping = False

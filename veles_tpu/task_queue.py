@@ -426,10 +426,15 @@ class FitnessQueueWorker(Logger):
         self.give_up_s = give_up_s
         self.tasks_done = 0
         #: how the last run() ended: "done" (server said so), "gave_up"
-        #: (no contact for give_up_s), or "max_tasks". Callers use this
-        #: to distinguish a worker that participated from one that never
-        #: reached the coordinator at all.
+        #: (no contact for give_up_s), "stopped" (stop() called), or
+        #: "max_tasks". Callers use this to distinguish a worker that
+        #: participated from one that never reached the coordinator.
         self.ended_by = ""
+        #: stop() teardown contract (thread owners — start_thread —
+        #: must expose it; the protocol pass `thread-no-stop` gates it):
+        #: once set, run() exits at its next poll boundary and the
+        #: worker is decommissioned
+        self._stop_requested = threading.Event()
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None
@@ -468,7 +473,8 @@ class FitnessQueueWorker(Logger):
         self.ended_by = ""                 # fresh verdict for THIS run
         last_contact = time.monotonic()
         fail_streak = 0
-        while max_tasks is None or self.tasks_done < max_tasks:
+        while (max_tasks is None or self.tasks_done < max_tasks) \
+                and not self._stop_requested.is_set():
             try:
                 got = self._request("GET", task_path)
             except PermissionError:
@@ -490,6 +496,9 @@ class FitnessQueueWorker(Logger):
                             self.backoff_max)
                 delay *= 1.0 + self.backoff_jitter * random.random()
                 fail_streak += 1
+                # module-level time.sleep on purpose (the backoff test
+                # observes it); stop() takes effect at the next loop
+                # check, within one bounded backoff period
                 time.sleep(delay)
                 continue
             last_contact = time.monotonic()
@@ -564,7 +573,9 @@ class FitnessQueueWorker(Logger):
                 # member_worker's return value must not claim it
                 self.tasks_done += 1
         if not self.ended_by:
-            self.ended_by = "max_tasks"
+            self.ended_by = ("stopped"
+                             if self._stop_requested.is_set()
+                             else "max_tasks")
         return self.tasks_done
 
     def start_thread(self) -> threading.Thread:
@@ -574,3 +585,10 @@ class FitnessQueueWorker(Logger):
                              name=f"fitness-worker{self.worker_id}")
         t.start()
         return t
+
+    def stop(self) -> None:
+        """Decommission the worker: the run() loop (threaded via
+        start_thread or not) exits at its next poll/backoff boundary,
+        an in-progress evaluation finishes and posts first. Permanent —
+        a stopped worker stays stopped (fresh workers are cheap)."""
+        self._stop_requested.set()

@@ -181,6 +181,12 @@ class WebStatusServer:
         self.profile_controller = profile_controller
         #: worker heartbeats: process_id -> {host, local_devices, t}
         self.workers: Dict[str, Dict[str, Any]] = {}
+        #: guards `workers`: POSTed beats insert from one server thread
+        #: while /status.json iterates from another — an unguarded
+        #: sorted(workers.items()) mid-insert raises "dictionary changed
+        #: size during iteration" (the shared-write-no-lock class the
+        #: concurrency pass flags)
+        self._workers_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -224,6 +230,7 @@ class WebStatusServer:
     def start(self) -> None:
         wf = self.workflow
         workers = self.workers
+        wlock = self._workers_lock
         token = self.token
         max_workers = self.max_workers
         clean = self._clean_beat
@@ -256,10 +263,13 @@ class WebStatusServer:
                 elif self.path.startswith("/status.json"):
                     status = workflow_status(wf)
                     now = time.time()
+                    with wlock:     # beats insert from sibling threads
+                        snap = sorted((pid, dict(w))
+                                      for pid, w in workers.items())
                     status["workers"] = {
                         pid: {**{k: v for k, v in w.items() if k != "t"},
                               "age_s": round(now - w["t"], 3)}
-                        for pid, w in sorted(workers.items())}
+                        for pid, w in snap}
                     body = json.dumps(status).encode()
                     ctype = "application/json"
                 else:
@@ -295,12 +305,16 @@ class WebStatusServer:
                     self.send_response(400)   # malformed beat != crash
                     self.end_headers()
                     return
-                if pid not in workers and len(workers) >= max_workers:
+                beat["t"] = time.time()
+                with wlock:
+                    full = (pid not in workers
+                            and len(workers) >= max_workers)
+                    if not full:
+                        workers[pid] = beat
+                if full:
                     self.send_response(429)   # registry full: no growth
                     self.end_headers()
                     return
-                beat["t"] = time.time()
-                workers[pid] = beat
                 self.send_response(204)
                 self.end_headers()
 
