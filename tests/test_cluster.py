@@ -250,6 +250,124 @@ def test_http_mirror_failed_verify_unpublishes(tmp_path, monkeypatch):
         srv.stop()
 
 
+def test_mirror_meta_roundtrip_and_invisibility(tmp_path):
+    """Control-plane meta records (coordinator announcement, presence
+    beacons) live next to the snapshot blobs but must NEVER appear in
+    entries()/quorum votes — and last-writer-wins by design (the
+    election's claim/settle protocol builds on exactly that)."""
+    path, _ = _fake_snapshot(tmp_path / "local")
+    srv = MirrorServer(str(tmp_path / "blob"), token="sekrit").start()
+    try:
+        for mirror in (DirMirror(str(tmp_path / "mir")),
+                       HttpMirror(srv.url, token="sekrit")):
+            assert mirror.get_meta("cluster_coord.json") is None
+            assert mirror.put_meta("cluster_coord.json",
+                                   {"term": 1, "host": "0"})
+            assert mirror.put_meta("cluster_coord.json",
+                                   {"term": 2, "host": "1"})
+            got = mirror.get_meta("cluster_coord.json")
+            assert got == {"term": 2, "host": "1"}   # last writer wins
+            mirror.push(path)
+            names = {e["name"] for e in mirror.entries()}
+            assert names == {"wf_a.pickle.gz"}       # meta invisible
+    finally:
+        srv.stop()
+
+
+def test_mirror_meta_rejects_traversal_and_garbage(tmp_path):
+    mirror = DirMirror(str(tmp_path / "mir"))
+    with pytest.raises(ValueError):
+        mirror.put_meta("../evil.json", {"a": 1})
+    (tmp_path / "mir").mkdir(exist_ok=True)
+    (tmp_path / "mir" / "junk.json").write_text("not json {")
+    assert mirror.get_meta("junk.json") is None
+    (tmp_path / "mir" / "list.json").write_text("[1, 2]")
+    assert mirror.get_meta("list.json") is None      # not an object
+
+
+def test_http_mirror_concurrent_pushes_stay_idempotent(tmp_path):
+    """The gang-respawn race: several pushes of the SAME (name, digest)
+    in flight at once (a respawned child re-exporting while the old
+    push still runs) must converge to ONE verified copy — no torn
+    publishes, no tmp leftovers, has() true afterwards."""
+    path, digest = _fake_snapshot(tmp_path / "local")
+    srv = MirrorServer(str(tmp_path / "blob")).start()
+    try:
+        results = []
+
+        def pusher():
+            m = HttpMirror(srv.url)       # one client per thread
+            results.append(m.push(path))
+
+        threads = [threading.Thread(target=pusher) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert all(results) and len(results) == 6
+        mirror = HttpMirror(srv.url)
+        assert mirror.has("wf_a.pickle.gz", digest)
+        [entry] = mirror.entries()
+        assert entry["digest"] == digest
+        leftovers = [n for n in os.listdir(tmp_path / "blob")
+                     if n.endswith(".tmp")]
+        assert leftovers == []
+        # the mirrored bytes verify end-to-end
+        got = mirror.fetch("wf_a.pickle.gz", str(tmp_path / "r"))
+        assert got is not None and Snapshotter.verify(got)
+    finally:
+        srv.stop()
+
+
+def test_restore_never_sees_half_published_sidecar(tmp_path):
+    """A restoring member racing an in-flight push must never restore
+    digest-mismatched bytes: the sidecar is published only AFTER the
+    uploaded bytes verified, so every fetch() outcome is either None
+    (not yet published / mismatch) or a fully verified copy."""
+    path, digest = _fake_snapshot(tmp_path / "local")
+    new_payload = b"snapshot-bytes-v2" * 64
+    path2, digest2 = _fake_snapshot(tmp_path / "local2",
+                                    payload=new_payload)
+    srv = MirrorServer(str(tmp_path / "blob")).start()
+    try:
+        from veles_tpu.resilience.mirror import _read_sidecar
+        stop = threading.Event()
+        bad = []
+
+        def restorer():
+            m = HttpMirror(srv.url)
+            i = 0
+            while not stop.is_set():
+                i += 1
+                dest = str(tmp_path / f"r{i % 4}")
+                got = m.fetch("wf_a.pickle.gz", dest)
+                if got is None:
+                    continue
+                with open(got, "rb") as f:
+                    data = f.read()
+                side = _read_sidecar(got)
+                if hashlib.sha256(data).hexdigest() != side:
+                    bad.append(side)
+
+        t = threading.Thread(target=restorer)
+        t.start()
+        pusher = HttpMirror(srv.url)
+        for _ in range(8):      # alternate generations' snapshot bytes
+            assert pusher.push(path)
+            srv_copy = os.path.join(str(tmp_path / "blob"),
+                                    "wf_a.pickle.gz")
+            os.remove(srv_copy)  # next push re-uploads from scratch
+            os.remove(srv_copy + ".sha256")
+            assert pusher.push(path2)
+            os.remove(srv_copy)
+            os.remove(srv_copy + ".sha256")
+        stop.set()
+        t.join(30.0)
+        assert bad == [], f"restored digest-mismatched copies: {bad}"
+    finally:
+        srv.stop()
+
+
 #: a child that heartbeats ONCE and then wedges forever (deadlocked
 #: collective): only stall detection can get the cluster out
 FAKE_CHILD_HANG = '''
@@ -688,6 +806,335 @@ def test_cluster_gives_up_after_restart_budget(tmp_path):
     assert rep["cluster"]["restarts"] == 1
 
 
+# == elastic control plane: re-election / join / shrink ======================
+
+#: a child that heartbeats forever UNTIL resumed from a snapshot, then
+#: exits 0 after two more epochs — "training can only finish once the
+#: fleet agreed on a snapshot", which pins the quorum-resume claim in
+#: the elasticity tests below
+FAKE_CHILD_UNTIL_RESUMED = '''
+import json, os, sys, time
+hb = os.environ["VELES_HEARTBEAT_FILE"]
+args = sys.argv[1:]
+snap = args[args.index("-s") + 1] if "-s" in args else None
+e = 0
+while True:
+    e += 1
+    with open(hb + ".t", "w") as f:
+        json.dump({"epoch": e, "ts": time.time()}, f)
+    os.replace(hb + ".t", hb)
+    if snap is not None and e >= 2:
+        sys.exit(0)
+    time.sleep(0.2)
+'''
+
+
+def test_coordinator_reelection_promotes_lowest_live(tmp_path):
+    """The tentpole: the coordinator dies mid-run; the lowest live
+    host-id promotes itself through the mirror record (term 2), the
+    other member re-homes to the announced endpoint, and the election
+    bump resumes every host from the QUORUM snapshot — the children
+    (which only finish when resumed) prove the fleet kept going."""
+    child = _write_child(tmp_path, FAKE_CHILD_UNTIL_RESUMED,
+                         name="child_r.py")
+    mirror_dir = str(tmp_path / "mirror")
+    path, _ = _fake_snapshot(tmp_path / "h1", name="wf_a.pickle.gz")
+    DirMirror(mirror_dir).push(path)
+    coord = ClusterCoordinator(2, host="127.0.0.1", port=0,
+                               dead_after=15.0, members=("1", "2"),
+                               mirror=mirror_dir,
+                               advertise="127.0.0.1").start()
+    members = [
+        _member(tmp_path, i, None, coord.port,
+                [sys.executable, child], mirror=mirror_dir,
+                beat_s=0.1, coord_timeout=30.0, floor=2,
+                dead_after=1.0, advertise="127.0.0.1")
+        for i in (1, 2)]
+
+    def _snipe():
+        # tear the control plane down once both hosts run generation 1
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            with coord._lock:
+                if len(coord._hosts) == 2 and all(
+                        h["report"].get("status") == "running"
+                        for h in coord._hosts.values()):
+                    break
+            time.sleep(0.05)
+        coord.stop()
+
+    sniper = threading.Thread(target=_snipe, daemon=True)
+    sniper.start()
+    codes = _run_members(members, timeout=60.0)
+    sniper.join(5.0)
+    assert codes == {"1": 0, "2": 0}
+    rep1 = json.loads((tmp_path / "report_1.json").read_text())
+    cluster = rep1["cluster"]          # host 1 hosts the NEW plane
+    assert cluster["term"] == 2
+    assert cluster["outcome"] == "completed"
+    assert cluster["members"] == ["1", "2"]
+    bump = cluster["generations"][0]
+    assert "re-elected" in bump["reason"]
+    # no rollback: the election bump resumed from the agreed quorum
+    # snapshot, not from scratch
+    assert bump["snapshot"] == "wf_a.pickle.gz"
+    rep2 = json.loads((tmp_path / "report_2.json").read_text())
+    assert rep2["term"] == 2
+    # host 2 respawned at the post-election generation from the
+    # mirror-restored copy of the agreed snapshot
+    resumed = [a["snapshot"] for a in rep2["attempts"]
+               if a["generation"] == bump["generation"]]
+    assert resumed and resumed[0].endswith("wf_a.pickle.gz")
+
+
+def test_join_admitted_at_next_generation_bump(tmp_path):
+    """Elastic growth: a joining host (id outside the boot membership)
+    announces itself via /join and is admitted at the next generation
+    bump — the whole fleet respawns over the grown member set from the
+    quorum snapshot, and the joiner's children run the same job."""
+    child = _write_child(tmp_path, FAKE_CHILD_UNTIL_RESUMED,
+                         name="child_j.py")
+    mirror_dir = str(tmp_path / "mirror")
+    path, _ = _fake_snapshot(tmp_path / "h0", name="wf_a.pickle.gz")
+    DirMirror(mirror_dir).push(path)
+    coord = ClusterCoordinator(2, host="127.0.0.1", port=0,
+                               dead_after=15.0, mirror=mirror_dir,
+                               advertise="127.0.0.1").start()
+    boot = [
+        _member(tmp_path, i, coord if i == 0 else None, coord.port,
+                [sys.executable, child], mirror=mirror_dir,
+                beat_s=0.1, floor=2) for i in range(2)]
+    codes = {}
+    threads = []
+    for m in boot:
+        t = threading.Thread(
+            target=lambda m=m: codes.__setitem__(m.host_id, m.run()),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    # admit the joiner only once the boot pair runs generation 1 (so
+    # the bump's quorum pick has their reports)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        with coord._lock:
+            if {"0", "1"} <= set(coord._hosts) and all(
+                    h["report"].get("status") == "running"
+                    for h in coord._hosts.values()):
+                break
+        time.sleep(0.05)
+    joiner = _member(tmp_path, 2, None, coord.port,
+                     [sys.executable, child], mirror=mirror_dir,
+                     beat_s=0.1, floor=2, join=True)
+    tj = threading.Thread(
+        target=lambda: codes.__setitem__("2", joiner.run()),
+        daemon=True)
+    tj.start()
+    threads.append(tj)
+    deadline = time.monotonic() + 60.0
+    for t in threads:
+        t.join(max(0.1, deadline - time.monotonic()))
+    assert codes == {"0": 0, "1": 0, "2": 0}
+    rep0 = json.loads((tmp_path / "report_0.json").read_text())
+    cluster = rep0["cluster"]
+    assert cluster["outcome"] == "completed"
+    assert cluster["members"] == ["0", "1", "2"]
+    assert cluster["floor"] == 2                 # grew PAST the floor
+    join_bumps = [g for g in cluster["generations"]
+                  if "joined" in g.get("reason", "")]
+    assert len(join_bumps) == 1
+    assert join_bumps[0]["members"] == ["0", "1", "2"]
+    assert join_bumps[0]["snapshot"] == "wf_a.pickle.gz"
+    # membership changes are topology, not crash loops: the restart
+    # budget is untouched
+    assert cluster["restarts"] == 0
+    rep2 = json.loads((tmp_path / "report_2.json").read_text())
+    assert rep2["attempts"], "joiner never spawned children"
+    assert rep2["attempts"][0]["generation"] \
+        == join_bumps[0]["generation"]
+
+
+def test_dead_host_shrinks_membership_not_the_run(tmp_path):
+    """Elastic shrink: with the live set still at/above the floor, a
+    dead host is EVICTED (quorum denominator follows) and the fleet
+    respawns over the survivors instead of wedging with exit 84."""
+    child = _write_child(tmp_path, FAKE_CHILD_UNTIL_RESUMED,
+                         name="child_s.py")
+    mirror_dir = str(tmp_path / "mirror")
+    path, _ = _fake_snapshot(tmp_path / "h0", name="wf_a.pickle.gz")
+    DirMirror(mirror_dir).push(path)
+    coord = ClusterCoordinator(2, host="127.0.0.1", port=0,
+                               dead_after=1.0,
+                               members=("0", "1", "2"),
+                               mirror=mirror_dir,
+                               advertise="127.0.0.1").start()
+    # host 2: a few real beats, then silence (its agent died)
+    from veles_tpu.http_util import http_post_json
+    for _ in range(3):
+        http_post_json("127.0.0.1", coord.port, "/hb",
+                       {"host": "2", "generation": 1, "term": 1,
+                        "status": "running", "epoch": 1,
+                        "snapshots": []})
+        time.sleep(0.1)
+    members = [
+        _member(tmp_path, i, coord if i == 0 else None, coord.port,
+                [sys.executable, child], mirror=mirror_dir,
+                beat_s=0.1, floor=2) for i in range(2)]
+    codes = _run_members(members, timeout=60.0)
+    assert codes == {"0": 0, "1": 0}
+    rep0 = json.loads((tmp_path / "report_0.json").read_text())
+    cluster = rep0["cluster"]
+    assert cluster["outcome"] == "completed"
+    assert cluster["dead_hosts"] == ["2"]
+    assert cluster["members"] == ["0", "1"]
+    assert cluster["quorum"] == 2      # majority of the SHRUNK set
+    shrink = [g for g in cluster["generations"]
+              if "shrinks" in g.get("reason", "")]
+    assert len(shrink) == 1 and shrink[0]["members"] == ["0", "1"]
+    assert shrink[0]["snapshot"] == "wf_a.pickle.gz"
+    assert cluster["restarts"] == 0    # eviction is not a crash loop
+
+
+def test_member_fences_stale_term_directive(tmp_path):
+    """Term fencing: a directive below the member's highest seen term
+    (a pre-partition incumbent coming back) must be ignored — treated
+    as control-plane silence, never obeyed."""
+    member = ClusterMember(
+        [["true"]], host_id="1", coordinator_addr="127.0.0.1:1",
+        floor=2, dead_after=30.0)
+    member.term = 3
+    # the adoption guard is what the run loop's fence rides on
+    assert not member._try_adopt({"term": 2, "host": "0",
+                                  "endpoint": "127.0.0.1:9"})
+    assert member.coord_port == 1                  # unchanged
+    # a NEWER announcement re-homes (and bumps the seen term)
+    assert member._try_adopt({"term": 4, "host": "2",
+                              "endpoint": "127.0.0.1:9"})
+    assert member.coord_port == 9 and member.term == 4
+    # the same record never re-adopts (a successor that died too must
+    # escalate to election, not pin the member in a re-home loop)
+    assert not member._try_adopt({"term": 4, "host": "2",
+                                  "endpoint": "127.0.0.1:9"})
+
+
+def test_seek_defers_to_lower_live_host(tmp_path):
+    """Election safety: a candidate that sees a LOWER host-id's fresh
+    presence beacon must not claim — the lowest live id owns the
+    promotion."""
+    mirror_dir = str(tmp_path / "mirror")
+    mirror = DirMirror(mirror_dir)
+    member = ClusterMember(
+        [["true"]], host_id="2", coordinator_addr="127.0.0.1:1",
+        mirror=mirror_dir, floor=2, dead_after=5.0, beat_s=0.1)
+    member.cluster_members = ["1", "2"]
+    mirror.put_meta("cluster_beacon_1.json",
+                    {"host": "1", "time": time.time(),
+                     "generation": 1, "term": 1})
+    assert member._seek_coordinator() is False
+    assert member.coordinator is None              # never promoted
+    # the coordinator record was never claimed by host 2
+    ann = mirror.get_meta("cluster_coord.json")
+    assert ann is None or ann.get("host") != "2"
+    # once host 1's beacon goes stale, host 2 IS the lowest live id:
+    # it claims term+1, settles, and promotes
+    mirror.put_meta("cluster_beacon_1.json",
+                    {"host": "1", "time": time.time() - 60.0,
+                     "generation": 1, "term": 1})
+    try:
+        assert member._seek_coordinator() is True
+        assert member.coordinator is not None
+        assert member.coordinator.term == 2
+        ann = mirror.get_meta("cluster_coord.json")
+        assert ann["host"] == "2" and ann["term"] == 2
+        assert ann["endpoint"].endswith(str(member.coord_port))
+    finally:
+        if member.coordinator is not None:
+            member.coordinator.stop()
+
+
+# == shared backoff policy (resilience/backoff.py) ===========================
+
+def test_backoff_delay_grows_caps_and_jitters():
+    from veles_tpu.resilience.backoff import backoff_delay
+    # deterministic rng: exact values checkable
+    flat = [backoff_delay(s, base=0.1, cap=2.0, jitter=0.25,
+                          rand=lambda: 0.0) for s in range(8)]
+    assert flat[:5] == [0.1, 0.2, 0.4, 0.8, 1.6]
+    assert flat[5:] == [2.0, 2.0, 2.0]              # capped
+    top = backoff_delay(3, base=0.1, cap=2.0, jitter=0.25,
+                        rand=lambda: 1.0)
+    assert abs(top - 0.8 * 1.25) < 1e-9             # jitter factor
+    # the clamped exponent: a never-give-up loop at streak 10_000 must
+    # not overflow float (the PR-4 FitnessQueueWorker fix, now shared)
+    assert backoff_delay(10_000, base=0.1, cap=2.0,
+                         rand=lambda: 0.0) == 2.0
+    assert backoff_delay(-3, base=0.1, cap=2.0,
+                         rand=lambda: 0.0) == 0.1   # floor at streak 0
+    assert backoff_delay(5, base=0.0, cap=2.0) == 0.0
+
+
+# == eager CLI validation ====================================================
+
+def test_cli_validates_cluster_flags_eagerly():
+    """Bad --cluster-hosts/--host-id pairs fail AT LAUNCH with an error
+    naming both flags — not deep inside member startup."""
+    from veles_tpu.__main__ import main
+    base = ["wf.py", "--supervise", "--cluster", "127.0.0.1:1"]
+    with pytest.raises(SystemExit, match="--cluster-hosts 0"):
+        main(base + ["--cluster-hosts", "0"])
+    with pytest.raises(SystemExit, match="--host-id -1"):
+        main(base + ["--cluster-hosts", "2", "--host-id", "-1"])
+    # a host id outside the boot membership needs --cluster-join; the
+    # error names BOTH flags and the fix
+    with pytest.raises(SystemExit) as e:
+        main(base + ["--cluster-hosts", "2", "--host-id", "5"])
+    msg = str(e.value)
+    assert "--host-id 5" in msg and "--cluster-hosts 2" in msg \
+        and "--cluster-join" in msg
+    # cluster-only flags without --cluster are rejected, not ignored
+    with pytest.raises(SystemExit, match="--cluster"):
+        main(["wf.py", "--cluster-join"])
+    with pytest.raises(SystemExit, match="--cluster"):
+        main(["wf.py", "--cluster-advertise", "10.0.0.9"])
+
+
+# == chaos matrix telemetry routing ==========================================
+
+def test_chaos_routes_outcomes_through_metrics_registry(tmp_path,
+                                                        monkeypatch):
+    """Scenario outcomes land in the ONE telemetry registry as
+    `veles_chaos_scenarios_total{result}` (plus consumed restarts in
+    `veles_restart_total`) and the JSONL sink mirrors the flush — the
+    tier-1 twin of the slow full-matrix run."""
+    from veles_tpu.telemetry import metrics as tmetrics
+    chaos = _chaos()
+    jsonl = tmp_path / "chaos_metrics.jsonl"
+    monkeypatch.setenv("VELES_METRICS_JSONL", str(jsonl))
+    tmetrics.reset_default_registry()
+    try:
+        rows = [
+            ("coord_loss", "h0:host_loss@epoch=2",
+             {"ok": True, "restarts": 2}),
+            ("join_mid_run", "join h2@+2s",
+             {"ok": True, "restarts": 0}),
+            ("shrink_below_floor", "h1:host_loss@epoch=2",
+             {"ok": False, "restarts": None}),
+        ]
+        chaos._route_telemetry(rows, cluster=True)
+        expo = tmetrics.default_registry().exposition()
+        assert 'veles_chaos_scenarios_total{result="pass"} 2' in expo
+        assert 'veles_chaos_scenarios_total{result="fail"} 1' in expo
+        assert "veles_restart_total 2" in expo
+        lines = [json.loads(ln) for ln in
+                 jsonl.read_text().splitlines() if ln.strip()]
+        assert any(row.get("source") == "chaos"
+                   and row.get("matrix") == "cluster"
+                   and row.get("metrics", {})
+                          .get("veles_restart_total") == 2.0
+                   for row in lines)
+    finally:
+        tmetrics.reset_default_registry()
+
+
 # == end-to-end with real training (slow; operational twin of
 # `tools/chaos.py --cluster`) ================================================
 
@@ -704,7 +1151,9 @@ def _chaos():
 #: scenario added to the tool fails the matching-keys check below
 #: instead of silently going untested
 _E2E_SCENARIOS = ("baseline", "kill_h0", "kill_h1", "stale_dir",
-                  "mirror_corrupt", "partition", "host_loss")
+                  "mirror_corrupt", "partition", "coord_loss",
+                  "reelect_loss", "join_mid_run", "shrink_ok",
+                  "shrink_below_floor")
 
 
 def test_e2e_matrix_matches_chaos_tool():
@@ -718,12 +1167,15 @@ def test_cluster_e2e_full_matrix(scenario):
     the acceptance criteria end-to-end: kill of either host's children,
     emptied local dir and corrupted mirror copy each recover to the
     uninterrupted final epoch with zero human intervention; a transient
-    partition is a non-event; a lost host exits 84 with machine-readable
-    dead_hosts."""
+    partition is a non-event; coordinator loss (and the re-elected
+    coordinator's loss) re-elect through the mirror record and resume
+    from the quorum snapshot; a joiner is admitted at the next
+    generation bump; a dead host shrinks the membership while the floor
+    holds, and fail-stops with exit 84 + machine-readable dead_hosts
+    below it."""
     chaos = _chaos()
-    plans, expect_rc, _ = chaos.CLUSTER_SCENARIOS[scenario]
-    r = chaos.run_cluster_scenario(scenario, plans, expect_rc,
-                                   verbose=True)
+    spec = chaos.CLUSTER_SCENARIOS[scenario]
+    r = chaos.run_cluster_scenario(scenario, spec, verbose=True)
     import shutil
     shutil.rmtree(r["tmp"], ignore_errors=True)
     assert r["ok"], r

@@ -4,7 +4,7 @@ plan the resilience layer claims to survive, and print a pass/fail
 recovery matrix.
 
     python tools/chaos.py [--keep] [--only kill,stall,...]
-    python tools/chaos.py --cluster [--only kill_h0,host_loss,...]
+    python tools/chaos.py --cluster [--only kill_h0,coord_loss,...]
 
 Each single-host scenario runs `python -m veles_tpu --supervise` on a
 tiny synthetic-classifier workflow (6 epochs, snapshots on improvement)
@@ -13,14 +13,20 @@ finished with the SAME final epoch count as the uninterrupted baseline
 — i.e. recovery was automatic and complete. Exit code: 0 when every
 scenario recovers, 1 otherwise.
 
-`--cluster` runs the CROSS-HOST matrix instead: two member processes
+`--cluster` runs the CROSS-HOST matrix instead: N member processes
 (`--supervise --cluster` on loopback, host 0 embedding the control
-plane) share a durable snapshot mirror; host 0's child is the snapshot
-writer, host 1 rejoins from the mirror. Scenarios: SIGKILL of either
-host's children (gang restart from the quorum snapshot), an emptied
-local snapshot dir (restore-from-mirror), a corrupted mirror copy
-(digest fallback), a transient control-plane partition (rejoin), and a
-lost host (quorum death -> nonzero exit + machine-readable dead_hosts).
+plane) share a durable snapshot mirror; the coordinator's host is the
+snapshot writer, the others rejoin from the mirror. Scenarios: SIGKILL
+of either host's children (gang restart from the quorum snapshot), an
+emptied local snapshot dir (restore-from-mirror), a corrupted mirror
+copy (digest fallback), a transient control-plane partition (rejoin),
+plus the ELASTIC matrix — coordinator loss (lowest live host-id
+re-elects itself through the mirror record and training resumes from
+the quorum snapshot, no rollback), re-elected-coordinator loss (a
+THIRD coordinator), join-mid-run (admitted at the next generation
+bump), a dead host shrinking the membership (run continues), and a
+shrink below the --cluster-hosts floor (clean fail-stop, exit 84 with
+machine-readable dead_hosts).
 
 This is the operational twin of tests/test_supervisor.py +
 tests/test_cluster.py: CI asserts a fast subset; this prints the whole
@@ -75,38 +81,78 @@ def run(load, main):
 '''
 
 #: cluster-matrix workflow: identical to WORKFLOW_SRC but the snapshot
-#: writer role is decided by the harness (host 1 runs with
-#: VELES_SNAPSHOT_DRY_RUN=1 and rejoins from the mirror)
+#: writer role is decided by the harness (non-coordinator hosts run
+#: with VELES_SNAPSHOT_DRY_RUN=1 and rejoin from the mirror; a host
+#: promoted by a re-election drops the pin on respawn)
 CLUSTER_WORKFLOW_SRC = WORKFLOW_SRC.replace("chaoswf", "clwf") \
     .replace("ChaosWF", "ClusterWF")
 
-#: cluster matrix: name -> (per-host fault plans {host: plan},
-#: expected exit codes, expectation blurb). Recovery scenarios must end
-#: rc 0 + FINAL 6 on every surviving host; host_loss must end 84 with
-#: dead_hosts naming host 1.
+#: cluster matrix: name -> spec dict. `hosts` boot member processes
+#: (ids 0..hosts-1) share a loopback control plane + mirror; `floor`
+#: (--cluster-hosts, default = hosts) is the MINIMUM live host count.
+#: `plans` maps host id -> VELES_FAULT_PLAN. `lost` hosts are expected
+#: to vanish (SIGKILL, nonzero rc); every other host must end rc 0
+#: with FINAL 6 — unless `expect_stop` names the clean fail-stop exit
+#: code every survivor must end with instead. `joiner_delay` starts an
+#: extra `--cluster-join` host (id = hosts) that many seconds in.
+#: Optional checks: want_restart (failure restarts consumed — or
+#: explicitly zero), want_term (a re-election reached this term),
+#: want_resume (the election bump resumed from a quorum snapshot, not
+#: scratch — the no-rollback proof), want_members (final membership),
+#: want_dead (final dead_hosts list).
 CLUSTER_SCENARIOS = {
-    "baseline": ({}, (0, 0), "uninterrupted 2-host run completes"),
-    "kill_h0": ({0: "kill@epoch=2"}, (0, 0),
-                "writer host's children SIGKILLed -> gang restart from "
-                "quorum snapshot"),
-    "kill_h1": ({1: "kill@epoch=2"}, (0, 0),
-                "snapshot-less host's children SIGKILLed -> restart, "
-                "rejoin from mirror"),
-    "stale_dir": ({0: "kill@epoch=2; stale_local_dir@restart=1"},
-                  (0, 0),
-                  "writer's local snapshot dir emptied at respawn -> "
-                  "restore from mirror"),
-    "mirror_corrupt": ({0: "mirror_corrupt@push=2; kill@epoch=3"},
-                       (0, 0),
-                       "corrupted mirror copy refused by digest at "
-                       "restore -> blacklisted from future votes, "
-                       "fleet still recovers"),
-    "partition": ({1: "partition@beat=3"}, (0, 0),
-                  "transient control-plane partition (< dead_after) -> "
-                  "member rejoins, run completes"),
-    "host_loss": ({1: "host_loss@epoch=2"}, (84, None),
-                  "host 1 vanishes (agent + children) -> quorum death, "
-                  "exit 84 with machine-readable dead_hosts"),
+    "baseline": dict(
+        hosts=2, blurb="uninterrupted 2-host run completes"),
+    "kill_h0": dict(
+        hosts=2, plans={0: "kill@epoch=2"}, want_restart=True,
+        blurb="writer host's children SIGKILLed -> gang restart from "
+              "quorum snapshot"),
+    "kill_h1": dict(
+        hosts=2, plans={1: "kill@epoch=2"}, want_restart=True,
+        blurb="snapshot-less host's children SIGKILLed -> restart, "
+              "rejoin from mirror"),
+    "stale_dir": dict(
+        hosts=2, plans={0: "kill@epoch=2; stale_local_dir@restart=1"},
+        want_restart=True,
+        blurb="writer's local snapshot dir emptied at respawn -> "
+              "restore from mirror"),
+    "mirror_corrupt": dict(
+        hosts=2, plans={0: "mirror_corrupt@push=2; kill@epoch=3"},
+        want_restart=True,
+        blurb="corrupted mirror copy refused by digest at restore -> "
+              "blacklisted from future votes, fleet still recovers"),
+    "partition": dict(
+        hosts=2, plans={1: "partition@beat=3"}, want_restart=False,
+        blurb="transient control-plane partition (< dead_after) -> "
+              "member rejoins, run completes"),
+    "coord_loss": dict(
+        hosts=3, floor=2, plans={0: "host_loss@epoch=2"}, lost=(0,),
+        want_term=2, want_resume=True,
+        blurb="coordinator host vanishes -> lowest live host-id "
+              "re-elects itself (term 2), training resumes from the "
+              "quorum snapshot with no rollback"),
+    "reelect_loss": dict(
+        hosts=4, floor=2,
+        plans={0: "host_loss@epoch=2", 1: "coord_loss@term=2"},
+        lost=(0, 1), want_term=3,
+        blurb="the RE-ELECTED coordinator vanishes too -> survivors "
+              "elect a third coordinator (term 3) and finish"),
+    "join_mid_run": dict(
+        hosts=2, joiner_delay=2.0, want_members=["0", "1", "2"],
+        blurb="a new host joins mid-run (--cluster-join) -> admitted "
+              "at the next generation bump, fleet rebuilds over N+1"),
+    "shrink_ok": dict(
+        hosts=3, floor=2, plans={2: "host_loss@epoch=2"}, lost=(2,),
+        want_dead=["2"],
+        blurb="a host above the floor vanishes -> membership (and the "
+              "quorum denominator) shrinks, run completes on the "
+              "survivors"),
+    "shrink_below_floor": dict(
+        hosts=2, plans={1: "host_loss@epoch=2"}, lost=(1,),
+        expect_stop=84, want_dead=["1"],
+        blurb="a host loss that would drop the live set below the "
+              "--cluster-hosts floor -> clean fail-stop, exit 84 with "
+              "machine-readable dead_hosts"),
 }
 
 
@@ -119,88 +165,159 @@ def _free_port() -> int:
     return port
 
 
-def run_cluster_scenario(name: str, plans: dict, expect_rc,
-                         verbose: bool) -> dict:
+def _spawn_member(tmp: str, wf_py: str, mirror: str, port: int,
+                  host: int, floor: int, plan, join: bool = False):
+    """One member agent process (+ report path). The coordinator's
+    host is the snapshot writer; everyone else runs with
+    VELES_SNAPSHOT_DRY_RUN=1 (a member promoted after a re-election
+    drops the pin on respawn — the writer role follows the control
+    plane)."""
+    local = os.path.join(tmp, f"h{host}")
+    os.makedirs(local, exist_ok=True)
+    report = os.path.join(tmp, f"report_{host}.json")
+    env = dict(os.environ)
+    for var in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
+                "VELES_FAULT_STATE", "VELES_FAULT_PLAN",
+                "VELES_SNAPSHOT_DRY_RUN"):
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if host != 0:
+        env["VELES_SNAPSHOT_DRY_RUN"] = "1"
+    if plan:
+        env["VELES_FAULT_PLAN"] = plan
+    cmd = [sys.executable, "-m", "veles_tpu", wf_py, "--no-stats",
+           "-v", "--supervise",
+           "--cluster", f"127.0.0.1:{port}",
+           "--cluster-hosts", str(floor), "--host-id", str(host),
+           "--cluster-beat", "0.5", "--cluster-dead-after", "8",
+           "--max-restarts", "3",
+           "--snapshot-dir", local, "--snapshot-prefix", "clwf",
+           "--mirror", mirror, "--supervise-report", report]
+    if join or host >= floor:
+        # any id outside 0..floor-1 enters through the join path —
+        # whether it boots with the fleet (hosts above the floor) or
+        # arrives mid-run
+        cmd.append("--cluster-join")
+    cmd.append(f"root.clwf.snapshot_dir={local}")
+    proc = subprocess.Popen(cmd, env=env, cwd=tmp,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    return proc, report
+
+
+def run_cluster_scenario(name: str, spec: dict, verbose: bool) -> dict:
     tmp = tempfile.mkdtemp(prefix=f"chaos_cluster_{name}_")
     wf_py = os.path.join(tmp, "clwf.py")
     with open(wf_py, "w") as f:
         f.write(CLUSTER_WORKFLOW_SRC)
     mirror = os.path.join(tmp, "mirror")
     port = _free_port()
-    procs, reports, local_dirs = [], [], []
+    n_hosts = spec["hosts"]
+    floor = spec.get("floor", n_hosts)
+    plans = spec.get("plans", {})
+    lost = {str(h) for h in spec.get("lost", ())}
+    procs, reports = {}, {}
     t0 = time.time()
-    for host in (0, 1):
-        local = os.path.join(tmp, f"h{host}")
-        os.makedirs(local, exist_ok=True)
-        local_dirs.append(local)
-        report = os.path.join(tmp, f"report_{host}.json")
-        reports.append(report)
-        env = dict(os.environ)
-        for var in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
-                    "VELES_FAULT_STATE", "VELES_FAULT_PLAN",
-                    "VELES_SNAPSHOT_DRY_RUN"):
-            env.pop(var, None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        if host != 0:
-            env["VELES_SNAPSHOT_DRY_RUN"] = "1"   # single-writer
-        if plans.get(host):
-            env["VELES_FAULT_PLAN"] = plans[host]
-        cmd = [sys.executable, "-m", "veles_tpu", wf_py, "--no-stats",
-               "-v", "--supervise",
-               "--cluster", f"127.0.0.1:{port}",
-               "--cluster-hosts", "2", "--host-id", str(host),
-               "--cluster-beat", "0.5", "--cluster-dead-after", "8",
-               "--max-restarts", "3",
-               "--snapshot-dir", local, "--snapshot-prefix", "clwf",
-               "--mirror", mirror, "--supervise-report", report,
-               f"root.clwf.snapshot_dir={local}"]
-        procs.append(subprocess.Popen(
-            cmd, env=env, cwd=tmp, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
+    for host in range(n_hosts):
+        procs[str(host)], reports[str(host)] = _spawn_member(
+            tmp, wf_py, mirror, port, host, floor, plans.get(host))
         if host == 0:
             time.sleep(1.0)     # let the control plane bind first
-    outs = []
-    rcs = []
-    for p in procs:
+    if spec.get("joiner_delay"):
+        time.sleep(float(spec["joiner_delay"]))
+        procs[str(n_hosts)], reports[str(n_hosts)] = _spawn_member(
+            tmp, wf_py, mirror, port, n_hosts, floor,
+            plans.get(n_hosts), join=True)
+    outs, rcs = {}, {}
+    deadline = time.time() + 600
+    for host, p in procs.items():
         try:
-            out, err = p.communicate(timeout=600)
+            out, err = p.communicate(
+                timeout=max(5.0, deadline - time.time()))
         except subprocess.TimeoutExpired:
             p.kill()
             out, err = p.communicate()
-        outs.append((out, err))
-        rcs.append(p.returncode)
+        outs[host] = (out, err)
+        rcs[host] = p.returncode
     elapsed = time.time() - t0
 
     def final_epoch(out):
         lines = [ln for ln in out.splitlines() if ln.startswith("FINAL")]
         return int(lines[-1].split()[1]) if lines else None
 
-    rep0 = None
-    if os.path.exists(reports[0]):
-        with open(reports[0]) as f:
-            rep0 = json.load(f)
-    cluster = (rep0 or {}).get("cluster") or {}
-    finals = [final_epoch(o) for o, _ in outs]
-    if expect_rc == (84, None):      # host-loss: h1 was SIGKILLed
-        ok = (rcs[0] == 84 and cluster.get("dead_hosts") == ["1"]
-              and (rep0 or {}).get("dead_hosts") == ["1"])
+    finals = {h: final_epoch(o) for h, (o, _) in outs.items()}
+    # the authoritative cluster summary lives in the LAST coordinator's
+    # report — after re-elections that is not necessarily host 0: pick
+    # the cluster block with the highest (term, generation)
+    cluster, top_report = {}, None
+    for h, path in sorted(reports.items()):
+        if not os.path.exists(path):
+            continue            # a lost host never writes its report
+        with open(path) as f:
+            rep = json.load(f)
+        c = rep.get("cluster") or {}
+        if c and ((c.get("term") or 0, c.get("generation") or 0)
+                  >= (cluster.get("term") or 0,
+                      cluster.get("generation") or 0)):
+            cluster, top_report = c, rep
+    survivors = [h for h in procs if h not in lost]
+    problems = []
+    stop_rc = spec.get("expect_stop")
+    if stop_rc:
+        for h in survivors:
+            if rcs[h] != stop_rc:
+                problems.append(f"host {h} rc {rcs[h]} != {stop_rc}")
+        if cluster.get("exit_code") != stop_rc:
+            problems.append(
+                f"cluster exit_code {cluster.get('exit_code')}")
+        if (top_report or {}).get("dead_hosts") != spec.get("want_dead"):
+            problems.append("report-level dead_hosts missing")
     else:
-        ok = (tuple(rcs) == expect_rc
-              and all(f == 6 for f in finals)
-              and cluster.get("outcome") == "completed")
-        if plans and name != "partition":
-            # a fault scenario that never needed a restart is a FAIL
-            ok = ok and cluster.get("restarts", 0) >= 1
-        if name == "partition":
-            ok = ok and cluster.get("restarts", 0) == 0
+        for h in survivors:
+            if rcs[h] != 0:
+                problems.append(f"host {h} rc {rcs[h]} != 0")
+            if finals.get(h) != 6:
+                problems.append(f"host {h} FINAL {finals.get(h)} != 6")
+        if cluster.get("outcome") != "completed":
+            problems.append(f"outcome {cluster.get('outcome')!r}")
+    for h in lost:
+        if rcs.get(h) == 0:
+            problems.append(f"lost host {h} exited 0")
+    if spec.get("want_restart") is True and not cluster.get("restarts"):
+        problems.append("no failure restart consumed")
+    if spec.get("want_restart") is False and cluster.get("restarts"):
+        problems.append(f"unexpected restarts {cluster.get('restarts')}")
+    if spec.get("want_term") and (cluster.get("term") or 0) \
+            < spec["want_term"]:
+        problems.append(
+            f"term {cluster.get('term')} < {spec['want_term']}")
+    if spec.get("want_resume"):
+        bumps = [g for g in cluster.get("generations", ())
+                 if "re-elected" in str(g.get("reason", ""))]
+        if not bumps or not bumps[0].get("snapshot"):
+            problems.append("election bump did not resume from a "
+                            "quorum snapshot (rollback hazard)")
+    if spec.get("want_members") is not None \
+            and cluster.get("members") != spec["want_members"]:
+        problems.append(f"members {cluster.get('members')} != "
+                        f"{spec['want_members']}")
+    if spec.get("want_dead") is not None \
+            and cluster.get("dead_hosts") != spec["want_dead"]:
+        problems.append(f"dead_hosts {cluster.get('dead_hosts')} != "
+                        f"{spec['want_dead']}")
+    ok = not problems
     if verbose and not ok:
-        for i, (out, err) in enumerate(outs):
-            sys.stderr.write(f"--- host {i} rc={rcs[i]} ---\n"
+        sys.stderr.write(f"--- {name} problems: {problems} ---\n")
+        for h, (out, err) in sorted(outs.items()):
+            sys.stderr.write(f"--- host {h} rc={rcs[h]} ---\n"
                              + err[-2500:] + "\n")
-    return {"tmp": tmp, "ok": ok, "rc": tuple(rcs),
-            "final_epoch": finals[0], "generation":
-                cluster.get("generation"),
+    return {"tmp": tmp, "ok": ok, "problems": problems,
+            "rc": tuple(rcs[h] for h in sorted(rcs, key=int)),
+            "final_epoch": max((f for f in finals.values()
+                                if f is not None), default=None),
+            "generation": cluster.get("generation"),
+            "term": cluster.get("term"),
             "restarts": cluster.get("restarts"),
             "dead_hosts": cluster.get("dead_hosts"),
             "elapsed": elapsed}
@@ -319,30 +436,35 @@ def main() -> int:
 
     if args.cluster:
         rows = []
-        for name, (plans, expect_rc, blurb) in CLUSTER_SCENARIOS.items():
+        for name, spec in CLUSTER_SCENARIOS.items():
             if only and name not in only:
                 continue
-            print(f"chaos[cluster]: {name}: {blurb} …", flush=True)
-            r = run_cluster_scenario(name, plans, expect_rc,
-                                     args.verbose)
-            plan_str = "; ".join(f"h{h}:{p}"
-                                 for h, p in plans.items()) or "—"
-            rows.append((name, plan_str, r))
+            print(f"chaos[cluster]: {name}: {spec['blurb']} …",
+                  flush=True)
+            r = run_cluster_scenario(name, spec, args.verbose)
+            plan_str = "; ".join(f"h{h}:{p}" for h, p in
+                                 spec.get("plans", {}).items())
+            if spec.get("joiner_delay"):
+                plan_str = (plan_str + "; " if plan_str else "") + \
+                    f"join h{spec['hosts']}@+{spec['joiner_delay']:.0f}s"
+            rows.append((name, plan_str or "—", r))
             if not args.keep:
                 import shutil
                 shutil.rmtree(r["tmp"], ignore_errors=True)
         print()
-        print(f"{'scenario':<15} {'fault plan':<42} {'ok':<5} "
-              f"{'rc':<10} {'gen':<4} {'restarts':<9} {'dead':<8} "
-              f"{'secs':<6}")
+        print(f"{'scenario':<19} {'fault plan':<44} {'ok':<5} "
+              f"{'rc':<18} {'gen':<4} {'term':<5} {'restarts':<9} "
+              f"{'dead':<6} {'secs':<6}")
         failed = 0
         for name, plan, r in rows:
             verdict = "PASS" if r["ok"] else "FAIL"
             failed += not r["ok"]
-            print(f"{name:<15} {plan:<42} {verdict:<5} "
-                  f"{str(r['rc']):<10} {str(r['generation'] or '-'):<4} "
+            print(f"{name:<19} {plan:<44} {verdict:<5} "
+                  f"{str(r['rc']):<18} "
+                  f"{str(r['generation'] or '-'):<4} "
+                  f"{str(r['term'] or '-'):<5} "
                   f"{str(r['restarts'] if r['restarts'] is not None else '-'):<9} "
-                  f"{','.join(r['dead_hosts'] or []) or '-':<8} "
+                  f"{','.join(r['dead_hosts'] or []) or '-':<6} "
                   f"{r['elapsed']:<6.1f}")
         print()
         _route_telemetry(rows, cluster=True)

@@ -238,11 +238,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "coordinated generation counter, dead-host "
                         "declaration for the scheduler")
     p.add_argument("--cluster-hosts", type=int, default=1, metavar="N",
-                   help="total hosts in the --cluster job (quorum is "
-                        "N//2+1)")
+                   help="the cluster's host-count FLOOR (minimum live "
+                        "hosts, >= 1): boot hosts use ids 0..N-1, "
+                        "joiners grow the membership past it, deaths "
+                        "shrink back down to it (below = fail-stop "
+                        "exit 84); quorum follows the live membership "
+                        "(majority)")
     p.add_argument("--host-id", type=int, default=0, metavar="K",
                    help="this host's index in the --cluster job "
-                        "(0 also runs the coordinator)")
+                        "(0 also runs the coordinator; ids >= "
+                        "--cluster-hosts need --cluster-join)")
+    p.add_argument("--cluster-join", action="store_true",
+                   help="join a RUNNING --cluster job mid-run with a "
+                        "host id outside the boot membership: the host "
+                        "announces itself via the control plane's "
+                        "/join endpoint and is admitted at the next "
+                        "generation bump (the gang respawn rebuilds "
+                        "the job over the grown host set)")
+    p.add_argument("--cluster-advertise", default="", metavar="HOST",
+                   help="address peers can reach THIS host on if it "
+                        "is promoted to coordinator after a "
+                        "re-election (default: 127.0.0.1 when the "
+                        "--cluster address is loopback, else this "
+                        "host's fqdn)")
     p.add_argument("--cluster-beat", type=float, default=1.0,
                    metavar="SECONDS",
                    help="cluster heartbeat interval (default 1.0)")
@@ -299,7 +317,9 @@ _SUPERVISOR_FLAGS = {"--supervise": False, "--max-restarts": True,
                      "--snapshot-prefix": True, "--supervise-report": True,
                      "--cluster": True, "--cluster-hosts": True,
                      "--host-id": True, "--cluster-beat": True,
-                     "--cluster-dead-after": True}
+                     "--cluster-dead-after": True,
+                     "--cluster-join": False,
+                     "--cluster-advertise": True}
 
 
 def _supervise(args, argv) -> int:
@@ -322,6 +342,22 @@ def _supervise(args, argv) -> int:
     if args.cluster:
         from veles_tpu.resilience.cluster import (ClusterCoordinator,
                                                   ClusterMember)
+        # eager flag validation: a bad floor/id pair must fail HERE,
+        # naming both flags, not deep inside member startup
+        if args.cluster_hosts < 1:
+            raise SystemExit(
+                f"--cluster-hosts {args.cluster_hosts} is not a valid "
+                f"floor: it is the MINIMUM live host count and must "
+                f"be >= 1")
+        if args.host_id < 0:
+            raise SystemExit(f"--host-id {args.host_id} must be >= 0")
+        if args.host_id >= args.cluster_hosts and not args.cluster_join:
+            raise SystemExit(
+                f"--host-id {args.host_id} is outside the boot "
+                f"membership 0..{args.cluster_hosts - 1} implied by "
+                f"--cluster-hosts {args.cluster_hosts}: boot hosts "
+                f"use ids below the floor; pass --cluster-join to "
+                f"join a running cluster with a new id")
         token = os.environ.get("VELES_WEB_TOKEN") or None
         host, _, port = args.cluster.rpartition(":")
         if not port.isdigit():
@@ -336,13 +372,30 @@ def _supervise(args, argv) -> int:
                 "--cluster on a non-loopback address needs a shared "
                 "secret: set VELES_WEB_TOKEN on every host (or bind "
                 "127.0.0.1:PORT for single-box tests)")
+        loopback = host in ("127.0.0.1", "localhost", "::1")
+        if args.cluster_advertise:
+            advertise = args.cluster_advertise
+        elif loopback:
+            advertise = "127.0.0.1"
+        else:
+            import socket
+            advertise = socket.getfqdn()
         coordinator = None
-        if args.host_id == 0:
+        if args.host_id == 0 and not args.cluster_join:
+            # a re-placed host 0 REJOINING an elected cluster must not
+            # bind a rival control plane: --cluster-join skips the
+            # embedded coordinator and re-homes via the mirror record
             coordinator = ClusterCoordinator(
                 args.cluster_hosts, host=host or "0.0.0.0",
                 port=int(port), token=token,
                 dead_after=args.cluster_dead_after,
-                max_restarts=args.max_restarts).start()
+                max_restarts=args.max_restarts,
+                mirror=args.mirror, coord_id="0",
+                # the ANNOUNCED endpoint must be an address peers can
+                # actually dial — never the bind host (a 0.0.0.0 bind
+                # announced verbatim would re-home every member to its
+                # own loopback)
+                advertise=advertise).start()
         member = ClusterMember(
             [cmd], host_id=str(args.host_id),
             coordinator_addr=f"{host or '127.0.0.1'}:{port}",
@@ -352,7 +405,11 @@ def _supervise(args, argv) -> int:
             mirror=args.mirror, token=token, beat_s=args.cluster_beat,
             coord_timeout=max(args.cluster_dead_after * 2, 10.0),
             stall_timeout=args.stall_timeout,
-            report_path=args.supervise_report)
+            report_path=args.supervise_report,
+            floor=args.cluster_hosts,
+            dead_after=args.cluster_dead_after,
+            max_restarts=args.max_restarts,
+            join=args.cluster_join, advertise=advertise)
         return member.run()
     sup = Supervisor(
         [cmd], snapshot_dir=args.snapshot_dir,
@@ -384,6 +441,13 @@ def main(argv=None) -> int:
     if args.cluster and not args.supervise:
         raise SystemExit("--cluster is a supervision mode: combine it "
                          "with --supervise")
+    if (args.cluster_join or args.cluster_advertise) and \
+            not args.cluster:
+        # the --feed-ahead precedent: a cluster-only flag without
+        # --cluster would be silently ignored — reject it instead
+        raise SystemExit("--cluster-join/--cluster-advertise only "
+                         "apply to --cluster runs: add --supervise "
+                         "--cluster HOST:PORT")
     if args.supervise:
         return _supervise(args, argv if argv is not None else sys.argv[1:])
     if args.no_plot:

@@ -7,12 +7,21 @@ snapshot" — this package turns that from a manual procedure into code:
   process(es), detects death AND hangs (heartbeat file touched every
   epoch), and restarts from `Snapshotter.latest` with a bounded retry
   budget, exponential backoff + jitter and a no-progress cutoff.
-- `cluster.py` — cross-host supervision: per-host `ClusterMember`
-  agents join a `ClusterCoordinator` HTTP control plane that decides
-  restarts by QUORUM (newest snapshot visible to a majority of hosts),
-  gang-restarts the whole job on a coordinated generation counter, and
-  declares silent hosts dead (machine-readable `dead_hosts` in the
-  exit report for the scheduler).
+- `cluster.py` — ELASTIC cross-host supervision: per-host
+  `ClusterMember` agents join a `ClusterCoordinator` HTTP control
+  plane that decides restarts by QUORUM (newest snapshot visible to a
+  majority of the live membership) and gang-restarts the whole job on
+  a coordinated generation counter. The membership is elastic
+  (`--cluster-hosts` is a floor): joiners are admitted at the next
+  generation bump, dead hosts shrink the membership + quorum
+  denominator, and only a drop below the floor fail-stops (exit 84,
+  machine-readable `dead_hosts`). The coordinator itself is
+  re-electable: terms + endpoint announcements persist through the
+  mirror store, the lowest live host-id promotes itself when the
+  control plane goes silent, and stale coordinators are term-fenced.
+- `backoff.py` — the ONE jittered-exponential-backoff formula
+  (clamped exponent) shared by the fitness-queue worker, the
+  Supervisor, and the member reconnect/re-home loops.
 - `mirror.py` — snapshot durability: every atomic local write is
   mirrored (second directory or HTTP store) with verify-on-upload and
   idempotent re-push; restores fall back to the mirror when the local

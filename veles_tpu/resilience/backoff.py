@@ -1,0 +1,41 @@
+"""Shared jittered-exponential-backoff policy.
+
+One formula for every reconnect/retry loop that talks to a peer which
+may be briefly down: ``min(base * 2^streak, cap)`` scaled by a random
+jitter factor in ``[1, 1 + jitter)``. The exponent is clamped BEFORE
+the multiply — ``2 ** streak`` overflows float around streak 1030,
+which a never-give-up loop eventually reaches — and the jitter
+decorrelates the retry instants across a fleet so a recovering
+coordinator never takes a thundering herd the moment it comes back.
+
+Consumers: the fitness-queue worker's poll loop (task_queue.py), the
+Supervisor's restart backoff, and the cluster member's control-plane
+reconnect / re-home loop (resilience/cluster.py). Extracted here so
+the clamped-exponent fix exists exactly once.
+
+Import-light on purpose (stdlib only): the supervisor/member processes
+use this and must never initialize jax.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+#: clamp for the exponent: far past any real cap crossing, far below
+#: float overflow (2**30 * any sane base saturates every cap)
+MAX_EXPONENT = 30
+
+
+def backoff_delay(streak: int, *, base: float, cap: float,
+                  jitter: float = 0.25,
+                  rand: Callable[[], float] = random.random) -> float:
+    """Delay before retry number ``streak`` (0-based: the first retry
+    after the first failure passes 0). ``rand`` is injectable for
+    deterministic tests; the default is module-level ``random.random``
+    so fleet members stay decorrelated."""
+    if base <= 0.0:
+        return 0.0
+    delay = min(base * (2 ** min(max(int(streak), 0), MAX_EXPONENT)),
+                cap)
+    return delay * (1.0 + jitter * rand())

@@ -25,9 +25,36 @@ back. This module closes both gaps (ROADMAP "Still manual" items):
   **from the mirror** (resilience/mirror.py) when the local copy is
   missing or corrupt, so a re-placed host rejoins from durable state.
 
+The cluster is ELASTIC (the PR-4 plane fixed N hosts and made host 0 a
+control-plane SPOF; this closes both):
+
+- **Coordinator re-election.** Every directive and beat carries a
+  monotone election TERM, persisted (with the coordinator's endpoint)
+  as a meta record on the mirror store — the shared truth. Members
+  that observe the coordinator silent past `dead_after` re-home to a
+  newer announced endpoint, or — when this host holds the LOWEST live
+  host-id by the mirror's presence beacons — claim term+1, wait a
+  jittered settle window for a lower-id claim to override, then bind a
+  fresh coordinator and announce it. The promoted coordinator GATHERS
+  the re-homed members' reports and bumps the generation with the
+  quorum snapshot pick, so promotion can never roll the fleet back
+  past what a majority already saw. Directives from a stale term are
+  rejected by every member (fencing); a minority-island incumbent
+  sweeps its members dead, falls below the floor and fail-stops.
+- **Elastic membership.** `n_hosts` is a FLOOR, not a constant. A
+  joining host (`--cluster-join`, host-id outside the boot set) is
+  admitted at the next generation bump; a host silent past
+  `dead_after` is evicted and the quorum denominator SHRINKS with the
+  membership — the gang respawn rebuilds the job over the live set
+  (children see it via `VELES_CLUSTER_*`; the PR-6 vel-reshard-on-
+  restore path carries training state across the data-axis size
+  change). Only when the live set would drop below the floor does the
+  run fail-stop with exit 84 and the machine-readable `dead_hosts`
+  report.
+
 The SPMD contract stays the reference's (SURVEY.md §5.3): one process
-lost = the collective is dead = restart the JOB — now cluster-wide and
-from an agreed-on snapshot.
+lost = the collective is dead = restart the JOB — now cluster-wide,
+from an agreed-on snapshot, over whatever hosts are actually alive.
 
 Import-light on purpose: no jax, no workflow machinery — members and
 the coordinator are the processes that must outlive any model bug.
@@ -38,22 +65,48 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import signal
 import subprocess
 import sys
 import tempfile
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Set
 
 from veles_tpu.logger import Logger
 from veles_tpu.resilience import (EXIT_GIVEUP, EXIT_HOST_DEAD,
                                   EXIT_ISOLATED, EXIT_NONFINITE)
+from veles_tpu.resilience.backoff import backoff_delay
 from veles_tpu.resilience.supervisor import read_heartbeat
 
 #: heartbeats a partition fault suppresses once it fires (long enough
 #: to be visible in the coordinator's beat ages, short enough to stay
 #: under any sane dead_after so the member REJOINS instead of dying)
 PARTITION_BEATS = 3
+
+#: mirror meta record carrying the control plane's shared truth:
+#: {"term", "host", "endpoint", "generation", "time"} — written by the
+#: live coordinator at start and on every bump, overwritten by an
+#: election claim (endpoint "" until the winner binds). Never contains
+#: ".pickle", so it can never appear in snapshot votes.
+COORD_META = "cluster_coord.json"
+
+#: per-host presence beacon (same store): {"host", "time", "generation",
+#: "term"} — the election's liveness view. Wall-clock ages, same
+#: NTP-synced-fleet assumption as the quorum rule's snapshot mtimes.
+BEACON_META = "cluster_beacon_{host}.json"
+
+#: beats between beacon refreshes while the control plane is reachable
+#: (every failover probe also refreshes, so election-time liveness is
+#: fresh to within one probe interval)
+BEACON_EVERY = 5
+
+
+def _host_key(host_id: str):
+    """Ordering for 'lowest live host-id wins': numeric ids compare
+    numerically ("2" < "10"), non-numeric ids sort after, lexically."""
+    s = str(host_id)
+    return (0, int(s), "") if s.isdigit() else (1, 0, s)
 
 
 # -- quorum decision (pure function: the unit-testable core) ------------------
@@ -110,18 +163,33 @@ class ClusterCoordinator(Logger):
                  join_grace: float = 120.0, max_restarts: int = 3,
                  no_progress_limit: int = 2,
                  backoff_base: float = 1.0, backoff_max: float = 30.0,
-                 max_body: int = 1 << 20) -> None:
+                 max_body: int = 1 << 20, term: int = 1,
+                 members: Optional[Sequence[str]] = None,
+                 mirror: str = "", coord_id: str = "0",
+                 advertise: str = "", gather: bool = False) -> None:
         super().__init__()
         if n_hosts < 1:
             raise ValueError(f"n_hosts must be >= 1 (got {n_hosts})")
-        self.n_hosts = n_hosts
-        #: majority by default; an explicit quorum may be smaller (2-of-5
-        #: when three hosts share no storage) but never below 1
-        self.quorum = quorum or (n_hosts // 2 + 1)
+        #: the MINIMUM live host count, not an exact size: membership
+        #: grows past it on joins and shrinks down to it on deaths;
+        #: dropping BELOW it is the fail-stop condition
+        self.floor = n_hosts
+        self.n_hosts = n_hosts          # back-compat alias of `floor`
+        #: current expected membership (host ids). Boot clusters run
+        #: hosts 0..floor-1; a promoted coordinator passes the live set
+        self.members: Set[str] = (
+            {str(m) for m in members} if members
+            else {str(i) for i in range(n_hosts)})
+        #: majority OF THE CURRENT MEMBERSHIP by default, recomputed on
+        #: every membership change; an explicit quorum may be smaller
+        #: (2-of-5 when three hosts share no storage) but is then FIXED
+        self._quorum_fixed = bool(quorum)
+        self.quorum = quorum or (len(self.members) // 2 + 1)
         self.host = host
         self.port = port
         self.token = token
-        #: a host silent this long is DEAD (scheduler must re-place it)
+        #: a host silent this long is DEAD (evicted while the live set
+        #: stays at/above the floor; fail-stop below it)
         self.dead_after = dead_after
         #: grace for hosts that never reported at all (first contact
         #: includes process scheduling + interpreter start on a fresh VM)
@@ -131,11 +199,29 @@ class ClusterCoordinator(Logger):
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.max_body = max_body
+        #: monotone election term: every directive carries it, members
+        #: reject anything below the highest term they have seen, and
+        #: the mirror meta record persists it across coordinators
+        self.term = int(term)
+        self.mirror_spec = mirror
+        #: the host id this coordinator runs on (the announcement's
+        #: "host") and the address peers can reach it at
+        self.coord_id = str(coord_id)
+        self.advertise = advertise
+        #: a PROMOTED coordinator starts in gather mode: the inherited
+        #: generation is unknown until the re-homed members report, so
+        #: the first bump (generation := max reported + 1, quorum
+        #: snapshot pick) waits for all expected members or the gather
+        #: deadline — until then directives carry generation 0, which
+        #: never triggers a respawn, so surviving children keep
+        #: training through the election
+        self._gather = bool(gather)
+        self._gather_deadline = 0.0
         self._lock = threading.Lock()
         self._started = time.monotonic()
         #: host_id -> {"last_beat": monotonic, "report": {...}}
         self._hosts: Dict[str, Dict[str, Any]] = {}
-        self.generation = 1
+        self.generation = 0 if gather else 1
         self.snapshot: Optional[str] = None   # directive for current gen
         self.action = "run"
         self.exit_code = 0
@@ -144,9 +230,15 @@ class ClusterCoordinator(Logger):
         self.restarts = 0
         self._best_epoch = -1
         self._stagnant = 0
+        self._superseded = False
+        #: pending coordinator announcement (built under _lock, mirror
+        #: I/O done by _flush_announce after release)
+        self._announce_record: Optional[Dict[str, Any]] = None
         #: per-generation log for the exit report
-        self.generations: List[Dict[str, Any]] = [
-            {"generation": 1, "snapshot": None, "reason": "initial"}]
+        self.generations: List[Dict[str, Any]] = [] if gather else [
+            {"generation": 1, "snapshot": None, "reason": "initial",
+             "members": sorted(self.members, key=_host_key),
+             "term": self.term}]
         #: hosts that have RECEIVED a terminal (done/stop) directive —
         #: the embedding member drains on this before tearing the
         #: control plane down, so no peer is left polling a dead port
@@ -156,15 +248,51 @@ class ClusterCoordinator(Logger):
 
     # -- decision core (in-process API; HTTP is transport only) ---------------
 
-    def handle_beat(self, report: Dict[str, Any]) -> Dict[str, Any]:
+    def handle_beat(self, report: Dict[str, Any],
+                    joining: bool = False) -> Dict[str, Any]:
         """Ingest one host heartbeat, advance the state machine, return
         the directive the host must follow."""
         now = time.monotonic()
         host_id = str(report.get("host", ""))[:128]
         with self._lock:
             self._hosts[host_id] = {"last_beat": now, "report": report}
+            rterm = int(report.get("term", 0) or 0)
+            if rterm > self.term and not self._superseded:
+                # a successor was elected while this coordinator was on
+                # the wrong side of a partition: every member fences
+                # its directives out by term anyway; the dead-sweep of
+                # its minority island is what actually stops it
+                self._superseded = True
+                self.error("superseded: beat from host %s carries term "
+                           "%d > own %d — a newer coordinator exists; "
+                           "this one's directives are fenced out",
+                           host_id, rterm, self.term)
+            if self.action == "run" and host_id not in self.members:
+                if self._gather:
+                    # the promoted coordinator's liveness view missed a
+                    # host that turned out alive: fold it into the
+                    # membership the gather bump will announce
+                    self.members.add(host_id)
+                    self._recompute_quorum()
+                else:
+                    # join (or a re-placed dead host rejoining):
+                    # admitted at the NEXT generation bump — which this
+                    # is, so the whole fleet rebuilds over the new set
+                    self._membership_bump(
+                        f"host {host_id} "
+                        f"{'joined' if joining else 'reappeared'} — "
+                        f"membership grows to "
+                        f"{len(self.members) + 1}",
+                        admit={host_id})
+            if self.action == "run" and self._gather and (
+                    self.members <= set(self._hosts)
+                    or now > self._gather_deadline):
+                self._gather = False
+                self._membership_bump(
+                    f"coordinator re-elected (term {self.term}) — "
+                    f"resuming from the quorum snapshot")
             self._sweep_dead(now)
-            if self.action == "run":
+            if self.action == "run" and not self._gather:
                 status = report.get("status")
                 gen = int(report.get("generation", 0))
                 if status == "failed" and gen == self.generation:
@@ -179,38 +307,76 @@ class ClusterCoordinator(Logger):
             directive = self._directive()
             if directive["action"] in ("done", "stop"):
                 self._acked.add(host_id)
-            return directive
+        self._flush_announce()
+        return directive
+
+    def handle_join(self, report: Dict[str, Any]) -> Dict[str, Any]:
+        """The explicit admission endpoint (`POST /join`): a joining
+        host announces itself before its first beat; admission happens
+        at the next generation bump, and the returned directive names
+        the generation (and membership) it was admitted into."""
+        self.info("join request from host %s",
+                  str(report.get("host", ""))[:128])
+        return self.handle_beat(report, joining=True)
+
+    def _recompute_quorum(self) -> None:
+        if not self._quorum_fixed:
+            self.quorum = len(self.members) // 2 + 1
 
     def _sweep_dead(self, now: float) -> None:
-        dead = [hid for hid, h in self._hosts.items()
-                if now - h["last_beat"] > self.dead_after]
-        if len(self._hosts) < self.n_hosts \
-                and now - self._started > max(self.join_grace,
-                                              self.dead_after):
-            expected = {str(i) for i in range(self.n_hosts)}
-            dead += sorted(expected - set(self._hosts))
-        if dead and self.action not in ("stop", "done"):
-            self.dead_hosts = sorted(set(dead))
+        if self.action in ("stop", "done") or self._gather:
+            # gather mode: peers are mid-re-home; the gather deadline
+            # (not the beat-age sweep) bounds how long we wait for them
+            return
+        dead = [hid for hid in self.members
+                if hid in self._hosts
+                and now - self._hosts[hid]["last_beat"] > self.dead_after]
+        if now - self._started > max(self.join_grace, self.dead_after):
+            dead += sorted(self.members - set(self._hosts))
+        dead = sorted(set(dead), key=_host_key)
+        if not dead:
+            return
+        live = self.members - set(dead)
+        self.dead_hosts = sorted(set(self.dead_hosts) | set(dead),
+                                 key=_host_key)
+        if len(live) < self.floor:
             self.action = "stop"
             self.exit_code = EXIT_HOST_DEAD
-            self.outcome = (f"host(s) {', '.join(self.dead_hosts)} "
+            self.outcome = (f"host(s) {', '.join(dead)} "
                             f"declared dead after {self.dead_after:.0f}s "
-                            "without a heartbeat: the scheduler must "
-                            "re-place them")
+                            f"without a heartbeat and only {len(live)} "
+                            f"live host(s) remain — below the "
+                            f"--cluster-hosts floor of {self.floor}: "
+                            "the scheduler must re-place them")
             self.error("%s", self.outcome)
+        else:
+            # elastic shrink: the dead hosts leave the membership, the
+            # quorum denominator follows, and the gang respawn rebuilds
+            # the job over the survivors — no wedge, no fail-stop
+            self._membership_bump(
+                f"host(s) {', '.join(dead)} dead after "
+                f"{self.dead_after:.0f}s — membership shrinks to "
+                f"{len(live)}", evict=set(dead))
 
     def _all_done(self) -> bool:
-        if len(self._hosts) < self.n_hosts:
-            return False
-        return all(h["report"].get("status") == "done"
-                   and int(h["report"].get("generation", 0))
-                   == self.generation
-                   for h in self._hosts.values())
+        return all(hid in self._hosts
+                   and self._hosts[hid]["report"].get("status") == "done"
+                   and int(self._hosts[hid]["report"]
+                           .get("generation", 0)) == self.generation
+                   for hid in self.members)
+
+    def _member_reports(self) -> List[Dict[str, Any]]:
+        """Current members' latest reports — the quorum electorate.
+        A dead (evicted) host's stale report must not keep voting once
+        the denominator shrank past it."""
+        return [h["report"] for hid, h in self._hosts.items()
+                if hid in self.members]
 
     def _initiate_restart(self, reason: str,
                           nonfinite: bool = False) -> None:
-        epoch = max((int(h["report"].get("epoch", -1))
-                     for h in self._hosts.values()), default=-1)
+        reports = self._member_reports()
+        epoch = max((int(r.get("epoch", -1)) for r in reports),
+                    default=-1)
         if epoch > self._best_epoch:
             self._best_epoch = epoch
             self._stagnant = 0
@@ -231,7 +397,6 @@ class ClusterCoordinator(Logger):
             return
         self.restarts += 1
         self.generation += 1
-        reports = [h["report"] for h in self._hosts.values()]
         snap = quorum_snapshot(reports, self.quorum)
         if nonfinite and snap is not None:
             # the newest quorum snapshot may embed the divergence that
@@ -250,15 +415,89 @@ class ClusterCoordinator(Logger):
         self.warning(
             "restart -> generation %d from %s (%s; quorum %d/%d)",
             self.generation, snap or "<scratch>", reason, self.quorum,
-            self.n_hosts)
+            len(self.members))
+        self._announce()
+
+    def _membership_bump(self, reason: str,
+                         admit: Optional[Set[str]] = None,
+                         evict: Optional[Set[str]] = None) -> None:
+        """Change the membership and bump the generation so the gang
+        respawn rebuilds the job (data mesh + ZeRO plan) over the NEW
+        live set, resuming from the quorum snapshot. Deliberately does
+        NOT consume the failure-restart budget or the no-progress
+        counter: a membership change is topology, not a crash loop."""
+        self.members = (self.members | (admit or set())) \
+            - (evict or set())
+        self._recompute_quorum()
+        # a re-admitted host is alive again by definition
+        self.dead_hosts = [d for d in self.dead_hosts
+                           if d not in self.members]
+        gens = [int(h["report"].get("generation", 0) or 0)
+                for hid, h in self._hosts.items() if hid in self.members]
+        self.generation = max([self.generation, *gens]) + 1
+        self.snapshot = quorum_snapshot(self._member_reports(),
+                                        self.quorum)
+        self.generations.append({
+            "generation": self.generation, "snapshot": self.snapshot,
+            "reason": reason,
+            "members": sorted(self.members, key=_host_key),
+            "term": self.term})
+        self.warning(
+            "membership bump -> generation %d over %d host(s) [%s] "
+            "from %s (%s; quorum %d)", self.generation,
+            len(self.members),
+            ", ".join(sorted(self.members, key=_host_key)),
+            self.snapshot or "<scratch>", reason, self.quorum)
+        self._announce()
+
+    def _announce(self) -> None:
+        """Queue the control-plane record (term, endpoint, current
+        generation) for persistence through the mirror store — the
+        shared truth members re-home from and election candidates
+        fence against. Called with _lock held; the actual mirror I/O
+        happens in `_flush_announce` AFTER the lock is released — a
+        slow or unreachable mirror must never freeze the control plane
+        (every heartbeat handler queues on _lock). Best-effort: a
+        mirror-less cluster simply has no re-election (members
+        fail-stop EXIT_ISOLATED as before)."""
+        if not self.mirror_spec:
+            return
+        self._announce_record = {
+            "term": self.term, "host": self.coord_id,
+            "endpoint": f"{self.advertise or self.host}:{self.port}",
+            "generation": self.generation, "time": time.time()}
+
+    def _flush_announce(self) -> None:
+        """Publish the queued announcement (lock released: mirror I/O
+        only ever blocks the one handler thread that triggered the
+        bump). Concurrent flushes may land out of order in rare
+        interleavings — self-healing, since every later bump
+        re-announces and adoption keys on the monotone term."""
+        with self._lock:
+            record = self._announce_record
+            self._announce_record = None
+        if record is None:
+            return
+        from veles_tpu.resilience.mirror import get_mirror
+        try:
+            get_mirror(self.mirror_spec, token=self.token).put_meta(
+                COORD_META, record)
+        except Exception as e:  # noqa: BLE001 — announcement is
+            # best-effort durability, never the control path
+            self.warning("could not persist control-plane record to "
+                         "%s: %s", self.mirror_spec, e)
 
     def _directive(self) -> Dict[str, Any]:
         delay = 0.0
         if self.action == "run" and self.restarts:
-            delay = min(self.backoff_base * (2 ** (self.restarts - 1)),
-                        self.backoff_max)
+            delay = backoff_delay(self.restarts - 1,
+                                  base=self.backoff_base,
+                                  cap=self.backoff_max, jitter=0.0)
         return {"generation": self.generation, "action": self.action,
                 "snapshot": self.snapshot,
+                "term": self.term,
+                "members": sorted(self.members, key=_host_key),
+                "floor": self.floor,
                 "dead_hosts": self.dead_hosts,
                 "exit_code": self.exit_code,
                 "backoff": delay,
@@ -282,7 +521,10 @@ class ClusterCoordinator(Logger):
         """The cluster block of the exit report."""
         with self._lock:
             return {
-                "n_hosts": self.n_hosts, "quorum": self.quorum,
+                "n_hosts": self.n_hosts, "floor": self.floor,
+                "quorum": self.quorum,
+                "term": self.term,
+                "members": sorted(self.members, key=_host_key),
                 "generation": self.generation,
                 "restarts": self.restarts,
                 "dead_hosts": list(self.dead_hosts),
@@ -337,10 +579,19 @@ class ClusterCoordinator(Logger):
         #: child gauges the coordinator itself owns fleet-wide — never
         #: re-exposed per host
         reserved = {"veles_generation", "veles_mem_live_bytes_max",
-                    "veles_restart_total"}
+                    "veles_restart_total", "veles_cluster_term",
+                    "veles_cluster_members", "veles_cluster_floor"}
         with self._lock:
             reg.counter("veles_restart_total").set_total(self.restarts)
             reg.gauge("veles_generation").set(float(self.generation))
+            reg.gauge("veles_cluster_term",
+                      "control-plane election term").set(
+                float(self.term))
+            reg.gauge("veles_cluster_members",
+                      "current expected membership").set(
+                float(len(self.members)))
+            reg.gauge("veles_cluster_floor",
+                      "minimum live host count").set(float(self.floor))
             reg.gauge("veles_cluster_hosts",
                       "hosts that ever reported").set(
                 float(len(self._hosts)))
@@ -438,7 +689,13 @@ class ClusterCoordinator(Logger):
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 (http.server API)
-                if not self.path.startswith("/hb"):
+                if self.path.startswith("/hb"):
+                    handle = outer.handle_beat
+                elif self.path.startswith("/join"):
+                    # the explicit admission endpoint: a joining host's
+                    # first contact (same token/body contract as /hb)
+                    handle = outer.handle_join
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
@@ -456,7 +713,7 @@ class ClusterCoordinator(Logger):
                 try:
                     report = json.loads(self.rfile.read(length)
                                         or b"{}")
-                    directive = outer.handle_beat(dict(report))
+                    directive = handle(dict(report))
                 except (ValueError, TypeError):
                     self.send_response(400)
                     self.end_headers()
@@ -503,13 +760,24 @@ class ClusterCoordinator(Logger):
                                           Handler)
         self.port = self._httpd.server_address[1]
         self._started = time.monotonic()
+        self._gather_deadline = self._started + max(self.dead_after,
+                                                    5.0)
+        self.info("cluster control plane on %s:%d (term %d, members "
+                  "[%s], floor %d, quorum %d, dead after %.0fs)",
+                  self.host, self.port, self.term,
+                  ", ".join(sorted(self.members, key=_host_key)),
+                  self.floor, self.quorum, self.dead_after)
+        # announce BEFORE serve_forever spawns: the socket is already
+        # bound+listening (connections queue in the backlog). Taken
+        # under the lock like every other _announce call site so the
+        # coordinator-state reads inside are uniformly guarded
+        with self._lock:
+            self._announce()
+        self._flush_announce()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="cluster-coordinator")
         self._thread.start()
-        self.info("cluster control plane on %s:%d (%d hosts, quorum "
-                  "%d, dead after %.0fs)", self.host, self.port,
-                  self.n_hosts, self.quorum, self.dead_after)
         return self
 
     def stop(self) -> None:
@@ -533,7 +801,9 @@ class ClusterMember(Logger):
                  stall_timeout: float = 0.0,
                  term_grace: float = 5.0,
                  env: Optional[Dict[str, str]] = None,
-                 report_path: str = "") -> None:
+                 report_path: str = "", floor: int = 1,
+                 dead_after: float = 30.0, max_restarts: int = 3,
+                 join: bool = False, advertise: str = "") -> None:
         super().__init__()
         if commands and isinstance(commands[0], str):
             commands = [commands]
@@ -565,6 +835,37 @@ class ClusterMember(Logger):
         self.term_grace = term_grace
         self.env = dict(env) if env is not None else dict(os.environ)
         self.report_path = report_path
+        #: the cluster's minimum live host count (--cluster-hosts): a
+        #: promoted coordinator inherits it
+        self.floor = max(1, int(floor))
+        #: how long coordinator silence must last before this member
+        #: starts the mirror-rendezvous failover (re-home / election) —
+        #: the same bound the coordinator applies to silent members
+        self.dead_after = dead_after
+        #: restart budget a promoted coordinator inherits
+        self.max_restarts = max_restarts
+        #: True = this host's id is OUTSIDE the boot membership and it
+        #: announces itself via POST /join before its first beat
+        self.join = bool(join)
+        self._join_pending = bool(join)
+        #: the address peers can reach THIS host on if it is promoted
+        #: (the announced endpoint's host part; port is bound fresh)
+        self.advertise = advertise or "127.0.0.1"
+        #: highest election term seen (directives + announcements);
+        #: directives below it are fenced out as a stale coordinator's
+        self.term = 1
+        #: membership as of the last accepted directive — the election
+        #: electorate, and the child env's VELES_CLUSTER_* view. A boot
+        #: host starts from the implied 0..floor-1 set so an election
+        #: works even if the coordinator died before first contact
+        self.cluster_members: List[str] = (
+            [] if join else [str(i) for i in range(self.floor)])
+        #: (term, endpoint) last adopted from the mirror announcement —
+        #: never re-adopt the same record, so a successor that died too
+        #: cannot pin the member in a re-home loop
+        self._adopted: tuple = (0, "")
+        self._reconnect_streak = 0
+        self._stale_terms_seen: set = set()
         self.generation = 0           # nothing spawned yet
         self.attempts: List[Dict[str, Any]] = []
         self._procs: List[subprocess.Popen] = []
@@ -717,6 +1018,24 @@ class ClusterMember(Logger):
                 argv = _with_snapshot(argv, snapshot)
             env = dict(self.env)
             env["VELES_HEARTBEAT_FILE"] = hb
+            # the elastic-membership view for the children: the gang
+            # respawn rebuilds the data mesh + ZeRO plan over the LIVE
+            # host set (the PR-6 vel-reshard-on-restore path carries
+            # the optimizer state across the data-axis size change)
+            env["VELES_CLUSTER_GENERATION"] = str(self.generation)
+            env["VELES_CLUSTER_TERM"] = str(self.term)
+            if self.cluster_members:
+                env["VELES_CLUSTER_HOSTS"] = str(
+                    len(self.cluster_members))
+                env["VELES_CLUSTER_HOST_IDS"] = ",".join(
+                    self.cluster_members)
+            if self.coordinator is not None:
+                # the coordinator's host is the snapshot WRITER: a
+                # promoted host drops the single-writer dry-run pin it
+                # may have been launched with, so the fleet keeps
+                # producing durable snapshots after the original
+                # writer host died
+                env.pop("VELES_SNAPSHOT_DRY_RUN", None)
             self._procs.append(subprocess.Popen(argv, env=env))
         self.attempts.append({
             "generation": self.generation,
@@ -804,6 +1123,31 @@ class ClusterMember(Logger):
         from veles_tpu.resilience.faults import active_plan
         return active_plan()
 
+    def _report(self, status: str, codes: List[Any]) -> Dict[str, Any]:
+        report = {"host": self.host_id, "generation": self.generation,
+                  "term": self.term, "status": status,
+                  "exit_codes": [c for c in codes],
+                  "snapshots": self._visible_snapshots()}
+        report.update(self._child_payload())
+        return report
+
+    def _post(self, path: str, report: Dict[str, Any]
+              ) -> Optional[Dict[str, Any]]:
+        from veles_tpu.http_util import http_post_json
+        from veles_tpu.telemetry import tracer as _tracer
+        tr = _tracer.active()
+        tok = tr.begin("cluster.beat", "cluster") \
+            if tr is not None else None
+        try:
+            return http_post_json(self.coord_host, self.coord_port,
+                                  path, report, token=self.token,
+                                  timeout=max(5.0, self.beat_s * 3))
+        except OSError:
+            return None
+        finally:
+            if tok is not None:
+                tr.end(tok)
+
     def _beat(self, status: str, codes: List[Any]
               ) -> Optional[Dict[str, Any]]:
         """Send one heartbeat; returns the directive, or None when the
@@ -818,25 +1162,228 @@ class ClusterMember(Logger):
         if self._suppress_beats > 0:
             self._suppress_beats -= 1
             return None
-        report = {"host": self.host_id, "generation": self.generation,
-                  "status": status,
-                  "exit_codes": [c for c in codes],
-                  "snapshots": self._visible_snapshots()}
-        report.update(self._child_payload())
-        from veles_tpu.http_util import http_post_json
-        from veles_tpu.telemetry import tracer as _tracer
-        tr = _tracer.active()
-        tok = tr.begin("cluster.beat", "cluster") \
-            if tr is not None else None
+        if self._beats_sent % BEACON_EVERY == 1:
+            self._publish_beacon()
+        return self._post("/hb", self._report(status, codes))
+
+    def _join_cluster(self, status: str, codes: List[Any]
+                      ) -> Optional[Dict[str, Any]]:
+        """First contact for a joining host: announce via the explicit
+        POST /join admission endpoint (admission = the next generation
+        bump). Falls back to retrying — with the same backoff/failover
+        path as a lost beat — until a control plane answers."""
+        self._publish_beacon()
+        directive = self._post("/join", self._report(status, codes))
+        if directive is not None:
+            self._join_pending = False
+            self.info("admitted to the cluster (directive generation "
+                      "%s, members %s)", directive.get("generation"),
+                      directive.get("members"))
+        else:
+            self.warning("join request to %s:%d got no answer — "
+                         "retrying", self.coord_host, self.coord_port)
+        return directive
+
+    # -- failover: mirror-rendezvous re-home / re-election --------------------
+
+    def _publish_beacon(self, mirror=None) -> None:
+        """Refresh this host's presence beacon on the mirror store (the
+        election's liveness view)."""
+        if not self.mirror_spec:
+            return
+        from veles_tpu.resilience.mirror import get_mirror
         try:
-            return http_post_json(self.coord_host, self.coord_port,
-                                  "/hb", report, token=self.token,
-                                  timeout=max(5.0, self.beat_s * 3))
-        except OSError:
-            return None
-        finally:
-            if tok is not None:
-                tr.end(tok)
+            (mirror or get_mirror(self.mirror_spec,
+                                  token=self.token)).put_meta(
+                BEACON_META.format(host=self.host_id),
+                {"host": self.host_id, "time": time.time(),
+                 "generation": self.generation, "term": self.term})
+        except Exception as e:  # noqa: BLE001 — liveness is best-effort
+            self.warning("presence beacon publish failed: %s", e)
+
+    def _live_hosts(self, mirror) -> List[str]:
+        """Host ids (of the known membership plus self) whose presence
+        beacon is fresher than dead_after — who is still standing for
+        election purposes. Wall-clock ages: the same NTP-synced-fleet
+        assumption the quorum rule makes for snapshot mtimes."""
+        now = time.time()
+        live = {self.host_id}
+        for hid in set(self.cluster_members) | {self.host_id}:
+            if hid == self.host_id:
+                continue
+            try:
+                beacon = mirror.get_meta(BEACON_META.format(host=hid))
+            except Exception:  # noqa: BLE001
+                beacon = None
+            if beacon is None:
+                continue
+            try:
+                age = now - float(beacon.get("time", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if age < self.dead_after:
+                live.add(str(beacon.get("host", hid)))
+        return sorted(live, key=_host_key)
+
+    def _try_adopt(self, ann: Optional[Dict[str, Any]]) -> bool:
+        """Re-home to an announced successor coordinator. Adopts only a
+        record that moves this member FORWARD: a newer term, or the
+        current term at an endpoint we have not already adopted (so a
+        successor that died too cannot pin us in a re-home loop — the
+        next silence window escalates to an election instead)."""
+        if not isinstance(ann, dict):
+            return False
+        try:
+            term = int(ann.get("term", 0) or 0)
+        except (TypeError, ValueError):
+            return False
+        endpoint = str(ann.get("endpoint") or "")
+        host, _, port = endpoint.rpartition(":")
+        if not port.isdigit():
+            return False          # claim without a bound endpoint yet
+        if term < self.term or (term, endpoint) == self._adopted:
+            return False
+        if str(ann.get("host")) == self.host_id \
+                and self.coordinator is None:
+            # our own earlier claim that never finished promoting:
+            # nothing to re-home to — the election path retries
+            return False
+        if term == self.term \
+                and endpoint == f"{self.coord_host}:{self.coord_port}":
+            return False          # already homed exactly there
+        self.coord_host = host or "127.0.0.1"
+        self.coord_port = int(port)
+        self._adopted = (term, endpoint)
+        self.term = max(self.term, term)
+        self.info("re-homing to coordinator %s (term %d, announced by "
+                  "host %s)", endpoint, term, ann.get("host"))
+        return True
+
+    def _seek_coordinator(self) -> bool:
+        """The failover path, entered once the control plane has been
+        silent past dead_after: consult the mirror's shared record and
+        either RE-HOME to a successor's announced endpoint, or — when
+        this host holds the lowest live host-id — claim the next term,
+        wait a jittered settle window for a lower-id claim to override,
+        and PROMOTE self. Returns True when the member has a control
+        plane to talk to again."""
+        from veles_tpu.resilience.mirror import get_mirror
+        mirror = get_mirror(self.mirror_spec, token=self.token)
+        self._publish_beacon(mirror)
+        try:
+            ann = mirror.get_meta(COORD_META)
+        except Exception as e:  # noqa: BLE001
+            self.warning("mirror %s unreachable during failover: %s",
+                         self.mirror_spec, e)
+            return False
+        if self._try_adopt(ann):
+            return True
+        if self._join_pending:
+            # a joining host that was never admitted has no membership
+            # to inherit: it may re-home to an announced successor
+            # (above) but must NOT stand for election — promoting here
+            # would fork a one-host rival cluster instead of joining
+            # the real one (or failing stop when it is gone)
+            self.info("not yet admitted — a joining host cannot stand "
+                      "for election; retrying /join")
+            return False
+        live = self._live_hosts(mirror)
+        if live[0] != self.host_id:
+            self.info("coordinator silent; host %s (lowest live of %s) "
+                      "owns the promotion — waiting for its "
+                      "announcement", live[0], live)
+            return False
+        # deterministic anti-collision bias: a believed-lowest
+        # candidate with a HIGHER id waits longer before claiming, so
+        # when stale beacons make two hosts each believe they are the
+        # lowest live, the true lowest claims first and the other
+        # adopts its announcement on the re-read below
+        rank = _host_key(self.host_id)[1]
+        if rank:
+            time.sleep(min(rank, 8) * max(self.beat_s, 0.25))
+            try:
+                ann = mirror.get_meta(COORD_META)
+            except Exception:  # noqa: BLE001
+                return False
+            if self._try_adopt(ann):
+                return True
+        target = max(self.term,
+                     int((ann or {}).get("term", 0) or 0)) + 1
+        claim = {"term": target, "host": self.host_id, "endpoint": "",
+                 "time": time.time()}
+        for attempt in range(3):
+            if not mirror.put_meta(COORD_META, dict(claim)):
+                return False
+            # jittered settle: a racing lower-id candidate's rewrite
+            # must get the chance to land before we commit
+            time.sleep(backoff_delay(attempt,
+                                     base=max(self.beat_s, 0.25),
+                                     cap=2.0))
+            try:
+                now_ann = mirror.get_meta(COORD_META)
+            except Exception:  # noqa: BLE001
+                return False
+            if now_ann is None:
+                continue
+            a_host = str(now_ann.get("host", ""))
+            a_term = int(now_ann.get("term", 0) or 0)
+            if a_host == self.host_id and a_term == target:
+                return self._promote(target, live)
+            if self._try_adopt(now_ann):
+                return True
+            if _host_key(a_host) < _host_key(self.host_id):
+                # a lower id claimed: defer; adopt once it announces
+                return False
+            # a higher id raced us: rewrite our claim and settle again
+            target = max(target, a_term)
+            claim = {"term": target, "host": self.host_id,
+                     "endpoint": "", "time": time.time()}
+        return False
+
+    def _promote(self, term: int, live: List[str]) -> bool:
+        """Become the coordinator: bind a fresh control plane over the
+        live membership, announce its endpoint at the claimed term, and
+        re-home to it. The new coordinator starts in GATHER mode, so
+        its first directive bump resumes every host from the quorum
+        snapshot the re-homed members report — promotion can never roll
+        the fleet back (the pick needs a majority of the live set)."""
+        members = sorted(set(live) | {self.host_id}, key=_host_key)
+        loopback = self.advertise in ("127.0.0.1", "localhost", "::1")
+        coord = ClusterCoordinator(
+            self.floor, host="127.0.0.1" if loopback else "0.0.0.0",
+            port=0, token=self.token, dead_after=self.dead_after,
+            max_restarts=self.max_restarts, members=members,
+            mirror=self.mirror_spec, term=term, coord_id=self.host_id,
+            advertise=self.advertise, gather=True,
+            # a live member re-homes within ~one seek interval; a host
+            # whose beacon was borderline-fresh at promotion but is
+            # actually dead must not get the default two-minute
+            # first-contact grace before the membership can shrink
+            join_grace=self.dead_after * 2)
+        try:
+            coord.start()
+        except OSError as e:
+            self.error("could not bind the promoted control plane: %s",
+                       e)
+            return False
+        self.coordinator = coord
+        self.coord_host = self.advertise
+        self.coord_port = coord.port
+        self._adopted = (term, f"{self.advertise}:{coord.port}")
+        self.term = term
+        self.warning("promoted self to coordinator (term %d) at %s:%d "
+                     "over live hosts [%s]", term, self.advertise,
+                     coord.port, ", ".join(members))
+        plan = self._plan()
+        if plan is not None and plan.coord_loss_at_term(term):
+            # deterministic re-elected-coordinator loss: the whole host
+            # vanishes right after the announcement peers will re-home
+            # to — the survivors must elect a THIRD coordinator
+            self._kill_children()
+            import logging as _logging
+            _logging.shutdown()
+            os.kill(os.getpid(), signal.SIGKILL)
+        return True
 
     # -- main loop ------------------------------------------------------------
 
@@ -853,7 +1400,6 @@ class ClusterMember(Logger):
         def _to_interrupt(*_):
             raise KeyboardInterrupt
 
-        import signal
         try:
             prev_term = signal.signal(signal.SIGTERM, _to_interrupt)
         except ValueError:
@@ -862,10 +1408,40 @@ class ClusterMember(Logger):
             while True:
                 status, codes = (self._children_status()
                                  if self._procs else ("joining", []))
-                directive = self._beat(status, codes)
+                directive = (self._join_cluster(status, codes)
+                             if self._join_pending
+                             else self._beat(status, codes))
+                if directive is not None:
+                    dterm = int(directive.get("term", self.term) or 0)
+                    if dterm < self.term:
+                        # term fencing: a stale coordinator (the
+                        # pre-partition incumbent coming back, or one
+                        # this member already moved past) must not
+                        # steer this host — treat its directive as
+                        # silence so the failover path takes over
+                        if dterm not in self._stale_terms_seen:
+                            self._stale_terms_seen.add(dterm)
+                            self.warning(
+                                "rejecting directive from stale term "
+                                "%d (this member has seen term %d)",
+                                dterm, self.term)
+                        directive = None
                 if directive is None:
-                    if time.monotonic() - last_contact \
-                            > self.coord_timeout:
+                    now = time.monotonic()
+                    silent = now - last_contact
+                    if self.mirror_spec and silent > self.dead_after:
+                        if self._seek_coordinator():
+                            # re-homed (or promoted): fresh window
+                            last_contact = time.monotonic()
+                            self._reconnect_streak = 0
+                            continue
+                    elif self.mirror_spec:
+                        # stay visibly ALIVE to electors while cut off:
+                        # a beacon that goes stale during the silence
+                        # window would let a higher host-id believe it
+                        # is the lowest live and double-promote
+                        self._publish_beacon()
+                    if silent > self.coord_timeout:
                         self.error(
                             "no control-plane contact for %.0fs: this "
                             "host is partitioned — killing children "
@@ -875,9 +1451,22 @@ class ClusterMember(Logger):
                         return self._finish(EXIT_ISOLATED,
                                             "isolated from the control "
                                             "plane")
-                    time.sleep(self.beat_s)
+                    # jittered exponential reconnect backoff (shared
+                    # resilience/backoff.py policy), capped well under
+                    # coord_timeout so the isolation check stays live
+                    time.sleep(backoff_delay(
+                        self._reconnect_streak, base=self.beat_s,
+                        cap=max(self.beat_s,
+                                min(5.0, self.coord_timeout / 4))))
+                    self._reconnect_streak += 1
                     continue
                 last_contact = time.monotonic()
+                self._reconnect_streak = 0
+                self.term = max(self.term,
+                                int(directive.get("term", 0) or 0))
+                members = directive.get("members")
+                if isinstance(members, list) and members:
+                    self.cluster_members = [str(m) for m in members]
                 action = directive.get("action")
                 if action in ("done", "stop"):
                     self._kill_children()   # "done": no-op, exited 0
@@ -926,6 +1515,8 @@ class ClusterMember(Logger):
         report: Dict[str, Any] = {
             "outcome": outcome, "exit_code": code,
             "host": self.host_id, "generation": self.generation,
+            "term": self.term,
+            "members": list(self.cluster_members),
             "dead_hosts": list(dead_hosts or []),
             "attempts": self.attempts}
         if self.coordinator is not None:

@@ -42,6 +42,11 @@ Cluster-scale actions (resilience/cluster.py + mirror.py):
 - ``stale_local_dir`` — before respawn number K the member empties its
   local snapshot dir (a re-placed host on a fresh disk): the restart
   must restore from the durable mirror.
+- ``coord_loss`` — the moment this member PROMOTES itself to cluster
+  coordinator at election term K (announcement already published), the
+  whole host vanishes (children killed, then SIGKILL self): the
+  deterministic "re-elected coordinator is lost too" trigger — the
+  surviving members must elect a THIRD coordinator.
 
 Each entry fires AT MOST ONCE. When ``VELES_FAULT_STATE`` names a file
 (the Supervisor sets it), fired entries are recorded there BEFORE the
@@ -69,7 +74,8 @@ _ACTIONS = {"kill": "epoch", "hang": "epoch", "nan": "step",
             "corrupt_snapshot": "write",
             # cluster-scale (resilience/cluster.py, mirror.py)
             "host_loss": "epoch", "partition": "beat",
-            "mirror_corrupt": "push", "stale_local_dir": "restart"}
+            "mirror_corrupt": "push", "stale_local_dir": "restart",
+            "coord_loss": "term"}
 
 #: sentinel distinguishing "not looked up yet" from "looked up: no plan"
 _UNSET = object()
@@ -246,6 +252,21 @@ class FaultPlan:
             return False
         self._mark_fired(e)
         _log.warning("FAULT INJECTION: %s", e.key)
+        return True
+
+    def coord_loss_at_term(self, term: int) -> bool:
+        """True when this member's promotion to coordinator at `term`
+        should be followed by the whole host vanishing (children
+        killed, SIGKILL self). Called by ClusterMember._promote AFTER
+        the new term's endpoint is announced through the mirror, so
+        peers deterministically observe a re-elected-then-lost
+        coordinator."""
+        e = self._take("coord_loss", term)
+        if e is None:
+            return False
+        self._mark_fired(e)
+        _log.warning("FAULT INJECTION: %s -> host vanishes after "
+                     "promotion", e.key)
         return True
 
     def stale_local_dir_at_restart(self, restart: int) -> bool:

@@ -32,11 +32,22 @@ import json
 import logging
 import os
 import shutil
+import threading
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
 _log = logging.getLogger("veles.Mirror")
+
+
+def _tmp_name(path: str) -> str:
+    """A per-writer temp name next to `path` (still `.tmp`-suffixed so
+    listings skip it). Concurrent pushes/fetches of the SAME entry —
+    a respawned child re-exporting while the old push is still in
+    flight, two handler threads serving the same upload — must each
+    write their own temp file: a shared `path + ".tmp"` let one
+    writer's atomic replace steal (or tear) another's bytes."""
+    return f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
 
 #: mirrored snapshot bodies above this are refused by MirrorServer
 #: (a snapshot is a compressed workflow pickle: even flagship runs sit
@@ -108,6 +119,23 @@ class Mirror:
         retention policy so the mirror cannot grow without bound)."""
         raise NotImplementedError
 
+    # -- control-plane meta records -------------------------------------------
+    # Tiny mutable JSON records living NEXT TO the snapshot blobs: the
+    # cluster's shared rendezvous state (coordinator announcement +
+    # per-host presence beacons for re-election). Last-writer-wins by
+    # design — the election's claim/settle protocol builds on exactly
+    # that. Meta names never contain ".pickle", so they are invisible
+    # to `entries()`/quorum votes and exempt from keep_last pruning.
+
+    def put_meta(self, name: str, record: Dict[str, object]) -> bool:
+        """Atomically publish `record` under `name` (overwrites)."""
+        raise NotImplementedError
+
+    def get_meta(self, name: str) -> Optional[Dict[str, object]]:
+        """The record under `name`, or None (absent/unreadable/not a
+        JSON object)."""
+        raise NotImplementedError
+
     def _corrupt(self, name: str) -> None:
         """Deterministic bit-rot injection hook (mirror_corrupt fault):
         tear the MIRRORED copy while the local one stays intact."""
@@ -163,7 +191,7 @@ class DirMirror(Mirror):
                        name)
             return True
         dst = self._path(name)
-        tmp = dst + ".tmp"
+        tmp = _tmp_name(dst)
         shutil.copyfile(path, tmp)
         if _sha256_file(tmp) != digest:      # torn read of a live file
             os.remove(tmp)
@@ -171,9 +199,10 @@ class DirMirror(Mirror):
                          "digest: not published", name)
             return False
         os.replace(tmp, dst)
-        with open(dst + ".sha256.tmp", "w") as f:
+        side_tmp = _tmp_name(dst + ".sha256")
+        with open(side_tmp, "w") as f:
             f.write(f"{digest}  {name}\n")
-        os.replace(dst + ".sha256.tmp", dst + ".sha256")
+        os.replace(side_tmp, dst + ".sha256")
         self._maybe_inject_corruption(name)
         return True
 
@@ -188,15 +217,16 @@ class DirMirror(Mirror):
             return None
         os.makedirs(dest_dir, exist_ok=True)
         dst = os.path.join(dest_dir, name)
-        tmp = dst + ".tmp"
+        tmp = _tmp_name(dst)
         shutil.copyfile(src, tmp)
         if _sha256_file(tmp) != digest:
             os.remove(tmp)
             return None
         os.replace(tmp, dst)
-        with open(dst + ".sha256.tmp", "w") as f:
+        side_tmp = _tmp_name(dst + ".sha256")
+        with open(side_tmp, "w") as f:
             f.write(f"{digest}  {name}\n")
-        os.replace(dst + ".sha256.tmp", dst + ".sha256")
+        os.replace(side_tmp, dst + ".sha256")
         return dst
 
     def delete(self, name: str) -> None:
@@ -205,6 +235,32 @@ class DirMirror(Mirror):
                 os.remove(victim)
             except OSError:
                 pass
+
+    def put_meta(self, name: str, record: Dict[str, object]) -> bool:
+        dst = self._path(name)
+        os.makedirs(self.root, exist_ok=True)
+        # per-process tmp name: two hosts publishing the same record
+        # concurrently must each tear nothing (last replace wins)
+        tmp = _tmp_name(dst)
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, dst)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        return True
+
+    def get_meta(self, name: str) -> Optional[Dict[str, object]]:
+        try:
+            with open(self._path(name)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return data if isinstance(data, dict) else None
 
     def _corrupt(self, name: str) -> None:
         from veles_tpu.resilience.faults import corrupt_file
@@ -239,14 +295,20 @@ class HttpMirror(Mirror):
         return urllib.request.urlopen(req, timeout=self.timeout)
 
     def _get_bytes(self, name_or_query: str) -> Optional[bytes]:
+        import http.client
         try:
             with self._request("GET", name_or_query) as resp:
                 return resp.read()
-        except (urllib.error.URLError, OSError, ValueError):
+        except (urllib.error.URLError, OSError, ValueError,
+                http.client.HTTPException):
+            # HTTPException covers a TORN response (IncompleteRead from
+            # a blob replaced mid-stream): best-effort visibility, the
+            # caller retries or degrades exactly like "unreachable"
             return None
 
     def _get_to_file(self, name: str, dst: str) -> Optional[str]:
         """Stream a GET into `dst`, returning the sha256 hex digest."""
+        import http.client
         h = hashlib.sha256()
         try:
             with self._request("GET", name) as resp, open(dst, "wb") as f:
@@ -256,7 +318,8 @@ class HttpMirror(Mirror):
                         break
                     h.update(block)
                     f.write(block)
-        except (urllib.error.URLError, OSError, ValueError):
+        except (urllib.error.URLError, OSError, ValueError,
+                http.client.HTTPException):
             try:
                 os.remove(dst)
             except OSError:
@@ -306,7 +369,7 @@ class HttpMirror(Mirror):
         # mirrored" poisoned entry. A PUT-only store (no GET) is
         # tolerated with a warning — that upload happened, it just
         # cannot be independently verified (nor serve restores).
-        tmp = path + ".mirror_verify.tmp"
+        tmp = _tmp_name(path + ".mirror_verify")
         got = self._get_to_file(name, tmp)
         try:
             os.remove(tmp)
@@ -343,7 +406,7 @@ class HttpMirror(Mirror):
             return None
         os.makedirs(dest_dir, exist_ok=True)
         dst = os.path.join(dest_dir, name)
-        tmp = dst + ".tmp"
+        tmp = _tmp_name(dst)
         got = self._get_to_file(name, tmp)
         if got != digest:
             _log.warning("mirror copy of %s is corrupt (digest "
@@ -354,9 +417,10 @@ class HttpMirror(Mirror):
                 pass
             return None
         os.replace(tmp, dst)
-        with open(dst + ".sha256.tmp", "w") as f:
+        side_tmp = _tmp_name(dst + ".sha256")
+        with open(side_tmp, "w") as f:
             f.write(f"{digest}  {name}\n")
-        os.replace(dst + ".sha256.tmp", dst + ".sha256")
+        os.replace(side_tmp, dst + ".sha256")
         return dst
 
     def delete(self, name: str) -> None:
@@ -366,6 +430,25 @@ class HttpMirror(Mirror):
                     resp.read()
             except (urllib.error.URLError, OSError, ValueError):
                 pass
+
+    def put_meta(self, name: str, record: Dict[str, object]) -> bool:
+        try:
+            with self._request("PUT", _safe_name(name),
+                               data=json.dumps(record).encode()) as resp:
+                resp.read()
+                return resp.status == 200
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def get_meta(self, name: str) -> Optional[Dict[str, object]]:
+        raw = self._get_bytes(_safe_name(name))
+        if raw is None:
+            return None
+        try:
+            data = json.loads(raw)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return data if isinstance(data, dict) else None
 
     def _corrupt(self, name: str) -> None:
         """Re-PUT a torn copy over the mirrored file (the server keeps
@@ -494,7 +577,7 @@ class MirrorServer:
                 if length > outer.max_body:
                     return self._deny(413)
                 dst = os.path.join(outer.root, name)
-                tmp = dst + ".tmp"
+                tmp = _tmp_name(dst)
                 remaining = length
                 with open(tmp, "wb") as f:
                     while remaining > 0:
@@ -576,3 +659,38 @@ class MirrorServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+def _main(argv=None) -> int:
+    """`python -m veles_tpu.resilience.mirror --root DIR [--host H]
+    [--port P]` — run the reference blob store standalone (the deploy/
+    manifests' mirror pod; token from VELES_WEB_TOKEN)."""
+    import argparse
+    import signal
+    import threading as _threading
+    ap = argparse.ArgumentParser(
+        description="veles snapshot mirror store (PUT/GET/DELETE "
+                    "/{name}, GET /?index=1)")
+    ap.add_argument("--root", required=True,
+                    help="directory holding the mirrored blobs")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args(argv)
+    token = os.environ.get("VELES_WEB_TOKEN") or None
+    if not token and args.host not in ("127.0.0.1", "localhost", "::1"):
+        ap.error("a non-loopback mirror store needs a shared secret: "
+                 "set VELES_WEB_TOKEN (mirrored snapshots are pickles "
+                 "— see the trust model in this module's docstring)")
+    srv = MirrorServer(args.root, host=args.host, port=args.port,
+                       token=token).start()
+    print(f"mirror store on {srv.url} (root {args.root})", flush=True)
+    done = _threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover — thin wrapper
+    raise SystemExit(_main())

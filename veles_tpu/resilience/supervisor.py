@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import json
 import os
-import random
 import signal
 import subprocess
 import sys
@@ -303,9 +302,10 @@ class Supervisor(Logger):
                     f"failures (stuck at epoch {best_epoch})")
             restarts += 1
             self._m_restarts.inc()
-            delay = min(self.backoff_base * (2 ** (restarts - 1)),
-                        self.backoff_max)
-            delay *= 1.0 + self.jitter * random.random()
+            from veles_tpu.resilience.backoff import backoff_delay
+            delay = backoff_delay(restarts - 1, base=self.backoff_base,
+                                  cap=self.backoff_max,
+                                  jitter=self.jitter)
             self.info("backing off %.2fs before restart %d", delay,
                       restarts)
             time.sleep(delay)

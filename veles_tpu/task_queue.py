@@ -468,7 +468,6 @@ class FitnessQueueWorker(Logger):
 
     def run(self, max_tasks: Optional[int] = None) -> int:
         """Returns the number of tasks completed by this worker."""
-        import random
         task_path = f"/task?worker={quote(self.worker_id)}"
         self.ended_by = ""                 # fresh verdict for THIS run
         last_contact = time.monotonic()
@@ -488,13 +487,13 @@ class FitnessQueueWorker(Logger):
                               self.give_up_s)
                     self.ended_by = "gave_up"
                     break
-                # jittered exponential backoff, reset on contact (the
-                # exponent is clamped BEFORE the multiply: an unbounded
-                # 2**streak overflows float around streak 1030, which a
-                # never-give-up worker would eventually reach)
-                delay = min(self.poll_s * (2 ** min(fail_streak, 30)),
-                            self.backoff_max)
-                delay *= 1.0 + self.backoff_jitter * random.random()
+                # jittered exponential backoff, reset on contact
+                # (resilience/backoff.py owns the formula, clamped
+                # exponent included)
+                from veles_tpu.resilience.backoff import backoff_delay
+                delay = backoff_delay(fail_streak, base=self.poll_s,
+                                      cap=self.backoff_max,
+                                      jitter=self.backoff_jitter)
                 fail_streak += 1
                 # module-level time.sleep on purpose (the backoff test
                 # observes it); stop() takes effect at the next loop
