@@ -379,6 +379,12 @@ def child_main() -> None:
         # the lowerings that produced this number (ops.variants): the
         # driver finally sees WHICH variant table was measured
         "variants": step.variant_table(),
+        # ZeRO collective byte attribution (ISSUE 12): the modeled
+        # per-device grad_reduce/all-gather egress this step moves per
+        # train step, by link leg — None off the registry-scatter path
+        "collectives": (step.collective_accounting()
+                        if hasattr(step, "collective_accounting")
+                        else None),
         # the jaxpr auditor's verdict on the step that was measured
         # (analysis pass 2; docs/ANALYSIS.md)
         "analysis": _audit_record(step, in_shape, state=state),
@@ -520,6 +526,9 @@ def e2e_child_main() -> None:
         "feed": feed_stats,
         "telemetry": _telemetry_overhead(batch / value),
         "variants": step.variant_table(),
+        "collectives": (step.collective_accounting()
+                        if hasattr(step, "collective_accounting")
+                        else None),
         "device_memory": _mem_record(),
         "device_kind": jax.devices()[0].device_kind,
         "batch_per_chip": batch,
@@ -652,6 +661,14 @@ def _compact(rec, record_path) -> dict:
         # one overlap-health number rides the compact line; the full
         # counter set stays in the record file
         out["e2e_uint8_wire"] = e2e_feed.get("uint8_wire")
+    coll = rec.get("collectives")
+    if isinstance(coll, dict):
+        # the bytes-moved claim rides the compact line (ISSUE 12): the
+        # measured number names the grad_reduce variant + its modeled
+        # per-step DCN/ICI egress; full legs/geometry stay in the file
+        out["collectives"] = {"variant": coll.get("variant"),
+                              "dcn_bytes": coll.get("dcn_bytes"),
+                              "ici_bytes": coll.get("ici_bytes")}
     ana = rec.get("analysis")
     if isinstance(ana, dict) and "errors" in ana:
         # counts only: the per-finding detail lives in the record file

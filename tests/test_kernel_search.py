@@ -285,28 +285,71 @@ def test_empty_ops_list_searches_nothing(tmp_path):
 
 
 def test_autotune_workflow_budget_searches_in_graph(tmp_path):
-    """--autotune --autotune-budget path: the workflow's template op
-    (lrn) switches to the in-graph search; non-template ops keep the
-    flat enumeration; the whole report stays one dict."""
+    """--autotune --autotune-budget path: every template-backed op the
+    workflow names rides the budgeted search IN-GRAPH (since ISSUE 12
+    that is the whole discovered registry here — maxpool/conv_stem
+    gained templates, closing the carried ROADMAP item), sgd_update and
+    grad_reduce ride the same budget via their microbenches, and the
+    whole report stays one dict. The budget is deliberately too small
+    to floor every op: allocation is priority-ordered, so the
+    first-discovered ops search and the tail reports 'skipped' — never
+    'error'."""
     templates.clear_ledger()
     wf = _tiny_workflow("InGraphT")
     rep = at.autotune_workflow(wf, steps=1, repeats=1, batch=4,
                                cache_path=str(tmp_path / "c.json"),
                                budget=6)
+    # discovery order (conv first in the layer list) wins the scarce
+    # budget; the in-graph timer serves the workflow-discovered ops
+    assert rep["conv_stem"]["source"] == "searched"
+    assert rep["conv_stem"]["timer"] == "in_graph"
     assert rep["lrn"]["source"] == "searched"
     assert rep["lrn"]["timer"] == "in_graph"
     assert rep["lrn"]["trials"] <= 6
     # hand-written incumbents were timed first
     first = rep["lrn"]["trace"][0]["variant"]
     assert "[" not in first
-    assert rep["maxpool"]["source"] == "tuned"     # flat enumeration
-    assert rep["conv_stem"]["source"] == "tuned"
-    # the step's SGD leg resolves the sgd_update registry op, so its
-    # template space rides this workflow's search (microbench-timed)
-    assert rep["sgd_update"]["source"] in ("searched", "skipped")
-    assert rep["sgd_update"].get("timer", "microbench") == "microbench"
-    for op in ("lrn", "maxpool", "conv_stem"):
+    # the remaining ops ride the same budget — with 6 total trials
+    # they are allocated zero and SKIP, never error
+    for op in ("maxpool", "sgd_update", "grad_reduce"):
+        assert rep[op]["source"] in ("searched", "skipped"), (op, rep[op])
+    for op in ("lrn", "conv_stem"):
         assert variants.effective(op) == rep[op]["variant"]
+
+
+def test_autotune_workflow_budget_covers_whole_registry(tmp_path):
+    """With a budget large enough to floor every op, the search covers
+    the WHOLE discovered registry plus the below-graph sgd_update and
+    grad_reduce spaces (the ISSUE-12 carried item: no registry op left
+    un-searched)."""
+    templates.clear_ledger()
+    wf = _tiny_workflow("FullCoverT")
+    rep = at.autotune_workflow(wf, steps=1, repeats=1, batch=4,
+                               cache_path=str(tmp_path / "c.json"),
+                               budget=19)
+    for op in ("lrn", "maxpool", "conv_stem", "sgd_update",
+               "grad_reduce"):
+        assert rep[op]["source"] == "searched", (op, rep[op])
+    assert rep["maxpool"]["timer"] == "in_graph"
+    assert rep["grad_reduce"]["timer"] == "microbench"
+    # the grad_reduce key is salted with the link geometry: the same
+    # space under a different (hosts x local) request hashes apart
+    import os as _os
+
+    from veles_tpu.ops.variants import GRAD_REDUCE_LOCAL_ENV
+    prev_env = _os.environ.get(GRAD_REDUCE_LOCAL_ENV)
+    try:
+        _os.environ[GRAD_REDUCE_LOCAL_ENV] = "2"
+        other = at.op_cache_key(
+            "cpu", "grad_reduce",
+            at.link_geometry_signature()
+            + templates.space_signature("grad_reduce"), None)
+    finally:
+        if prev_env is None:
+            _os.environ.pop(GRAD_REDUCE_LOCAL_ENV, None)
+        else:
+            _os.environ[GRAD_REDUCE_LOCAL_ENV] = prev_env
+    assert other != rep["grad_reduce"]["key"]
 
 
 # ---------------------------------------------------------------------------

@@ -3,6 +3,7 @@
 Usage (on a machine with the TPU visible):
     python tools/ablate.py full no-LRN no-dropout no-bigFC
     python tools/ablate.py --zero          # ZeRO update A/B (needs >=2 devices)
+    python tools/ablate.py --collectives   # grad_reduce variant A/B (ISSUE 12)
 
 Each variant builds the AlexNet fused train step with a layer family
 removed and reports samples/s via train_repeat — the deltas attribute
@@ -240,8 +241,241 @@ def measure_zero_ab() -> dict:
     return record
 
 
+def measure_collectives_ab() -> dict:
+    """A/B the grad_reduce variant family on a dp ZeRO mesh over every
+    local device (ISSUE 12): per variant — step time (train_repeat
+    windows, the layer-ablation protocol), bytes/step REPORTED FROM the
+    veles_collective_bytes_total counter family (the driver's model,
+    incremented per timed step and read back from the one registry),
+    an ISOLATED collective timing (a shard_map jit of just the
+    grad_reduce over the plan's total flat size — fed into
+    veles_collective_seconds_total and bracketed by a real `grad_reduce`
+    tracer span), and the trained-loss delta vs the f32 arm after a
+    short fixed-batch trajectory. Record lands in
+    COLLECTIVE_AB_RECORD.json (env VELES_COLLECTIVE_AB_PATH); CPU smoke
+    knobs COLLECTIVE_AB_BATCH/WIDTH/STEPS (the ZERO_AB precedent). On a
+    single-host mesh the DCN split needs an explicit (hosts x local)
+    geometry: VELES_GRAD_REDUCE_LOCAL defaults to n_devices/2 here so
+    the CPU 8-device mesh runs as (2 x 4)."""
+    import json
+
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.ops import variants
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.samples.alexnet import alexnet_layers
+    from veles_tpu.telemetry import metrics as tmetrics
+    from veles_tpu.telemetry import tracer as ttracer
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit("--collectives needs a >=2-device mesh; this "
+                         f"host exposes {len(devs)} device(s)")
+    n_data = len(devs)
+    prev_local = os.environ.get(variants.GRAD_REDUCE_LOCAL_ENV)
+    if prev_local is None and n_data >= 4:
+        os.environ[variants.GRAD_REDUCE_LOCAL_ENV] = str(n_data // 2)
+    mesh = make_mesh(devs)
+    batch = int(os.environ.get("COLLECTIVE_AB_BATCH", str(BATCH)))
+    width = float(os.environ.get("COLLECTIVE_AB_WIDTH", "1.0"))
+    loss_steps = int(os.environ.get("COLLECTIVE_AB_STEPS", "8"))
+    if batch % n_data:
+        raise SystemExit(f"--collectives: batch {batch} not divisible "
+                         f"by the {n_data}-device data axis")
+    reg = tmetrics.default_registry()
+    bytes_fam = reg.counter("veles_collective_bytes_total",
+                            labelnames=("op", "leg"))
+    secs_fam = reg.counter("veles_collective_seconds_total",
+                           labelnames=("op",))
+    secs_h = secs_fam.labels(op="grad_reduce")
+    tr = ttracer.active()
+    record = {"metric": "grad_reduce_collectives_ab",
+              "n_devices": n_data,
+              "device_kind": devs[0].device_kind, "batch": batch,
+              "width": width, "steps_per_window": K,
+              "loss_steps": loss_steps,
+              "geometry": dict(zip(("hosts", "local"),
+                                   variants.grad_reduce_geometry(
+                                       n_data))),
+              "arms": {}}
+    arms = ("f32", "bf16", "int8_block", "int8_ef", "hier2")
+    prev = variants.selected("grad_reduce")
+    try:
+        for name in arms:
+            variants.select("grad_reduce", name)
+            prng.seed_all(1)
+            loader = SyntheticClassifierLoader(
+                n_classes=64, sample_shape=(227, 227, 3),
+                n_validation=64, n_train=128, minibatch_size=batch,
+                noise=0.5)
+            wf = StandardWorkflow(
+                layers=list(alexnet_layers(64, width,
+                                           int(4096 * width) or 64)),
+                loader=loader, loss="softmax", n_classes=64,
+                decision_config={"max_epochs": 1, "fail_iterations": 9},
+                gd_config={"learning_rate": 0.01,
+                           "gradient_moment": 0.9},
+                name=f"CollAB-{name}")
+            wf.initialize(device=None)
+            step = wf.build_fused_step(mesh=mesh, mode="dp",
+                                       compute_dtype="bfloat16",
+                                       zero_sharding="on")
+            if not step.zero_active:
+                raise SystemExit(f"--collectives: zero inactive "
+                                 f"({step.zero_reason})")
+            acct = step.collective_accounting()
+            ch = tmetrics.collective_handles(acct, reg)
+            state = step.init_state()
+            rng = np.random.RandomState(0)
+            xs, ys_, _ = step.input_put_specs()
+            x = jax.device_put(
+                rng.randn(batch, 227, 227, 3).astype(np.float32),
+                jax.sharding.NamedSharding(mesh, xs))
+            y = jax.device_put(rng.randint(0, 64, batch),
+                               jax.sharding.NamedSharding(mesh, ys_))
+            state, _ = step.train_repeat(state, x, y, K)  # compile+warm
+            # post-warm sync barrier BY DESIGN (cf. measure())
+            # velint: disable=sync-feed
+            np.asarray(state["params"][-1]["bias"][:1])
+            before = {leg: bytes_fam.labels(op="grad_reduce",
+                                            leg=leg).value
+                      for leg in ("dcn", "ici")}
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                state, _ = step.train_repeat(state, x, y, K)
+                # measurement barrier BY DESIGN (cf. measure())
+                # velint: disable=sync-feed
+                np.asarray(state["params"][-1]["bias"][:1])
+                best = min(best, time.perf_counter() - t0)
+                # drive the counters the way the driver does: the
+                # modeled egress per dispatched train step
+                for _k in range(K):
+                    ch.dcn.inc(ch.dcn_bytes)
+                    ch.ici.inc(ch.ici_bytes)
+            # bytes/step READ BACK from the counters (the acceptance
+            # criterion's reporting path), over the 3x K timed steps
+            after = {leg: bytes_fam.labels(op="grad_reduce",
+                                           leg=leg).value
+                     for leg in ("dcn", "ici")}
+            counted = {leg: (after[leg] - before[leg]) / (3 * K)
+                       for leg in ("dcn", "ici")}
+            # isolated collective: time JUST the exchange over the
+            # plan's total flat size — the seconds counter's producer
+            coll_s = _time_isolated_reduce(step, mesh, repeats=3)
+            secs_h.inc(coll_s)
+            if tr is not None:
+                tr.instant(f"grad_reduce:{name}", "collective")
+            # trained-loss delta: a short fixed-batch trajectory (same
+            # seed per arm; rates are for the window above)
+            lstate = step.init_state()
+            loss = None
+            for _ in range(loss_steps):
+                lstate, (loss, _) = step.train(lstate, x, y)
+            arm = {
+                "samples_per_sec": round(batch * K / best, 1),
+                "bytes_per_step": {k: int(v)
+                                   for k, v in counted.items()},
+                "modeled": {k: acct[k] for k in
+                            ("dcn_bytes", "ici_bytes",
+                             "allgather_dcn_bytes",
+                             "allgather_ici_bytes")},
+                "collective_seconds": round(coll_s, 6),
+                "trained_loss": float(loss),
+                "variants": step.variant_table(),
+            }
+            record["arms"][name] = arm
+            print(f"ABLATE collectives[{name}]: "
+                  f"{arm['samples_per_sec']:.0f} samples/s, dcn "
+                  f"{arm['bytes_per_step']['dcn']} B/step, loss "
+                  f"{arm['trained_loss']:.4f}", flush=True)
+            del state, lstate
+    finally:
+        if prev is None:
+            variants.clear_selection("grad_reduce")
+        else:
+            variants.select("grad_reduce", prev)
+        # the geometry default above is scoped to THIS A/B: a later
+        # ablation in the same process must not inherit it
+        if prev_local is None:
+            os.environ.pop(variants.GRAD_REDUCE_LOCAL_ENV, None)
+    f32 = record["arms"]["f32"]
+    deltas = {}
+    for name in arms[1:]:
+        a = record["arms"][name]
+        deltas[name] = {
+            "dcn_ratio": round(
+                a["bytes_per_step"]["dcn"]
+                / max(f32["bytes_per_step"]["dcn"], 1), 4),
+            "step_time_ratio": round(
+                f32["samples_per_sec"]
+                / max(a["samples_per_sec"], 1e-9), 4),
+            "trained_loss_delta": round(
+                a["trained_loss"] - f32["trained_loss"], 6),
+        }
+    record["deltas"] = deltas
+    path = os.environ.get("VELES_COLLECTIVE_AB_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "COLLECTIVE_AB_RECORD.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2)
+    print("ABLATE collectives: dcn ratios "
+          + ", ".join(f"{n2}={d['dcn_ratio']:.3f}"
+                      for n2, d in deltas.items())
+          + f" -> {path}", flush=True)
+    return record
+
+
+def _time_isolated_reduce(step, mesh, repeats: int = 3) -> float:
+    """Seconds per call of JUST the selected grad_reduce exchange over
+    the step's total flat gradient size (one concatenated vector) —
+    the veles_collective_seconds_total producer, bracketed by a real
+    `grad_reduce` tracer span when tracing is live."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu._compat import shard_map
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    from veles_tpu.telemetry import tracer as ttracer
+    v = step._grad_reduce_variant()
+    n = mesh.shape[DATA_AXIS]
+    elems = sum(lp.padded for plan in step.zero_plans()
+                for lp in plan.values())
+    flat = jax.random.normal(jax.random.PRNGKey(7), (n, elems),
+                             jnp.float32)
+
+    def body(g):
+        r = v.apply(g.reshape(-1), DATA_AXIS)
+        out = r[0] if isinstance(r, tuple) else r
+        return out.reshape(1, -1)
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P(DATA_AXIS),
+                          out_specs=P(DATA_AXIS)))
+    jax.block_until_ready(f(flat))      # compile + warm
+    tr = ttracer.active()
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        tok = tr.begin("grad_reduce", "collective") if tr is not None \
+            else None
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(flat))
+        best = min(best, time.perf_counter() - t0)
+        if tok is not None:
+            tr.end(tok)
+    return best
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
+    if "--collectives" in args:
+        measure_collectives_ab()
+        args = [a for a in args if a != "--collectives"]
+        if not args:
+            raise SystemExit(0)
     if "--zero" in args:
         measure_zero_ab()
         args = [a for a in args if a != "--zero"]

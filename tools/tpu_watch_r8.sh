@@ -25,6 +25,12 @@
 #   6. bench.py under the searched winners (BENCH_AUTOTUNE=1) — the
 #      record's variant_table() names the generated points that won,
 #      so the headline number carries the search's provenance
+#   7. tools/ablate.py --collectives       -> carried r12 (ISSUE 12)
+#      on-chip twin of the CPU-mesh grad_reduce A/B: step time +
+#      counter-reported bytes/step + trained-loss delta per variant
+#      (f32/bf16/int8_block/int8_ef/hier2). Single-chip tunnels exit
+#      with the >=2-device message — still queued so a pod window
+#      captures it
 # Probe the flaky axon tunnel in a loop; the moment it answers, run the
 # queue in priority order, each timeout-bounded so one hang cannot eat
 # the warm window. Everything lands in tpu_watch/ + ONCHIP_LATE.md.
@@ -74,6 +80,13 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
     BENCH_AUTOTUNE=1 BENCH_ATTACH_E2E=0 timeout 600 python bench.py \
       > tpu_watch/r8_bench_tuned.txt 2> tpu_watch/r8_bench_tuned.err
     log "6 tuned bench rc=$? last: $(tail -1 tpu_watch/r8_bench_tuned.txt | head -c 200)"
+    # 7. carried r12: grad_reduce variant A/B (quantized + hierarchical
+    # collectives) — needs >=2 devices; a single-chip tunnel records
+    # the refusal message, a pod window records the real numbers
+    VELES_COLLECTIVE_AB_PATH=tpu_watch/r8_collective_ab.json \
+      timeout 1200 python tools/ablate.py --collectives \
+      > tpu_watch/r8_collective_ab.txt 2>&1
+    log "7 ablate --collectives rc=$? last: $(tail -1 tpu_watch/r8_collective_ab.txt | head -c 200)"
     {
       echo "# ONCHIP_LATE — r8 watcher capture ($(date -u +%FT%TZ))"
       echo
@@ -90,6 +103,8 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       echo "trace.json: $(wc -c < tpu_watch/r8_trace.json 2>/dev/null || echo missing) bytes; profiler dir: $(ls tpu_watch/r8_profile 2>/dev/null | head -3 | tr '\n' ' ')"
       echo "## 6. bench.py under searched winners (variant_table = provenance)"
       echo '```'; tail -3 tpu_watch/r8_bench_tuned.txt; echo '```'
+      echo "## 7. tools/ablate.py --collectives (quantized/hierarchical grad_reduce A/B)"
+      echo '```'; tail -7 tpu_watch/r8_collective_ab.txt; echo '```'
     } > ONCHIP_LATE.md
     log "capture done -> ONCHIP_LATE.md"
     exit 0

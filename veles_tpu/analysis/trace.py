@@ -245,6 +245,63 @@ def _sharding_findings(step) -> List[Finding]:
                             f"({mesh.shape[ax]} shards): XLA would "
                             "pad-shard or reject it", site))
     out += _optstate_findings(step, mesh)
+    out += _collective_findings(step, mesh)
+    return out
+
+
+def _collective_findings(step, mesh) -> List[Finding]:
+    """Link-geometry half of the sharding audit (ISSUE 12): the
+    hierarchical grad_reduce variants decompose the data axis into a
+    (hosts x local) 2-level factorization. An EXPLICIT local-group
+    request (env VELES_GRAD_REDUCE_LOCAL) that does not divide the
+    data axis is a config bug — the traced op degrades safely to the
+    flat exchange, but the user asked for a two-level decomposition
+    that cannot tile, so this pass fails loud pre-flight; a merely
+    degenerate geometry (single host) gets a warning, not an error."""
+    if not getattr(step, "zero_active", False):
+        return []
+    import os
+
+    from veles_tpu import _compat
+    if _compat.GRAD_TRANSPOSE_PSUM:
+        return []
+    from veles_tpu.ops import variants as va
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    name = step._grad_reduce_variant().name
+    cfg = va.grad_reduce_config(name) or {}
+    if not cfg.get("hier"):
+        return []
+    n = mesh.shape.get(DATA_AXIS, 1)
+    out: List[Finding] = []
+    raw = os.environ.get(va.GRAD_REDUCE_LOCAL_ENV)
+    site = f"grad_reduce/{name} over {DATA_AXIS!r} ({n} shards)"
+    if raw is not None:
+        try:
+            req = int(raw)
+        except ValueError:
+            req = 0
+        if req < 1 or n % req:
+            h, loc = va.grad_reduce_geometry(n)
+            out.append(Finding(
+                "sharding-mismatch", SEV_ERROR, "grad_reduce",
+                f"hierarchical grad_reduce local-group request "
+                f"{raw!r} ({va.GRAD_REDUCE_LOCAL_ENV}) does not divide "
+                f"the data axis ({n} shards): the requested "
+                f"(hosts x local) decomposition cannot tile it, so the "
+                f"traced op silently clamps to the largest divisor and "
+                f"runs ({h} x {loc}) instead — a DIFFERENT "
+                f"decomposition than asked for; fix the override or "
+                f"the mesh", site))
+            return out
+    h, loc = va.grad_reduce_geometry(n)
+    if h <= 1 or loc <= 1:
+        out.append(Finding(
+            "sharding-mismatch", SEV_WARN, "grad_reduce",
+            f"hierarchical grad_reduce variant selected but the link "
+            f"geometry is single-level (hosts={h}, local={loc}): the "
+            f"traced op degrades to the flat exchange here — expected "
+            f"on a single host; set {va.GRAD_REDUCE_LOCAL_ENV} to test "
+            f"the two-level path on a CPU mesh", site))
     return out
 
 
@@ -331,6 +388,50 @@ def _optstate_state_findings(step, state) -> List[Finding]:
                         "does not match the plan it will be updated "
                         "under",
                         f"{getattr(u, 'name', u)}.vel[{label}]"))
+    out += _ef_state_findings(step, state)
+    return out
+
+
+def _ef_state_findings(step, state) -> List[Finding]:
+    """Error-feedback-slot half of the live-state cross-check (ISSUE
+    12): a stateful (int8+EF) grad_reduce variant carries one flat
+    residual vector per param leaf, sized by the variant's rule
+    (ops.variants.grad_reduce_resid_len x data-axis shards). A residual
+    whose stored length disagrees — e.g. a checkpoint hand-carried
+    across a (hosts x local) geometry change — would be reshaped onto
+    the WRONG elements and compensate them forever: mis-sharded, the
+    exact failure the reshard path's drop rule exists to prevent."""
+    if not getattr(step, "ef_active", lambda: False)():
+        return []
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    n = step.mesh.shape.get(DATA_AXIS, 1)
+    ef = state.get("ef") if isinstance(state, dict) else None
+    out: List[Finding] = []
+    if ef is None:
+        out.append(Finding(
+            "sharding-mismatch", SEV_ERROR, "grad_reduce",
+            "the selected grad_reduce variant is stateful (error "
+            "feedback) but the state carries no 'ef' slot: the traced "
+            "update would have no residual to thread (rebuild the "
+            "state via init_state()/restore_state())", "state[ef]"))
+        return out
+    for u, lens, layer in zip(step.forwards, step.ef_lens(), ef):
+        if not isinstance(layer, dict):
+            continue
+        for k, rl in lens.items():
+            leaf = layer.get(k)
+            if leaf is None:
+                continue
+            shape = tuple(np.shape(leaf))
+            if shape != (n * rl,):
+                out.append(Finding(
+                    "sharding-mismatch", SEV_ERROR, repr(u),
+                    f"error-feedback residual {k!r} carries shape "
+                    f"{shape}, but the selected grad_reduce variant "
+                    f"slices ({n * rl},) ({n} shards x {rl} per-shard "
+                    f"elements): a mis-sized residual would compensate "
+                    f"the wrong gradient elements",
+                    f"{getattr(u, 'name', u)}.ef[{k}]"))
     return out
 
 

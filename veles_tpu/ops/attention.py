@@ -67,7 +67,8 @@ def _block_accum(q, k, v, scale, mask, m, l, o):
 
 def ring_attention(q, k, v, axis_name: str,
                    scale: Optional[float] = None, causal: bool = False,
-                   kv_block: Optional[int] = None):
+                   kv_block: Optional[int] = None,
+                   kv_order: str = "fwd"):
     """Sequence-parallel attention over a ring. Call INSIDE shard_map with
     q/k/v sharded on the sequence dim: (B, S/n, H, D) per device.
 
@@ -85,7 +86,17 @@ def ring_attention(q, k, v, axis_name: str,
     is `jax.checkpoint`-ed, so the backward recomputes scores/probs
     per block instead of storing them (flash-attention memory profile,
     differentiable end-to-end). None → min(S_local, 1024); a value that
-    does not divide S_local falls back to one block per hop."""
+    does not divide S_local falls back to one block per hop.
+
+    `kv_block`/`kv_order` are the flash_attn search axes reaching the
+    ring hop (MultiHeadAttention.ring_params wires the registry winner's
+    blk_k/kv_order here): "rev" visits the held shard's inner blocks
+    last-to-first — the online softmax is order-invariant, so the
+    choice only probes prefetch/locality, exactly like the local
+    kernel's kv_order axis."""
+    if kv_order not in ("fwd", "rev"):
+        raise ValueError(f"kv_order must be 'fwd'|'rev', got "
+                         f"{kv_order!r}")
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -127,6 +138,9 @@ def ring_attention(q, k, v, axis_name: str,
                 k_t.reshape(b, nb, kv_block, h, d), 1, 0)
             vr = jnp.moveaxis(
                 v_t.reshape(b, nb, kv_block, h, d), 1, 0)
+            order = jnp.arange(nb)
+            if kv_order == "rev":
+                kr, vr, order = kr[::-1], vr[::-1], order[::-1]
 
             @jax.checkpoint
             def blk(c, xs):
@@ -141,8 +155,7 @@ def ring_attention(q, k, v, axis_name: str,
                 return _block_accum(q, kb, vb, scale, mask,
                                     mc, lc, oc), None
 
-            (m, l, o), _ = lax.scan(blk, (m, l, o),
-                                    (kr, vr, jnp.arange(nb)))
+            (m, l, o), _ = lax.scan(blk, (m, l, o), (kr, vr, order))
         k_t = lax.ppermute(k_t, axis_name, perm)
         v_t = lax.ppermute(v_t, axis_name, perm)
         return m, l, o, k_t, v_t

@@ -242,8 +242,10 @@ def apply_cached(wf, *, compute_dtype=None,
         ks.append(op_cache_key(device_kind, op, sigs, compute_dtype))
         keys[op] = ks
     for op in templates.template_ops():
+        sig_fn = EXTRA_OP_SIGS.get(op)
+        base = sig_fn() if sig_fn else []
         keys.setdefault(op, [op_cache_key(
-            device_kind, op, templates.space_signature(op),
+            device_kind, op, base + templates.space_signature(op),
             compute_dtype)])
     applied: Dict[str, str] = {}
     for op, ks in keys.items():
@@ -299,14 +301,27 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
         searchable = [op for op in tunables
                       if templates.templates_for(op)
                       and op in templates.CONTRACTS]
-        if "sgd_update" in templates.CONTRACTS \
+        if (not ops or "sgd_update" in ops) \
+                and "sgd_update" in templates.CONTRACTS \
                 and any(not getattr(g, "optimizer", "sgd") == "adam"
                         for g in getattr(wf, "gds", ())):
             # the fused step's SGD leg resolves the sgd_update registry
             # op (FusedTrainStep._sgd_variant), so its template space
             # belongs in this workflow's search even though no forward
-            # unit names it — timed via the template microbench
+            # unit names it — timed via the template microbench. An
+            # explicit `ops` restriction that omits it still wins.
             searchable.append("sgd_update")
+        if (not ops or "grad_reduce" in ops) \
+                and "grad_reduce" in templates.CONTRACTS \
+                and len(jax.devices()) > 1:
+            # the dp-mode ZeRO update (on by default) resolves the
+            # grad_reduce registry op, so its wire/geometry space rides
+            # the budget too — microbench-timed over this host's link
+            # geometry, cache-keyed by it (EXTRA_OP_SIGS). Skipped on a
+            # single-device host (no axis to exchange over — the
+            # microbench would time a degenerate identity) and under an
+            # explicit `ops` restriction that omits it.
+            searchable.append("grad_reduce")
     if searchable:
         # ONE search implementation: delegate the template-backed ops
         # to search_workflow (priority order, budget split, in-graph
@@ -373,6 +388,30 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
 # ===========================================================================
 # Budgeted search over generated candidates (ops.templates)
 # ===========================================================================
+
+
+def link_geometry_signature() -> List[Dict]:
+    """Cache-key payload for cross-device collective ops (grad_reduce):
+    the link geometry. A winner tuned on one (hosts x local) topology
+    must not silently apply to another — the ISSUE-12 contract that the
+    autotune cache is keyed by device/mesh shape for the collective
+    family."""
+    import jax
+
+    from veles_tpu.ops import variants
+    n = len(jax.devices())
+    h, loc = variants.grad_reduce_geometry(n)
+    return [{"link_geometry": {
+        "n_devices": n, "n_processes": jax.process_count(),
+        "hosts": h, "local": loc}}]
+
+
+#: per-op extra cache-key signatures beyond the workflow's op configs —
+#: consulted by search_workflow AND apply_cached so a searched winner's
+#: key and a later run's probe can never disagree
+EXTRA_OP_SIGS: Dict[str, Callable[[], List[Dict]]] = {
+    "grad_reduce": link_geometry_signature,
+}
 
 
 def default_profile_path() -> str:
@@ -667,6 +706,12 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
         if not getattr(wf, "is_initialized", False):
             wf.initialize(device=None)
         wf_sigs = discover_tunables(wf)
+    #: ops the WORKFLOW names (in-graph-timeable) — before the extra
+    #: signatures below widen wf_sigs for cache-keying only
+    discovered = set(wf_sigs)
+    for op, sig_fn in EXTRA_OP_SIGS.items():
+        if op in all_ops:
+            wf_sigs.setdefault(op, sig_fn())
     on_cpu = jax.default_backend() == "cpu"
     ordered = priority_order(all_ops, profile_path)
     shares = allocate_budget(
@@ -678,7 +723,7 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
     with ctx:
         for op, share in ordered:
             timer = None
-            if wf is not None and op in wf_sigs:
+            if wf is not None and op in discovered:
                 timer = (lambda: _time_variant(
                     wf, mesh, compute_dtype, steps, repeats, batch))
             report[op] = search_op(

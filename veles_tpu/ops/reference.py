@@ -404,6 +404,41 @@ def sgd_momentum_update(p: np.ndarray, g: np.ndarray, v: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# blockwise int8 quantization (NO 2015 parity — the golden for the EQuARX
+# `grad_reduce` wire compression, arxiv 2506.17615: per-block absmax
+# scales, round-to-nearest-even codes. The jax twins in ops.variants
+# (`q8_encode`/`q8_decode`) must reproduce these BITWISE — codes, scales
+# and the dequantized values — which the grad_reduce equivalence contract
+# asserts before any quantized collective may be timed or trained with.)
+# ---------------------------------------------------------------------------
+
+def quantize_blockwise(x: np.ndarray, block: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-block absmax int8 quantization over the LAST axis (its length
+    must divide `block` — callers zero-pad first; a zero pad block gets
+    scale 1 and all-zero codes, contributing nothing on dequantize).
+    Returns (codes int8, scales f32); codes = clip(rint(x/scale), ±127)
+    with scale = absmax/127 (1.0 for an all-zero block)."""
+    assert x.shape[-1] % block == 0, (x.shape, block)
+    xb = x.reshape(x.shape[:-1] + (x.shape[-1] // block, block)) \
+        .astype(np.float32)
+    absmax = np.max(np.abs(xb), axis=-1)
+    scale = np.where(absmax > 0, absmax / np.float32(127.0),
+                     np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(xb / scale[..., None]), -127, 127).astype(np.int8)
+    return q.reshape(x.shape), scale
+
+
+def dequantize_blockwise(q: np.ndarray, scale: np.ndarray,
+                         block: int) -> np.ndarray:
+    """Inverse of `quantize_blockwise`: codes x scales -> f32 values."""
+    assert q.shape[-1] % block == 0, (q.shape, block)
+    qb = q.reshape(q.shape[:-1] + (q.shape[-1] // block, block)) \
+        .astype(np.float32)
+    return (qb * scale[..., None].astype(np.float32)).reshape(q.shape)
+
+
+# ---------------------------------------------------------------------------
 # multi-head attention (NO 2015 parity — the reference framework has no
 # attention anywhere, SURVEY.md §5.7; this numpy model is the golden the
 # `flash_attn` lowering variants are equivalence-gated against)

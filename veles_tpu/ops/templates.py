@@ -84,6 +84,11 @@ class KernelTemplate:
     #: the bench shapes; the search skips aliases so the budget times
     #: distinct kernels and a cached winner names an executed config)
     bench_key: Optional[Callable[[Dict[str, Any]], Any]] = None
+    #: optional config -> bool: does this point carry per-shard state
+    #: through the caller (grad_reduce error feedback)? Materialized
+    #: variants get Variant.stateful from it so the fused step can size
+    #: its state slot from the NAME alone.
+    stateful: Optional[Callable[[Dict[str, Any]], bool]] = None
 
     def __post_init__(self):
         self.seed = self.validate(self.seed)
@@ -196,6 +201,7 @@ def materialize(op: str, name: str) -> Optional["variants.Variant"]:
         v = variants.Variant(
             op=op, name=t.name(cfg), apply=t.build(cfg),
             pallas=t.pallas, generated=True,
+            stateful=bool(t.stateful(cfg)) if t.stateful else False,
             doc=f"generated from template {t.base} at {cfg}")
         return variants.register(v)
     return None
@@ -554,3 +560,307 @@ register_template(KernelTemplate(
         "rt=8"))
 CONTRACTS["sgd_update"] = _sgd_contract
 BENCHES["sgd_update"] = _sgd_bench
+
+
+# -- grad_reduce: wire dtype x scale block x error feedback x hierarchy -----
+#    (the EQuARX family, arxiv 2506.17615 — ISSUE 12). All points build
+#    through variants.grad_reduce_apply, the ONE collective
+#    implementation; the contract gates each point on the BITWISE
+#    quantize/dequantize roundtrip vs ops.reference plus the shard_map
+#    exchange vs the psum golden at the wire dtype's tolerance.
+
+def _gr_build(cfg):
+    return variants.grad_reduce_apply(dict(cfg))
+
+
+def _gr_mesh():
+    import jax
+
+    from veles_tpu.parallel.mesh import make_mesh
+    devs = jax.devices()[:8]
+    return make_mesh(devs), len(devs)
+
+
+def _gr_contract(apply):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu._compat import shard_map
+    from veles_tpu.ops import reference as ref
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    cfg = getattr(apply, "gr_config", None) or variants.grad_reduce_config(
+        "f32")
+    blk = int(cfg.get("blk") or 256)
+    # 1. BITWISE quantize/dequantize roundtrip vs the numpy goldens —
+    # codes, scales and dequantized values must match exactly (the
+    # "bitwise roundtrip" half of the equivalence ledger)
+    rs = np.random.RandomState(5)
+    xq = rs.randn(3, 2 * blk).astype(np.float32)
+    qj, sj = variants.q8_encode(jnp.asarray(xq), blk)
+    qg, sg = ref.quantize_blockwise(xq, blk)
+    np.testing.assert_array_equal(np.asarray(qj), qg)
+    np.testing.assert_array_equal(np.asarray(sj), sg)
+    np.testing.assert_array_equal(
+        np.asarray(variants.q8_decode(qj, sj, blk)),
+        ref.dequantize_blockwise(qg, sg, blk))
+    # 2. the exchange itself under shard_map vs the psum-then-slice
+    # golden (the registry's admission bar for collectives)
+    mesh, n = _gr_mesh()
+    local = 48
+    flat = rs.randn(n, n * local).astype(np.float32)
+    stateful = bool(cfg.get("ef"))
+
+    def body(g):
+        r = apply(g.reshape(-1), DATA_AXIS)
+        return r[0] if stateful else r
+
+    got = np.asarray(jax.jit(shard_map(
+        body, mesh=mesh, in_specs=P(DATA_AXIS),
+        out_specs=P(DATA_AXIS)))(flat))
+    want = flat.reshape(n, n, local).sum(axis=0).reshape(-1)
+    if cfg["dt"] == "f32":
+        tol = dict(rtol=1e-5, atol=1e-5)
+    elif cfg["dt"] == "bf16":
+        tol = dict(rtol=0.05, atol=0.05)
+    else:
+        # int8 absolute error is bounded by n_shards x scale/2 with
+        # scale = block-absmax/127 (~0.03 for unit-normal grads)
+        tol = dict(rtol=0.1, atol=0.03 * n)
+    np.testing.assert_allclose(got, want, **tol)
+    if cfg["dt"] == "int8" and not cfg["hier"]:
+        # flat int8 is EXACTLY the reference-quantized exchange: the sum
+        # of per-shard dequantized partials, to f32 summation rounding
+        deq = np.zeros_like(flat)
+        pad = (-local) % blk
+        for i in range(n):
+            x2 = np.pad(flat[i].reshape(n, local), ((0, 0), (0, pad)))
+            q, s = ref.quantize_blockwise(x2, blk)
+            deq[i] = ref.dequantize_blockwise(q, s, blk)[:, :local] \
+                .reshape(-1)
+        want_q = deq.reshape(n, n, local).sum(axis=0).reshape(-1)
+        np.testing.assert_allclose(got, want_q, rtol=1e-6, atol=1e-5)
+    return {"checked": f"q8 roundtrip bitwise vs ops.reference + "
+                       f"shard_map exchange vs psum golden on {n} "
+                       f"devices ({cfg['dt']} tolerance)"}
+
+
+def _gr_bench_key(cfg):
+    """Configs that trace the same program at the bench geometry alias:
+    blk/ef only matter for int8 wire, and hier degrades to flat when
+    the geometry is single-level (grad_reduce_geometry)."""
+    _, n = _gr_mesh()
+    h, loc = variants.grad_reduce_geometry(n)
+    int8 = cfg["dt"] == "int8"
+    hier = bool(cfg["hier"]) and h > 1 and loc > 1
+    return (cfg["dt"], cfg["blk"] if int8 else 0,
+            cfg["ef"] if int8 else 0, int(hier))
+
+
+def _gr_bench(apply, repeats):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu._compat import shard_map
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    mesh, n = _gr_mesh()
+    per_shard = n * (4096 if _on_cpu() else (1 << 19))
+    flat = jax.random.normal(jax.random.PRNGKey(3), (n, per_shard),
+                             jnp.float32)
+
+    def body(g):
+        r = apply(g.reshape(-1), DATA_AXIS)
+        out = r[0] if isinstance(r, tuple) else r
+        return out.reshape(1, -1)
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P(DATA_AXIS),
+                          out_specs=P(DATA_AXIS)))
+    jax.block_until_ready(f(flat))          # compile + warm
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(flat))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+register_template(KernelTemplate(
+    op="grad_reduce", base="wire",
+    axes=(Axis("dt", ("f32", "bf16", "int8"),
+               doc="wire dtype of the DCN exchange"),
+          Axis("blk", (64, 128, 256, 512),
+               doc="int8 absmax-scale block (scale overhead 4/blk "
+                   "bytes/elem); inert for float wire"),
+          Axis("ef", (0, 1),
+               doc="error feedback: carry the quantization residual in "
+                   "the ZeRO state (int8 only — canonicalized off "
+                   "otherwise)"),
+          Axis("hier", (0, 1),
+               doc="two-level (hosts x local) decomposition: ICI-local "
+                   "reduce-scatter, DCN exchange of 1/n_local slices")),
+    build=_gr_build, seed={"dt": "f32", "blk": 256, "ef": 0, "hier": 0},
+    pallas=False, bench_key=_gr_bench_key,
+    stateful=lambda cfg: cfg["dt"] == "int8" and bool(cfg["ef"]),
+    doc="quantized + hierarchical ZeRO reduce-scatter family (EQuARX, "
+        "arxiv 2506.17615) — the search picks the winner per link "
+        "geometry, cache-keyed by (device_kind, hosts x local)"))
+CONTRACTS["grad_reduce"] = _gr_contract
+BENCHES["grad_reduce"] = _gr_bench
+
+
+# -- maxpool: forward algorithm x backward combine-DAG shape ----------------
+#    (carried ROADMAP item: the last registry ops with no template; the
+#    axes reify the hand-written reduce_window/slices split and add the
+#    slices fold's combine-tree shape — the backward's select-DAG depth)
+
+def _maxpool_build(cfg):
+    algo, fold = cfg["algo"], cfg["fold"]
+
+    def apply(x, ksize, stride, use_abs):
+        from veles_tpu.ops import variants as va
+        from veles_tpu.ops import xla as ox
+        if algo == "reduce_window":
+            return va.get("maxpool", "reduce_window").apply(
+                x, ksize, stride, use_abs)
+        return ox.maxpool_forward_slices(x, ksize, stride, use_abs,
+                                         fold=fold)
+    return apply
+
+
+def _maxpool_contract(apply):
+    import jax
+    import numpy as np
+
+    from veles_tpu.ops import reference as ref
+    rs = np.random.RandomState(9)
+    x = rs.randn(2, 7, 7, 6).astype(np.float32)
+    for use_abs in (False, True):
+        y, vjp = jax.vjp(lambda a: apply(a, (3, 3), (2, 2), use_abs), x)
+        yg, idx = ref.maxpool_forward(x, (3, 3), (2, 2), use_abs)
+        np.testing.assert_allclose(np.asarray(y), yg, atol=1e-6,
+                                   err_msg=f"use_abs={use_abs}")
+        g = rs.randn(*yg.shape).astype(np.float32)
+        (dx,) = vjp(g)
+        np.testing.assert_allclose(
+            np.asarray(dx), ref.maxpool_backward(g, idx, x.shape),
+            atol=1e-6, err_msg=f"use_abs={use_abs} bwd")
+    return {"checked": "maxpool fwd+bwd (max + maxabs) vs "
+                       "ops.reference, atol 1e-6"}
+
+
+def _maxpool_bench(apply, repeats):
+    import jax
+    import jax.numpy as jnp
+    shape = (8, 13, 13, 8) if _on_cpu() else (256, 27, 27, 96)
+    x = jax.random.normal(jax.random.PRNGKey(4), shape, jnp.float32)
+
+    def fwd_bwd(xx):
+        y, vjp = jax.vjp(lambda a: apply(a, (3, 3), (2, 2), False), xx)
+        return y, vjp(y)[0]
+
+    return _time_jitted(fwd_bwd, (x,), repeats)
+
+
+def _maxpool_bench_key(cfg):
+    # fold only shapes the slices combine-DAG; reduce_window ignores it
+    return (cfg["algo"],
+            cfg["fold"] if cfg["algo"] == "slices" else "-")
+
+
+register_template(KernelTemplate(
+    op="maxpool", base="gen",
+    axes=(Axis("algo", ("reduce_window", "slices"),
+               doc="forward lowering (the knob is what the BACKWARD "
+                   "lowers to: select_and_scatter vs selects+pads)"),
+          Axis("fold", ("linear", "tree"),
+               doc="slices combine-DAG: left fold (deep select chain) "
+                   "vs pairwise tree (log depth); inert for "
+                   "reduce_window")),
+    build=_maxpool_build,
+    seed={"algo": "reduce_window", "fold": "linear"},
+    pallas=False, bench_key=_maxpool_bench_key,
+    doc="max/maxabs pooling over algorithm x backward combine shape"))
+CONTRACTS["maxpool"] = _maxpool_contract
+BENCHES["maxpool"] = _maxpool_bench
+
+
+# -- conv_stem: input packing x accumulator dtype ---------------------------
+
+def _conv_stem_build(cfg):
+    pack, acc = cfg["pack"], cfg["acc"]
+
+    def apply(x, w, b, stride, padding, activation):
+        from veles_tpu.ops import xla as ox
+        return ox.conv2d_forward(x, w, b, stride, padding, activation,
+                                 s2d=(pack == "s2d"), acc=acc)
+    return apply
+
+
+def _conv_stem_contract(apply):
+    import jax
+    import numpy as np
+
+    from veles_tpu.ops import reference as ref
+    rs = np.random.RandomState(13)
+    x = rs.randn(2, 19, 19, 3).astype(np.float32)
+    w = (rs.randn(5, 5, 3, 8) * 0.1).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    stride, padding, act = (4, 4), (0, 0), "strictrelu"
+    y, vjp = jax.vjp(
+        lambda xx, ww, bb: apply(xx, ww, bb, stride, padding, act),
+        x, w, b)
+    yg = ref.conv2d_forward(x, w, b, stride, padding, act)
+    np.testing.assert_allclose(np.asarray(y), yg, rtol=1e-4, atol=1e-4)
+    g = rs.randn(*yg.shape).astype(np.float32)
+    dx, dw, db = vjp(g)
+    gx, gw, gb = ref.conv2d_backward(x, w, yg, g, stride, padding, act)
+    np.testing.assert_allclose(np.asarray(dx), gx, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw), gw, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(db), gb, rtol=1e-4, atol=1e-4)
+    return {"checked": "stem conv fwd+bwd (stride-4 thin-channel) vs "
+                       "ops.reference, rtol 1e-4"}
+
+
+def _conv_stem_bench(apply, repeats):
+    import jax
+    import jax.numpy as jnp
+    n, hw, co = (4, 35, 16) if _on_cpu() else (256, 227, 96)
+    key = jax.random.PRNGKey(5)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (n, hw, hw, 3), jnp.float32)
+    w = jax.random.normal(k2, (11, 11, 3, co), jnp.float32) * 0.05
+    b = jax.random.normal(k3, (co,), jnp.float32)
+
+    def fwd_bwd(xx, ww, bb):
+        y, vjp = jax.vjp(
+            lambda a, c, d: apply(a, c, d, (4, 4), (0, 0),
+                                  "strictrelu"), xx, ww, bb)
+        return y, vjp(y)
+
+    return _time_jitted(fwd_bwd, (x, w, b), repeats)
+
+
+def _conv_stem_bench_key(cfg):
+    # the microbench runs f32 inputs, where the accumulator axis traces
+    # the same program — only the packing distinguishes kernels there
+    return (cfg["pack"],)
+
+
+register_template(KernelTemplate(
+    op="conv_stem", base="gen",
+    axes=(Axis("pack", ("direct", "s2d"),
+               doc="input packing: plain strided conv vs the exact "
+                   "space-to-depth rewrite (full MXU tiles)"),
+          Axis("acc", ("native", "f32"),
+               doc="conv accumulator dtype under sub-f32 compute: "
+                   "XLA's dtype-following default vs pinned f32 "
+                   "(preferred_element_type)")),
+    build=_conv_stem_build, seed={"pack": "s2d", "acc": "native"},
+    pallas=False, bench_key=_conv_stem_bench_key,
+    doc="strided thin-channel entry conv over packing x accumulator"))
+CONTRACTS["conv_stem"] = _conv_stem_contract
+BENCHES["conv_stem"] = _conv_stem_bench

@@ -41,7 +41,10 @@ __all__ = [
     "Variant", "register_op", "register", "ops", "variants_for", "get",
     "has", "select", "selected", "effective", "clear_selection",
     "selection_table", "resolve", "pallas_ok", "pallas_interpret",
-    "warn_deprecated_knob",
+    "warn_deprecated_knob", "grad_reduce_apply", "grad_reduce_config",
+    "grad_reduce_geometry", "grad_reduce_local_request",
+    "grad_reduce_resid_len", "grad_reduce_bytes", "q8_encode",
+    "q8_decode", "GRAD_REDUCE_LOCAL_ENV",
 ]
 
 
@@ -63,6 +66,11 @@ class Variant:
     pallas: bool = False
     tunable: bool = True
     generated: bool = False
+    #: stateful lowerings carry a per-shard residual through the caller's
+    #: state (grad_reduce error feedback: apply(flat, axis, resid) ->
+    #: (slice, new_resid)); consumers that can't host the slot must not
+    #: select one
+    stateful: bool = False
     doc: str = ""
 
 
@@ -327,44 +335,320 @@ register(Variant("conv_stem", "s2d", _conv_s2d,
 
 
 # -- gradient reduce-scatter (the ZeRO update's collective leg) -------------
-#    apply(flat_partial, axis_name) -> this shard's summed slice.
+#    apply(flat_partial, axis_name, resid=None) -> this shard's summed
+#    slice; STATEFUL (error-feedback) variants return (slice, new_resid).
 #    `flat_partial` is one param leaf's per-shard partial gradient,
 #    flattened and zero-padded to a multiple of the axis size
 #    (parallel.mesh.zero_flatten); the variant reduce-scatters it over
 #    the named data axis so each shard receives only the 1/N slice of
 #    the SUMMED gradient it owns under the update-sharding plan
-#    (arxiv 2004.13336). Seeded with f32 (exact) and bf16 (wire dtype
-#    halved; equivalence contract at a stated tolerance) so the EQuARX
-#    int8 blockwise-scaled / error-feedback variants (arxiv 2506.17615)
-#    are a pure follow-on `register()` — the fused step already resolves
-#    the collective through here.
+#    (arxiv 2004.13336). Cross-host that exchange rides DCN, where bytes
+#    — not FLOPs — bound scaling efficiency, so the family trades
+#    gradient bits for wire bytes (EQuARX, arxiv 2506.17615):
+#
+#    - f32 / bf16: psum_scatter in the wire dtype (exact / bytes ÷2);
+#    - int8_block: per-block absmax-scaled int8 codes, the f32 scales
+#      riding the SAME all-to-all exchange, dequantize-accumulate in
+#      f32 (bytes ÷~4 at blk=256);
+#    - int8_ef:   int8_block + error feedback — the quantization
+#      residual is carried per shard in the ZeRO flat-vector state (the
+#      step's "ef" slot) and added back before the next quantization,
+#      so the compression error telescopes instead of accumulating;
+#    - hier2:     two-level decomposition over the (hosts x local)
+#      factorization of the data axis: ICI-local reduce-scatter in the
+#      gradient dtype, then the DCN exchange moves only the 1/n_local
+#      slices (DCN bytes ÷n_local) — the CPU 8-device mesh tests it as
+#      (hosts=2, local=4) via VELES_GRAD_REDUCE_LOCAL;
+#    - the searched family `wire[dt=..,blk=..,ef=..,hier=..]`
+#      (ops.templates) composes all four axes; every point is built by
+#      the ONE `grad_reduce_apply` below and equivalence-gated against
+#      the ops.reference quantization goldens before the budgeted
+#      search may time it.
+#
+#    All collective calls live in THIS module by the velint
+#    stray-collective contract. The byte model (`grad_reduce_bytes`)
+#    feeds veles_collective_bytes_total; docs/SCALING.md states the
+#    per-variant math and the trained-loss tolerances.
 
-def _grad_reduce_f32(flat, axis_name):
-    from jax import lax
-    return lax.psum_scatter(flat, axis_name, scatter_dimension=0,
-                            tiled=True)
+GRAD_REDUCE_LOCAL_ENV = "VELES_GRAD_REDUCE_LOCAL"
+
+#: canonical configs of the named (hand-registered) family members —
+#: shared by registration, `grad_reduce_config` and the byte model
+_GR_NAMED: Dict[str, Dict[str, Any]] = {
+    "f32": {"dt": "f32", "blk": 0, "ef": 0, "hier": 0},
+    "bf16": {"dt": "bf16", "blk": 0, "ef": 0, "hier": 0},
+    "int8_block": {"dt": "int8", "blk": 256, "ef": 0, "hier": 0},
+    "int8_ef": {"dt": "int8", "blk": 256, "ef": 1, "hier": 0},
+    "hier2": {"dt": "f32", "blk": 0, "ef": 0, "hier": 1},
+}
 
 
-def _grad_reduce_bf16(flat, axis_name):
+def grad_reduce_local_request(n_shards: int) -> int:
+    """The UNCLAMPED ICI-group-size request for the hierarchical
+    variants: env VELES_GRAD_REDUCE_LOCAL (explicit geometry — CPU
+    tests, odd topologies) or this process's local device count. The
+    jaxpr auditor checks an explicit request divides the data axis;
+    `grad_reduce_geometry` below clamps a non-dividing request to the
+    LARGEST DIVISOR it does not exceed — the traced op then runs that
+    different (but always-valid) decomposition, never a crash."""
+    import os
+    raw = os.environ.get(GRAD_REDUCE_LOCAL_ENV)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            return 0
+    try:
+        import jax
+        return jax.local_device_count()
+    except Exception:  # noqa: BLE001 — no backend: treat as single-host
+        return n_shards
+
+
+def grad_reduce_geometry(n_shards: int) -> tuple:
+    """(n_hosts, n_local): the two-level factorization of the data axis
+    the hierarchical variants decompose over. n_local is the request
+    clamped to the largest divisor of n_shards it does not exceed, so
+    the groups always tile the axis; (1, n) or (n, 1) geometries make
+    the hierarchy degenerate and `grad_reduce_apply` falls back to the
+    flat exchange."""
+    loc = grad_reduce_local_request(n_shards)
+    loc = max(1, min(int(loc), n_shards))
+    while n_shards % loc:
+        loc -= 1
+    return n_shards // loc, loc
+
+
+def grad_reduce_config(name: Any) -> Optional[Dict[str, Any]]:
+    """Canonical EFFECTIVE config {dt, blk, ef, hier} for any
+    grad_reduce variant name — named incumbents or template-generated
+    ``wire[...]`` points; None for foreign names. Error feedback is an
+    int8-only mechanism: ef (and blk) canonicalize to 0 for float wire
+    dtypes, so two names that trace the same program report the same
+    config (bytes, state slots and bench aliasing all read this)."""
+    cfg = _GR_NAMED.get(name)
+    if cfg is not None:
+        cfg = dict(cfg)
+    elif isinstance(name, str) and "[" in name:
+        from veles_tpu.ops import templates
+        for t in templates.templates_for("grad_reduce"):
+            parsed = t.parse(name)
+            if parsed is not None:
+                cfg = dict(parsed)
+                break
+    if cfg is None:
+        return None
+    if cfg.get("dt") != "int8":
+        cfg["ef"] = 0
+        cfg["blk"] = 0
+    return cfg
+
+
+def grad_reduce_resid_len(name: str, padded: int,
+                          n_shards: int) -> Optional[int]:
+    """Per-shard error-feedback residual length for one (padded,) flat
+    leaf under the named variant — None for stateless variants. The
+    flat int8+EF exchange quantizes the whole per-shard partial
+    (residual = padded elements); the hierarchical one applies EF to
+    the DCN leg only, AFTER the ICI reduce-scatter, so its residual is
+    the 1/n_local slice. One rule shared by the traced op, the step's
+    state allocation and the checkpoint geometry — they can never
+    disagree."""
+    cfg = grad_reduce_config(name)
+    if not cfg or not cfg["ef"]:
+        return None
+    if cfg["hier"]:
+        h, loc = grad_reduce_geometry(n_shards)
+        if h > 1 and loc > 1:
+            return padded // loc
+    return padded
+
+
+def grad_reduce_bytes(name: str, n_elems: int,
+                      n_shards: int) -> Dict[str, Any]:
+    """Modeled per-device egress bytes per step of the grad_reduce
+    exchange (plus the param all-gather leg for context), split by link
+    leg under the (hosts x local) geometry. The model counts gradient
+    payload a device must move to peers: off-host destinations are DCN,
+    on-host are ICI; int8 wire adds the per-block f32 scale overhead
+    (4/blk bytes per element). This is the producer behind
+    veles_collective_bytes_total (docs/SCALING.md states the math) —
+    modeled from the collective's algorithm and the plan sizes, since
+    XLA exposes no per-collective wire counters."""
+    cfg = grad_reduce_config(name) or dict(_GR_NAMED["f32"])
+    h, loc = grad_reduce_geometry(n_shards)
+    item = {"f32": 4.0, "bf16": 2.0, "int8": 1.0}[cfg["dt"]]
+    if cfg["dt"] == "int8" and cfg["blk"]:
+        item += 4.0 / cfg["blk"]      # the scales ride the same exchange
+    n = n_shards
+    if cfg["hier"] and h > 1 and loc > 1:
+        # phase 1 (ICI): reduce-scatter within the local group, in the
+        # gradient dtype; phase 2 (DCN): only the 1/local slices cross
+        ici = n_elems * (loc - 1) / loc * 4.0
+        dcn = (n_elems / loc) * (h - 1) / h * item
+    else:
+        dcn = n_elems * (n - loc) / n * item
+        ici = n_elems * (loc - 1) / n * item
+    return {"dcn_bytes": int(dcn), "ici_bytes": int(ici),
+            "allgather_dcn_bytes": int(n_elems / n * (n - loc) * 4.0),
+            "allgather_ici_bytes": int(n_elems / n * (loc - 1) * 4.0),
+            "geometry": {"hosts": h, "local": loc},
+            "config": cfg}
+
+
+def q8_encode(x2, blk: int):
+    """jax twin of ops.reference.quantize_blockwise over the last axis
+    of a 2-D (rows, cols) array, zero-padding cols up to a block
+    multiple. Returns (codes int8 (rows, colsp), scales f32
+    (rows, colsp//blk)). BITWISE-identical to the numpy golden — the
+    grad_reduce equivalence contract asserts it."""
     import jax.numpy as jnp
+    rows, cols = x2.shape
+    pad = (-cols) % blk
+    if pad:
+        x2 = jnp.pad(x2, ((0, 0), (0, pad)))
+    xb = x2.reshape(rows, -1, blk)
+    absmax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / jnp.float32(127.0),
+                      jnp.float32(1.0))
+    q = jnp.clip(jnp.round(xb / scale[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    return q.reshape(rows, -1), scale
+
+
+def q8_decode(q, scale, blk: int):
+    """jax twin of ops.reference.dequantize_blockwise (2-D rows form)."""
+    import jax.numpy as jnp
+    rows = q.shape[0]
+    xb = q.reshape(rows, -1, blk).astype(jnp.float32)
+    return (xb * scale[..., None]).reshape(rows, -1)
+
+
+def _q8_exchange(x, axis_name, blk, resid, groups, local, want_resid):
+    """Blockwise-int8 exchange-and-accumulate: quantize each destination
+    row (per-block absmax scales), all_to_all the codes AND the scales
+    in one pattern (the scale exchange rides the same scatter),
+    dequantize and accumulate in f32. `x` is (rows, local) with row j
+    bound for exchange-group member j; returns (my summed (local,)
+    slice, new residual (rows*local,) or None)."""
+    import jax.numpy as jnp  # noqa: F401 — q8 helpers carry the math
     from jax import lax
-    return lax.psum_scatter(
-        flat.astype(jnp.bfloat16), axis_name, scatter_dimension=0,
-        tiled=True).astype(flat.dtype)
+    if resid is not None:
+        x = x + resid.reshape(x.shape)
+    q, s = q8_encode(x, blk)
+    new_resid = None
+    if want_resid:
+        new_resid = (x - q8_decode(q, s, blk)[:, :local]).reshape(-1)
+    kw = {"axis_index_groups": groups} if groups is not None else {}
+    q_r = lax.all_to_all(q, axis_name, 0, 0, tiled=True, **kw)
+    s_r = lax.all_to_all(s, axis_name, 0, 0, tiled=True, **kw)
+    out = q8_decode(q_r, s_r, blk)[:, :local].sum(axis=0)
+    return out, new_resid
+
+
+def grad_reduce_apply(cfg: Dict[str, Any]) -> Callable[..., Any]:
+    """Build the canonical grad_reduce apply for one config point — the
+    ONE implementation behind every named incumbent and every generated
+    ``wire[...]`` candidate. Stateful (EF) applies ALWAYS return
+    (slice, new_resid); resid=None means a zero residual. The closure
+    carries its canonical config as ``apply.gr_config`` so the
+    equivalence contract can pick per-dtype tolerances without a second
+    naming scheme."""
+    dt = cfg["dt"]
+    blk = int(cfg.get("blk") or 256)
+    ef = bool(cfg.get("ef")) and dt == "int8"
+    hier = bool(cfg.get("hier"))
+
+    def apply(flat, axis_name, resid=None):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from veles_tpu._compat import axis_size
+        n = axis_size(axis_name)
+        h, loc = grad_reduce_geometry(n)
+        two_level = hier and h > 1 and loc > 1
+        local = flat.shape[0] // n
+        new_resid = None
+        if two_level:
+            lgroups = [[hh * loc + ll for ll in range(loc)]
+                       for hh in range(h)]
+            cgroups = [[hh * loc + ll for hh in range(h)]
+                       for ll in range(loc)]
+            # phase 1 (ICI): reduce-scatter within each host's local
+            # group, in the gradient dtype — the row order below lands
+            # device (host h, local l) exactly the final slices device
+            # index h*loc+l owns, matching the flat scatter's layout
+            x = flat.astype(jnp.float32).reshape(h, loc, local) \
+                .transpose(1, 0, 2)
+            x = lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                 axis_index_groups=lgroups, tiled=True)
+            x = x.reshape(h, local)   # per-host partials of my slices
+            if dt == "int8":
+                out, new_resid = _q8_exchange(
+                    x, axis_name, blk, resid if ef else None, cgroups,
+                    local, ef)
+            else:
+                w = x.astype(jnp.bfloat16) if dt == "bf16" else x
+                out = lax.psum_scatter(
+                    w, axis_name, scatter_dimension=0,
+                    axis_index_groups=cgroups, tiled=True
+                ).reshape(-1).astype(jnp.float32)
+        elif dt == "int8":
+            x = flat.astype(jnp.float32).reshape(n, local)
+            out, new_resid = _q8_exchange(
+                x, axis_name, blk, resid if ef else None, None, local,
+                ef)
+        elif dt == "bf16":
+            out = lax.psum_scatter(
+                flat.astype(jnp.bfloat16), axis_name,
+                scatter_dimension=0, tiled=True).astype(jnp.float32)
+        else:
+            out = lax.psum_scatter(flat, axis_name,
+                                   scatter_dimension=0, tiled=True)
+        out = out.astype(flat.dtype)
+        return (out, new_resid) if ef else out
+
+    apply.gr_config = {"dt": dt, "blk": blk if dt == "int8" else 0,
+                       "ef": int(ef), "hier": int(hier)}
+    return apply
 
 
 register_op(
     "grad_reduce", default="f32",
     doc="ZeRO weight-update reduce-scatter of per-shard partial "
         "gradients over the data axis (cross-host this is DCN-bound: "
-        "the compressed variants trade gradient bits for wire bytes)")
-register(Variant("grad_reduce", "f32", _grad_reduce_f32,
+        "the compressed/hierarchical variants trade gradient bits and "
+        "exchange topology for DCN wire bytes — EQuARX, arxiv "
+        "2506.17615)")
+register(Variant("grad_reduce", "f32",
+                 grad_reduce_apply(_GR_NAMED["f32"]),
                  doc="exact: psum_scatter in the gradient dtype"))
-register(Variant("grad_reduce", "bf16", _grad_reduce_bf16,
+register(Variant("grad_reduce", "bf16",
+                 grad_reduce_apply(_GR_NAMED["bf16"]),
                  doc="wire dtype bf16 (bytes ÷2), accumulate + store "
                      "back in the gradient dtype; equivalence contract "
                      "at the trained-loss tolerance stated in "
                      "docs/SCALING.md"))
+register(Variant("grad_reduce", "int8_block",
+                 grad_reduce_apply(_GR_NAMED["int8_block"]),
+                 doc="EQuARX-style blockwise-scaled int8 exchange "
+                     "(blk=256): codes + per-block f32 scales ride one "
+                     "all_to_all, dequantize-accumulate in f32 — wire "
+                     "bytes ~0.26x the f32 scatter"))
+register(Variant("grad_reduce", "int8_ef",
+                 grad_reduce_apply(_GR_NAMED["int8_ef"]), stateful=True,
+                 doc="int8_block + error feedback: the quantization "
+                     "residual carries in the ZeRO flat-vector state "
+                     "(the step's 'ef' slot) and is added back before "
+                     "the next quantization, telescoping the "
+                     "compression error"))
+register(Variant("grad_reduce", "hier2",
+                 grad_reduce_apply(_GR_NAMED["hier2"]),
+                 doc="two-level (hosts x local) decomposition: "
+                     "ICI-local reduce-scatter, then the DCN exchange "
+                     "moves only the 1/n_local slices (DCN bytes "
+                     "÷n_local); exact f32 math, trajectory-equal to "
+                     "the flat scatter at rtol 1e-5"))
 
 
 # -- blocked flash attention (intra-chip tile loop) -------------------------

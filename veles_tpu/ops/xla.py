@@ -96,18 +96,29 @@ def all2all_softmax_forward(x, w, b):
 
 def conv2d_forward(x, w, b, stride: Tuple[int, int] = (1, 1),
                    padding: Tuple[int, int] = (0, 0),
-                   activation: str = "linear", s2d: bool = False):
+                   activation: str = "linear", s2d: bool = False,
+                   acc: str = "native"):
+    """acc="f32" pins the conv accumulator to f32
+    (preferred_element_type) — a real axis only under a sub-f32 compute
+    dtype, where it trades MXU-native accumulation for exactness; the
+    "native" default keeps XLA's dtype-following rule (today's
+    behavior). A generated conv_stem template axis (ops.templates)."""
     ph, pw = padding
+    pet = jnp.float32 if acc == "f32" else None
     if s2d and stride[0] == stride[1] and stride[0] > 1:
-        y = conv2d_space_to_depth(x, w, stride[0], (ph, pw))
+        y = conv2d_space_to_depth(x, w, stride[0], (ph, pw), acc=acc)
     else:
         y = lax.conv_general_dilated(
             x, w, window_strides=stride, padding=[(ph, ph), (pw, pw)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=pet)
+    if pet is not None:
+        y = y.astype(x.dtype)
     return act_forward(activation, y + b)
 
 
-def conv2d_space_to_depth(x, w, b_: int, padding: Tuple[int, int]):
+def conv2d_space_to_depth(x, w, b_: int, padding: Tuple[int, int],
+                          acc: str = "native"):
     """EXACT rewrite of a stride-b conv as a stride-1 conv on a
     space-to-depth-packed input — the classic TPU entry-conv trick for
     thin-channel inputs (AlexNet/ResNet stems: cin=3 fills 3/128 of an
@@ -145,9 +156,11 @@ def conv2d_space_to_depth(x, w, b_: int, padding: Tuple[int, int]):
     ws = w.reshape(kh2 // b_, b_, kw2 // b_, b_, c, co)
     ws = ws.transpose(0, 2, 1, 3, 4, 5).reshape(kh2 // b_, kw2 // b_,
                                                 b_ * b_ * c, co)
-    return lax.conv_general_dilated(
+    y = lax.conv_general_dilated(
         xs, ws, window_strides=(1, 1), padding="VALID",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=(jnp.float32 if acc == "f32" else None))
+    return y.astype(x.dtype) if acc == "f32" else y
 
 
 def deconv2d_forward(x, w, stride: Tuple[int, int] = (1, 1),
@@ -296,7 +309,8 @@ def maxpool_forward_with_idx(x, ksize: Tuple[int, int],
 
 
 def maxpool_forward_slices(x, ksize: Tuple[int, int],
-                           stride: Tuple[int, int], use_abs: bool = False):
+                           stride: Tuple[int, int], use_abs: bool = False,
+                           fold: str = "linear"):
     """Max pooling as a max-fold over the ky·kx SHIFTED STRIDED SLICES of
     the (−inf-padded) input — numerically identical to the reduce_window
     flavor, but reverse-mode differentiates into selects + zero-pads
@@ -307,7 +321,14 @@ def maxpool_forward_slices(x, ksize: Tuple[int, int],
     the fill never wins a window: −inf for plain max; 0 for the abs
     flavor (|−inf| = +inf would win every edge window; |0| only ties an
     all-zero window, where keeping 0 is correct — same fill
-    maxpool_forward uses)."""
+    maxpool_forward uses).
+
+    `fold` shapes the combine DAG — a generated maxpool template axis
+    (ops.templates): "linear" folds slices left-to-right (a ky·kx-deep
+    select chain in the backward), "tree" reduces them pairwise (a
+    log-depth balanced select tree; same values — on the measure-zero
+    abs-tie case the two may keep a different sign, exactly like any
+    reduction-order change)."""
     ky, kx = ksize
     sy, sx = stride
     n, h, w, c = x.shape
@@ -315,19 +336,27 @@ def maxpool_forward_slices(x, ksize: Tuple[int, int],
     dt = np.dtype(x.dtype)
     fill = (np.zeros((), dt) if use_abs else np.asarray(-np.inf, dt))[()]
     xp = lax.pad(x, fill, [(0, 0, 0), (0, eh, 0), (0, ew, 0), (0, 0, 0)])
-    out = None
-    for dy in range(ky):
-        for dx in range(kx):
-            s = lax.slice(xp, (0, dy, dx, 0),
-                          (n, dy + (oh - 1) * sy + 1,
-                           dx + (ow - 1) * sx + 1, c),
-                          (1, sy, sx, 1))
-            if out is None:
-                out = s
-            elif use_abs:
-                out = jnp.where(jnp.abs(out) >= jnp.abs(s), out, s)
-            else:
-                out = jnp.maximum(out, s)
+
+    def comb(a, b):
+        if use_abs:
+            return jnp.where(jnp.abs(a) >= jnp.abs(b), a, b)
+        return jnp.maximum(a, b)
+
+    slices = [
+        lax.slice(xp, (0, dy, dx, 0),
+                  (n, dy + (oh - 1) * sy + 1,
+                   dx + (ow - 1) * sx + 1, c),
+                  (1, sy, sx, 1))
+        for dy in range(ky) for dx in range(kx)]
+    if fold == "tree":
+        while len(slices) > 1:
+            slices = [comb(slices[i], slices[i + 1])
+                      if i + 1 < len(slices) else slices[i]
+                      for i in range(0, len(slices), 2)]
+        return slices[0]
+    out = slices[0]
+    for s in slices[1:]:
+        out = comb(out, s)
     return out
 
 

@@ -126,9 +126,21 @@ def _abstract_state(step, key_impl: str) -> Dict[str, Any]:
         for p, c, pl in zip(params, cfgs, plans))
     key_shape = jax.eval_shape(
         lambda: jax.random.key_data(jax.random.key(0, impl=key_impl)))
-    return {"params": params, "vel": vel,
-            "key": jax.ShapeDtypeStruct(key_shape.shape, key_shape.dtype),
-            "lr_scale": jax.ShapeDtypeStruct((), jnp.float32)}
+    out = {"params": params, "vel": vel,
+           "key": jax.ShapeDtypeStruct(key_shape.shape, key_shape.dtype),
+           "lr_scale": jax.ShapeDtypeStruct((), jnp.float32)}
+    if getattr(step, "ef_active", lambda: False)():
+        # stateful (int8+EF) grad_reduce: the error-feedback residual
+        # slot rides the checkpoint so a same-geometry resume carries
+        # the compensation state; a geometry change DROPS it (see
+        # _vel_reshard_restore — never mis-sharded)
+        from veles_tpu.parallel.mesh import DATA_AXIS
+        n = step.mesh.shape[DATA_AXIS]
+        out["ef"] = tuple(
+            {k: jax.ShapeDtypeStruct((n * rl,), jnp.float32)
+             for k, rl in lens.items()}
+            for lens in step.ef_lens())
+    return out
 
 
 def restore_state(step, directory: str) -> Dict[str, Any]:
@@ -272,7 +284,15 @@ def _vel_reshard_restore(ckptr, path: str, step, template, key_impl: str):
     except Exception:  # noqa: BLE001 — unreadable: not this class
         return None
     want = _leaf_index(template)
-    if set(saved) != set(want):
+    # the error-feedback slot ("ef/...", stateful grad_reduce variants)
+    # is a compensation accumulator, not trajectory state: across ANY
+    # geometry/variant mismatch it is DROPPED (target leaves reset to
+    # zeros, saved leaves ignored) rather than resharded — a residual
+    # sliced under the wrong (hosts x local) factorization would
+    # compensate the wrong elements forever. It never gates the reshard.
+    saved_ef = {k for k in saved if k.startswith("ef/")}
+    want_ef = {k for k in want if k.startswith("ef/")}
+    if set(saved) - saved_ef != set(want) - want_ef:
         return None
     orig = _orig_vel_shapes(step)
 
@@ -282,7 +302,7 @@ def _vel_reshard_restore(ckptr, path: str, step, template, key_impl: str):
             len(shape) == 1 and int(shape[0]) >= size)
 
     differing = []
-    for k in saved:
+    for k in set(saved) - saved_ef:
         if _describe(saved[k]) == _describe(want[k]):
             continue
         base = orig.get(k)
@@ -293,7 +313,9 @@ def _vel_reshard_restore(ckptr, path: str, step, template, key_impl: str):
                 or not legal(tuple(want[k].shape or ()), base):
             return None
         differing.append(k)
-    if not differing:
+    ef_differs = saved_ef != want_ef or any(
+        _describe(saved[k]) != _describe(want[k]) for k in saved_ef)
+    if not differing and not ef_differs:
         return None     # trees agree: not a geometry problem at all
 
     # restore into the SAVED geometry as HOST numpy (PyTree restore,
@@ -306,17 +328,32 @@ def _vel_reshard_restore(ckptr, path: str, step, template, key_impl: str):
     # whole tree is host-addressable by construction).
     import jax.tree_util as jtu
     import orbax.checkpoint as ocp
+    base_template = {k: v for k, v in template.items() if k != "ef"}
     saved_target = jtu.tree_map_with_path(
         lambda p_, leaf: jax.ShapeDtypeStruct(
             tuple(saved[_keystr(p_)].shape or ()),
             saved[_keystr(p_)].dtype),
-        template)
+        base_template)
+    if saved_ef:
+        # the restore item must mirror the ON-DISK structure: rebuild
+        # the saved ef subtree (tuple-of-dicts, like vel) from its leaf
+        # keypaths; the restored residuals are dropped below
+        layers: Dict[int, Dict[str, Any]] = {}
+        for k in saved_ef:
+            _, idx, leafname = k.split("/", 2)
+            layers.setdefault(int(idx), {})[leafname] = \
+                jax.ShapeDtypeStruct(tuple(saved[k].shape or ()),
+                                     saved[k].dtype)
+        saved_target["ef"] = tuple(
+            layers.get(i, {}) for i in range(len(step.forwards)))
     restore_args = jtu.tree_map(
         lambda _: ocp.RestoreArgs(restore_type=np.ndarray), saved_target)
     state = _host_checkpointer().restore(path, item=saved_target,
                                          restore_args=restore_args)
+    state.pop("ef", None)   # residuals from another geometry: dropped
 
     shardings = _target_shardings(step, template)
+    base_shardings = {k: v for k, v in shardings.items() if k != "ef"}
 
     def convert(path_, leaf, tmpl, sh):
         k = _keystr(path_)
@@ -333,7 +370,16 @@ def _vel_reshard_restore(ckptr, path: str, step, template, key_impl: str):
             leaf = out
         return jax.device_put(leaf, sh)
 
-    state = jtu.tree_map_with_path(convert, state, template, shardings)
+    state = jtu.tree_map_with_path(convert, state, base_template,
+                                   base_shardings)
+    if "ef" in template:
+        # the step wants an EF slot: fresh zeros under its OWN plan —
+        # dropping the residual costs one uncompensated step, never a
+        # mis-sharded compensation
+        state["ef"] = jtu.tree_map(
+            lambda t, sh: jax.device_put(
+                np.zeros(t.shape, t.dtype), sh),
+            template["ef"], shardings["ef"])
     state["key"] = jax.random.wrap_key_data(state["key"], impl=key_impl)
     return state
 

@@ -240,6 +240,11 @@ class FusedTrainStep:
         self.zero_active, self.zero_reason = \
             self._resolve_zero(zero_sharding)
         self._zero_plan_cache = None
+        #: the grad_reduce variant this step traces, resolved ONCE (see
+        #: _grad_reduce_variant — the EF state slot's geometry depends
+        #: on it, so a mid-life registry re-selection must not split
+        #: the state layout from the traced collective)
+        self._gr_cache = None
         self.donate = donate
         self._train_fn = None
         self._eval_fn = None
@@ -294,6 +299,65 @@ class FusedTrainStep:
                           n)
                 for u in self.forwards)
         return self._zero_plan_cache
+
+    def _grad_reduce_variant(self):
+        """The grad_reduce registry variant this step traces — ONE
+        resolution, cached on first read (the _sgd_variant precedent,
+        hardened): the error-feedback state slot (init_state, specs,
+        checkpoint geometry), the traced collective
+        (_apply_update_zero), variant_table and the byte accounting all
+        read the SAME verdict, so a registry re-selection between state
+        construction and trace can never mis-size the state."""
+        if self._gr_cache is None:
+            from veles_tpu.ops import variants
+            self._gr_cache = variants.resolve("grad_reduce")
+        return self._gr_cache
+
+    def ef_active(self) -> bool:
+        """True when the update carries the error-feedback residual
+        slot: ZeRO active, the registry scatter actually traces (not
+        the vma-era slice-after-psum degeneration), and the selected
+        grad_reduce variant is stateful (int8 + EF)."""
+        from veles_tpu import _compat
+        return (self.zero_active and not _compat.GRAD_TRANSPOSE_PSUM
+                and self._grad_reduce_variant().stateful)
+
+    def ef_lens(self):
+        """Per-layer {param: per-shard residual length} — the optional
+        EF slot of the update-sharding plan (mesh.zero_ef_plan), sized
+        by the selected variant's rule. Call only when ef_active()."""
+        from veles_tpu.ops import variants
+        from veles_tpu.parallel.mesh import zero_ef_plan
+        name = self._grad_reduce_variant().name
+        n = self.mesh.shape[DATA_AXIS]
+        return tuple(
+            zero_ef_plan(plan,
+                         lambda padded: variants.grad_reduce_resid_len(
+                             name, padded, n))
+            for plan in self.zero_plans())
+
+    def collective_accounting(self) -> Optional[Dict[str, Any]]:
+        """Modeled per-device collective egress bytes per TRAIN step
+        for the ZeRO grad_reduce exchange (+ the param all-gather leg),
+        under the selected variant and link geometry — the producer
+        behind the veles_collective_bytes_total counter family (the
+        driver increments once per dispatched step;
+        docs/OBSERVABILITY.md). None when no registry collective traces
+        (zero inactive, or the vma-era slice-after-psum path) — a
+        counter fed here can never fabricate provenance, same rule as
+        variant_table."""
+        from veles_tpu import _compat
+        if not self.zero_active or _compat.GRAD_TRANSPOSE_PSUM:
+            return None
+        from veles_tpu.ops import variants
+        v = self._grad_reduce_variant()
+        n = self.mesh.shape[DATA_AXIS]
+        elems = sum(lp.padded for plan in self.zero_plans()
+                    for lp in plan.values())
+        acct = variants.grad_reduce_bytes(v.name, elems, n)
+        acct.update(op="grad_reduce", variant=v.name, elements=elems,
+                    n_shards=n)
+        return acct
 
     def optimizer_state_bytes(self, state) -> Dict[int, int]:
         """{device_id: bytes} the optimizer-state pytree (state["vel"])
@@ -365,6 +429,16 @@ class FusedTrainStep:
         state = {"params": params, "vel": vel,
                  "key": prng.get().next_key(),
                  "lr_scale": jnp.float32(1.0)}
+        if self.ef_active():
+            # error-feedback residuals (stateful grad_reduce variants):
+            # one flat per-shard vector per param leaf, zero at start,
+            # sharded over the data axis like the rest of the ZeRO
+            # state (global length = n_shards x per-shard length)
+            n = self.mesh.shape[DATA_AXIS]
+            state["ef"] = tuple(
+                {k: put_flat(np.zeros(n * rl, np.float32))
+                 for k, rl in lens.items()}
+                for lens in self.ef_lens())
         if self.mode == "gspmd":
             state = self._shard_state(state)
         return state
@@ -744,7 +818,9 @@ class FusedTrainStep:
         """ZeRO weight-update sharding (arxiv 2004.13336), traced inside
         the dp shard_map body: per param leaf, reduce-SCATTER the
         per-shard partial gradient (registry op "grad_reduce" — the
-        quantized EQuARX variants slot in there), apply the SAME
+        quantized/hierarchical EQuARX variants slot in there; stateful
+        int8+EF variants thread the state's "ef" residual slot through
+        the exchange and return it updated), apply the SAME
         per-leaf optimizer rule to this shard's 1/N slice of params over
         its slice-only momentum/Adam state, and all-gather the fresh
         param slices for the next forward. Same wire bytes as the psum
@@ -758,16 +834,24 @@ class FusedTrainStep:
         autodiff's psum with a real psum_scatter is the jax-upgrade
         follow-on (ROADMAP)."""
         from veles_tpu import _compat
-        from veles_tpu.ops import variants
-        reduce = variants.resolve("grad_reduce").apply
+        gr = self._grad_reduce_variant()
+        reduce = gr.apply
+        # error-feedback residual slot (stateful variants): present in
+        # the state exactly when ef_active() held at init (one rule);
+        # threaded leaf-by-leaf through the reduce and returned updated
+        ef_state = state.get("ef") if self.ef_active() else None
+        new_ef: List[Any] = []
         idx = lax.axis_index(DATA_AXIS)
         new_params, new_vel = [], []
-        for p, g, v, cfg, plan in zip(state["params"], grads,
-                                      state["vel"], self.cfgs,
-                                      self.zero_plans()):
+        for li, (p, g, v, cfg, plan) in enumerate(
+                zip(state["params"], grads, state["vel"], self.cfgs,
+                    self.zero_plans())):
+            ef_layer = ef_state[li] if ef_state is not None else None
+            nef: Dict[str, Any] = {}
             if not p:
                 new_params.append(p)
                 new_vel.append(v)
+                new_ef.append(ef_layer if ef_layer is not None else {})
                 continue
             adam = isinstance(cfg, optim.AdamConfig)
             if adam:
@@ -783,6 +867,9 @@ class FusedTrainStep:
                 if _compat.GRAD_TRANSPOSE_PSUM:
                     g_loc = lax.dynamic_slice(
                         flat_g, (idx * lp.local,), (lp.local,))
+                elif ef_layer is not None:
+                    g_loc, nef[k] = reduce(flat_g, DATA_AXIS,
+                                           ef_layer[k])
                 else:
                     g_loc = reduce(flat_g, DATA_AXIS)
                 p_loc = lax.dynamic_slice(
@@ -805,9 +892,13 @@ class FusedTrainStep:
                 np_[k] = zero_unflatten(full, lp)
             new_params.append(np_)
             new_vel.append(nv)
+            new_ef.append(nef)
         new_key = jax.random.fold_in(state["key"], 1)
-        return {"params": tuple(new_params), "vel": tuple(new_vel),
-                "key": new_key, "lr_scale": state["lr_scale"]}
+        out = {"params": tuple(new_params), "vel": tuple(new_vel),
+               "key": new_key, "lr_scale": state["lr_scale"]}
+        if ef_state is not None:
+            out["ef"] = tuple(new_ef)
+        return out
 
     def _accum_body(self, state, xs, ys, ws, *, axis):
         """Gradient accumulation: grads of the FULL (K·m)-sample batch
@@ -934,7 +1025,14 @@ class FusedTrainStep:
         psp = self._smap_param_specs()
         vsp = (self._zero_vel_specs() if self.zero_active
                else self._vel_specs(psp, P()))
-        return {"params": psp, "vel": vsp, "key": P(), "lr_scale": P()}
+        spec = {"params": psp, "vel": vsp, "key": P(), "lr_scale": P()}
+        if self.ef_active():
+            # the EF residual slot mirrors the flat optimizer-state
+            # layout: every leaf a (per-shard-length,) slice of a
+            # data-axis-sharded vector
+            spec["ef"] = tuple({k: P(DATA_AXIS) for k in u.param_arrays()}
+                               for u in self.forwards)
+        return spec
 
     # -- compilation ---------------------------------------------------------
 
@@ -1210,8 +1308,10 @@ class FusedTrainStep:
             # grad_reduce variant moved the gradient bytes. On vma-era
             # jax the traced path slices autodiff's own all-reduce
             # instead (see _apply_update_zero) — no registry op runs,
-            # so reporting one would fabricate provenance.
-            table["grad_reduce"] = variants.resolve("grad_reduce").name
+            # so reporting one would fabricate provenance. Read through
+            # the step's cached resolution so reported == traced even
+            # across a registry re-selection.
+            table["grad_reduce"] = self._grad_reduce_variant().name
         if not self.zero_active and any(
                 isinstance(c, optim.SGDConfig) for c in self.cfgs):
             # the replicated SGD leg resolves through the registry (see

@@ -101,6 +101,22 @@ def zero_unflatten(flat, leaf: ZeroLeaf):
     return flat[:leaf.size].reshape(leaf.shape)
 
 
+def zero_ef_plan(plan: Any, resid_len) -> Any:
+    """The OPTIONAL error-feedback slot of the update-sharding plan
+    (ISSUE 12 / EQuARX, arxiv 2506.17615): map every ZeroLeaf of a
+    `zero_plan` tree to the PER-SHARD residual length a stateful
+    `grad_reduce` variant carries for it. `resid_len` is the variant's
+    rule (ops.variants.grad_reduce_resid_len bound to the variant name
+    and data-axis size): the flat int8+EF exchange carries the whole
+    (padded,) partial, the hierarchical one only the 1/n_local DCN-leg
+    slice. The fused step allocates, specs, audits and checkpoints the
+    slot from THIS mapping alone — the state geometry can never drift
+    from the plan."""
+    return jax.tree_util.tree_map(
+        lambda lp: resid_len(lp.padded), plan,
+        is_leaf=lambda x: isinstance(x, ZeroLeaf))
+
+
 def mesh_shape(n_devices: int, model: int = 1, seq: int = 1,
                data: Optional[int] = None) -> Dict[str, int]:
     """Resolve an axis-size dict; `data` defaults to whatever is left."""

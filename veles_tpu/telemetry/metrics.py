@@ -350,6 +350,18 @@ def register_standard(reg: MetricsRegistry) -> None:
                 "supervised restarts (supervisor or cluster)")
     reg.gauge("veles_generation",
               "supervision generation / attempt counter")
+    reg.counter("veles_collective_bytes_total",
+                "modeled per-device collective egress bytes by op and "
+                "link leg (dcn/ici) — the ZeRO grad_reduce exchange + "
+                "param all-gather, fed per dispatched train step from "
+                "FusedTrainStep.collective_accounting (byte model in "
+                "docs/SCALING.md)",
+                labelnames=("op", "leg"))
+    reg.counter("veles_collective_seconds_total",
+                "measured wall seconds inside timed collective windows "
+                "(tools/ablate.py --collectives harness; the driver "
+                "models bytes, never syncs for time)",
+                labelnames=("op",))
 
 
 _DEFAULT: Optional[MetricsRegistry] = None
@@ -388,6 +400,32 @@ def step_handles(reg: Optional[MetricsRegistry] = None) -> SimpleNamespace:
         loss=reg.gauge("veles_loss"),
         epoch=reg.gauge("veles_epoch"),
     )
+
+
+def collective_handles(acct: Optional[Dict[str, Any]],
+                       reg: Optional[MetricsRegistry] = None
+                       ) -> Optional[SimpleNamespace]:
+    """Pre-bound veles_collective_bytes_total children + per-step byte
+    amounts for one step's collective accounting dict
+    (FusedTrainStep.collective_accounting()) — bound ONCE outside the
+    driver loop, so the hot path pays four float adds and never a name
+    or label lookup (the hot-metric contract). None when the step
+    traces no registry collective."""
+    if not acct:
+        return None
+    reg = reg or default_registry()
+    fam = reg.counter("veles_collective_bytes_total",
+                      labelnames=("op", "leg"))
+    return SimpleNamespace(
+        dcn=fam.labels(op=acct["op"], leg="dcn"),
+        ici=fam.labels(op=acct["op"], leg="ici"),
+        ag_dcn=fam.labels(op="param_allgather", leg="dcn"),
+        ag_ici=fam.labels(op="param_allgather", leg="ici"),
+        dcn_bytes=float(acct.get("dcn_bytes", 0)),
+        ici_bytes=float(acct.get("ici_bytes", 0)),
+        ag_dcn_bytes=float(acct.get("allgather_dcn_bytes", 0)),
+        ag_ici_bytes=float(acct.get("allgather_ici_bytes", 0)),
+        mark=f"{acct['op']}:{acct.get('variant', '?')}")
 
 
 def mirror_feed(stats: Optional[Dict[str, Any]],

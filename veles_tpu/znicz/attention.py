@@ -135,6 +135,31 @@ class MultiHeadAttention(Forward):
             return "xla_mha"
         return self._flash_variant().name
 
+    def ring_params(self) -> Dict[str, Any]:
+        """Inner-hop tiling for the sequence-parallel RING path, taken
+        from the flash_attn registry winner (carried ROADMAP item: the
+        search results reach the ring hop, not just the local kernel):
+        the selected variant's (blk_k, kv_order) become the hop's
+        kv_block / block visit order. The hand-written "pallas"
+        incumbent maps to its template seed; the einsum golden
+        (xla_mha) carries no tiling preference — ring defaults apply
+        ({}); the pallas gate does NOT apply here (the ring consumes
+        the winner's TILE NUMBERS in plain XLA, not its kernel)."""
+        from veles_tpu.ops import templates
+        name = getattr(self, "variant_override", None) \
+            or variants.effective("flash_attn")
+        for t in templates.templates_for("flash_attn"):
+            if name == t.base:
+                cfg = dict(t.seed)
+            elif isinstance(name, str) and "[" in name:
+                cfg = t.parse(name)
+            else:
+                cfg = None
+            if cfg:
+                return {"kv_block": int(cfg["blk_k"]),
+                        "kv_order": str(cfg["kv_order"])}
+        return {}
+
     # -- pure forward ---------------------------------------------------------
 
     def tp_param_specs(self, model_axis: str, m: int):
@@ -169,7 +194,8 @@ class MultiHeadAttention(Forward):
             else:
                 o = oa.mha_forward(q, k, v, causal=self.causal)
         elif self.parallel_mode == "ring":
-            o = oa.ring_attention(q, k, v, axis_name, causal=self.causal)
+            o = oa.ring_attention(q, k, v, axis_name, causal=self.causal,
+                                  **self.ring_params())
         elif self.parallel_mode == "ulysses":
             o = oa.ulysses_attention(q, k, v, axis_name,
                                      causal=self.causal)

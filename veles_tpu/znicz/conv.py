@@ -74,9 +74,21 @@ class Conv(Forward):
             return False
         # "auto": the registry owns the decision for applicable stems
         # (default "s2d" — the r4 on-chip winner; tools/autotune.py can
-        # re-measure and flip it per device/shape)
-        return (self._s2d_applicable(cin)
-                and variants.resolve("conv_stem", unit=self).name == "s2d")
+        # re-measure and flip it per device/shape). A GENERATED winner
+        # (gen[pack=..,acc=..], ops.templates) carries its packing in
+        # the pack axis — the fused path consumes the full variant
+        # apply; this boolean serves the granular xla_init path.
+        if not self._s2d_applicable(cin):
+            return False
+        name = variants.resolve("conv_stem", unit=self).name
+        if name in ("s2d", "direct"):
+            return name == "s2d"
+        from veles_tpu.ops import templates
+        for t in templates.templates_for("conv_stem"):
+            cfg = t.parse(name)
+            if cfg is not None:
+                return cfg.get("pack") == "s2d"
+        return False
 
     def variant_effective(self):
         """The conv_stem lowering THIS layer actually traces, for
@@ -135,6 +147,14 @@ class Conv(Forward):
         return None
 
     def fused_apply(self, params, x, *, key=None, train=True):
+        if self.s2d == "auto" and self._s2d_applicable(x.shape[-1]):
+            # the registry owns auto-mode applicable stems END TO END:
+            # a generated winner's extra axes (the f32-accumulator
+            # pin) trace here, not just its packing bit. Hand-written
+            # names resolve to exactly the previous lowering.
+            v = variants.resolve("conv_stem", unit=self)
+            return v.apply(x, params["weights"], params["bias"],
+                           self.stride, self.padding, self.activation)
         return ox.conv2d_forward(x, params["weights"], params["bias"],
                                  self.stride, self.padding,
                                  self.activation,

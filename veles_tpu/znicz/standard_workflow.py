@@ -438,6 +438,8 @@ class StandardWorkflow(Workflow):
                 confusion=getattr(base, "confusion", None),
                 local_rows=getattr(base, "local_rows", None),
                 input_put_specs=getattr(base, "input_put_specs", None),
+                collective_accounting=getattr(
+                    base, "collective_accounting", None),
                 mesh=getattr(base, "mesh", None))
         import time as _time
 
@@ -457,6 +459,13 @@ class StandardWorkflow(Workflow):
         tr = _ttracer.active()
         prof = _ttracer.profile_controller()
         mh = _tmetrics.step_handles()
+        # per-collective byte attribution (ISSUE 12): the ZeRO
+        # grad_reduce exchange's modeled egress, pre-bound like every
+        # other hot-path instrument; None when the step traces no
+        # registry collective — the counters can't fabricate provenance
+        _acct_fn = getattr(step, "collective_accounting", None)
+        ch = _tmetrics.collective_handles(
+            _acct_fn() if _acct_fn is not None else None)
         state = step.init_state()
         loader, ev, dec = self.loader, self.evaluator, self.decision
         # the feed uploads (sharded, async) itself; the loader's granular-
@@ -528,6 +537,18 @@ class StandardWorkflow(Workflow):
                     if tr is not None:
                         tok = tr.begin("train.dispatch", "step")
                     state, (loss, n_err) = step.train(state, x, y, w)
+                    if ch is not None:
+                        # the exchange rides inside the step just
+                        # dispatched; count its modeled bytes now and
+                        # mark the step on the timeline (an instant:
+                        # its device duration is not host-observable
+                        # without a sync — docs/OBSERVABILITY.md)
+                        ch.dcn.inc(ch.dcn_bytes)
+                        ch.ici.inc(ch.ici_bytes)
+                        ch.ag_dcn.inc(ch.ag_dcn_bytes)
+                        ch.ag_ici.inc(ch.ag_ici_bytes)
+                        if tr is not None:
+                            tr.instant(ch.mark, "collective")
                     if tr is not None:
                         tr.end(tok)
                         step_tok = tr.begin("step", "step")
