@@ -296,6 +296,58 @@ def test_audit_flags_sharding_mismatch(fused_wf, eight_devices):
     assert all(f.severity == SEV_ERROR for f in findings)
 
 
+def test_audit_fused_pair_geometry_seeded_and_clean():
+    """ISSUE 13: the sharding-mismatch pass extends over the fused
+    pair's traced step. Clean: a selected lrn_maxpool winner claiming
+    an adjacent (norm, pool) pair audits with zero findings. Seeded: a
+    post-init reconfiguration of the claimed pass-through pooling unit
+    (its declared output Array no longer matches the fused kernel's
+    geometry) is flagged as a sharding-mismatch ERROR, and the audit
+    stops at the static verdict instead of crashing the trace on the
+    downstream shape clash."""
+    from veles_tpu.analysis.trace import audit_fused_step
+    from veles_tpu.ops import variants as va
+    prng.seed_all(7)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(12, 12, 3), n_validation=8,
+        n_train=16, minibatch_size=4, noise=0.5)
+    wf = StandardWorkflow(
+        layers=[{"type": "conv_strictrelu", "n_kernels": 8, "kx": 5,
+                 "ky": 5, "stride": (2, 2), "weights_stddev": 0.1},
+                {"type": "norm", "n": 5},
+                {"type": "max_pooling", "ksize": (2, 2)},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "weights_stddev": 0.1}],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 1, "fail_iterations": 9},
+        gd_config={"learning_rate": 0.1}, name="FusedAuditT")
+    wf.initialize(device=None, verify="off")
+    x = wf.loader.minibatch_data.mem
+    y = wf.loader.minibatch_labels.mem
+    prev = va.selected("lrn_maxpool")
+    try:
+        va.select("lrn_maxpool", "fused[rt=2,io=native,fuse=1]")
+        with va.pallas_interpret():
+            step = wf.build_fused_step()
+            assert step.fusion_pairs()          # the claim is live
+            assert audit_fused_step(step, x, y) == []
+            # seeded drift: ksize edited on the live unit after init —
+            # the declared output Array (built for (2, 2)) disagrees
+            # with what the fused kernel would now trace
+            pool = wf.forwards[2]
+            pool.ksize = (4, 4)
+            pool.stride = (4, 4)
+            findings = audit_fused_step(step, x, y)
+            assert rules(findings) == ["sharding-mismatch"]
+            assert all(f.severity == SEV_ERROR for f in findings)
+            assert any("fused pair" in f.message for f in findings)
+    finally:
+        if prev is None:
+            va.clear_selection("lrn_maxpool")
+        else:
+            va.select("lrn_maxpool", prev)
+
+
 def test_audit_nonfinite_guard_warning(fused_wf):
     step = fused_wf.build_fused_step()
     findings = audit(step, fused_wf, nonfinite_guard=False)

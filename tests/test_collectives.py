@@ -366,7 +366,7 @@ def test_ring_params_from_flash_winner():
     prev = variants.selected("flash_attn")
     try:
         variants.select("flash_attn",
-                        "pallas[blk_q=128,blk_k=256,kv_order=rev]")
+                        "pallas[blk_q=128,blk_k=256,kv_order=rev,drop=0]")
         assert u.ring_params() == {"kv_block": 256, "kv_order": "rev"}
         variants.select("flash_attn", "pallas")     # hand incumbent
         assert u.ring_params() == {"kv_block": 1024, "kv_order": "fwd"}
@@ -396,7 +396,7 @@ def test_ring_path_traces_selected_point(eight_devices, monkeypatch):
     prev = variants.selected("flash_attn")
     try:
         variants.select("flash_attn",
-                        "pallas[blk_q=128,blk_k=128,kv_order=rev]")
+                        "pallas[blk_q=128,blk_k=128,kv_order=rev,drop=0]")
         from veles_tpu.znicz.attention import MultiHeadAttention
         u = MultiHeadAttention.__new__(MultiHeadAttention)
         u.variant_override = None
@@ -424,7 +424,7 @@ def test_ring_path_traces_selected_point(eight_devices, monkeypatch):
         assert seen.get("kv_block") == 128
         assert seen.get("kv_order") == "rev"
         variants.select("flash_attn",
-                        "pallas[blk_q=128,blk_k=128,kv_order=fwd]")
+                        "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=0]")
         y_fwd = np.asarray(jax.jit(shard_map(
             body, mesh=mesh, in_specs=P(None, "seq", None),
             out_specs=P(None, "seq", None)))(x))
@@ -453,8 +453,8 @@ def test_templates_cover_whole_registry_but_dropout():
 @pytest.mark.parametrize("op,name", [
     ("maxpool", "gen[algo=slices,fold=tree]"),
     ("maxpool", "gen[algo=reduce_window,fold=linear]"),
-    ("conv_stem", "gen[pack=s2d,acc=f32]"),
-    ("conv_stem", "gen[pack=direct,acc=native]"),
+    ("conv_stem", "gen[pack=s2d,acc=f32,epi=none]"),
+    ("conv_stem", "gen[pack=direct,acc=native,epi=none]"),
 ])
 def test_new_template_points_pass_contracts(op, name):
     rec = templates.check_equivalence(op, name, force=True)
@@ -472,9 +472,9 @@ def test_conv_unit_consumes_generated_winner():
     u.stride = (4, 4)
     prev = variants.selected("conv_stem")
     try:
-        variants.select("conv_stem", "gen[pack=s2d,acc=f32]")
+        variants.select("conv_stem", "gen[pack=s2d,acc=f32,epi=none]")
         assert u._use_s2d(3) is True
-        variants.select("conv_stem", "gen[pack=direct,acc=native]")
+        variants.select("conv_stem", "gen[pack=direct,acc=native,epi=none]")
         assert u._use_s2d(3) is False
         variants.select("conv_stem", "s2d")
         assert u._use_s2d(3) is True

@@ -79,16 +79,20 @@ def test_template_spaces_cover_three_ops_with_eight_plus_candidates():
 
 def test_generated_name_round_trip_and_rejection():
     t = templates.templates_for("flash_attn")[0]
-    cfg = {"blk_q": 256, "blk_k": 512, "kv_order": "rev"}
+    cfg = {"blk_q": 256, "blk_k": 512, "kv_order": "rev", "drop": 0}
     name = t.name(cfg)
     assert t.parse(name) == cfg
     # out-of-space values, unknown axes, foreign bases: all rejected
-    assert t.parse("pallas[blk_q=999,blk_k=512,kv_order=rev]") is None
-    assert t.parse("pallas[blk_q=256,blk_k=512,kv_order=rev,x=1]") is None
-    assert t.parse("other[blk_q=256,blk_k=512,kv_order=rev]") is None
+    assert t.parse(
+        "pallas[blk_q=999,blk_k=512,kv_order=rev,drop=0]") is None
+    assert t.parse(
+        "pallas[blk_q=256,blk_k=512,kv_order=rev,drop=0,x=1]") is None
+    assert t.parse(
+        "other[blk_q=256,blk_k=512,kv_order=rev,drop=0]") is None
     assert t.parse("pallas[blk_q=256]") is None          # missing axes
     with pytest.raises(ValueError):
-        t.name({"blk_q": 999, "blk_k": 512, "kv_order": "rev"})
+        t.name({"blk_q": 999, "blk_k": 512, "kv_order": "rev",
+                "drop": 0})
 
 
 def test_materialize_from_name_alone():
@@ -109,14 +113,51 @@ def test_materialize_from_name_alone():
 @pytest.mark.parametrize("op,name", [
     ("lrn", "pallas[rt=64,io=f32]"),
     ("lrn", "pallas[rt=2048,io=native]"),
-    ("flash_attn", "pallas[blk_q=128,blk_k=256,kv_order=rev]"),
-    ("flash_attn", "pallas[blk_q=512,blk_k=1024,kv_order=fwd]"),
+    ("flash_attn", "pallas[blk_q=128,blk_k=256,kv_order=rev,drop=0]"),
+    ("flash_attn", "pallas[blk_q=512,blk_k=1024,kv_order=fwd,drop=0]"),
     ("sgd_update", "pallas_rows[rt=8]"),
     ("sgd_update", "pallas_rows[rt=1024]"),
 ])
 def test_generated_candidates_pass_reference_contract(op, name):
     rec = templates.check_equivalence(op, name, force=True)
     assert rec["status"] == "pass", rec
+
+
+@pytest.mark.parametrize("op,name", [
+    # the three FUSION families (ISSUE 13) — each fused point gated on
+    # its COMPOSED ops.reference golden, fwd+bwd, interpret on CPU
+    ("lrn_maxpool", "fused[rt=1,io=native,fuse=1]"),
+    ("lrn_maxpool", "fused[rt=2,io=f32,fuse=1]"),
+    ("lrn_maxpool", "fused[rt=4,io=native,fuse=0]"),   # composed point
+    ("conv_stem", "gen[pack=s2d,acc=native,epi=lrn]"),
+    ("conv_stem", "gen[pack=direct,acc=f32,epi=lrn]"),
+    ("flash_attn", "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=1]"),
+    ("flash_attn", "pallas[blk_q=256,blk_k=256,kv_order=rev,drop=1]"),
+])
+def test_fused_points_pass_composed_golden_contract(op, name):
+    rec = templates.check_equivalence(op, name, force=True)
+    assert rec["status"] == "pass", rec
+
+
+def test_fusion_structure_helpers():
+    """fusion_config is the one rule deciding whether a name CLAIMS a
+    neighbor: fuse-axis-on points only; composed/foreign names never."""
+    assert templates.fusion_members("lrn_maxpool") == ("lrn", "maxpool")
+    assert templates.fusion_members("lrn") == ()
+    assert templates.fusion_config(
+        "lrn_maxpool", "fused[rt=2,io=native,fuse=1]")["fuse"] == 1
+    assert templates.fusion_config(
+        "lrn_maxpool", "fused[rt=2,io=native,fuse=0]") is None
+    assert templates.fusion_config("lrn_maxpool", "composed") is None
+    assert templates.fusion_config(
+        "conv_stem", "gen[pack=s2d,acc=native,epi=lrn]") is not None
+    assert templates.fusion_config(
+        "conv_stem", "gen[pack=s2d,acc=native,epi=none]") is None
+    assert templates.fusion_config(
+        "flash_attn",
+        "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=1]") is not None
+    # the composed lrn_maxpool incumbent is a live registry entry
+    assert variants.has("lrn_maxpool", "composed")
 
 
 # ---------------------------------------------------------------------------
@@ -534,7 +575,7 @@ def test_attention_unit_traces_selected_flash_variant():
                                   use_flash="on", name="mha")
         unit.head_dim = e // 2
         variants.select("flash_attn",
-                        "pallas[blk_q=128,blk_k=128,kv_order=rev]")
+                        "pallas[blk_q=128,blk_k=128,kv_order=rev,drop=0]")
         got = np.asarray(unit._apply(params, x))
         gold = np.asarray(unit._apply(params, x, allow_flash=False))
         np.testing.assert_allclose(got, gold, rtol=5e-4, atol=5e-5)
@@ -574,6 +615,344 @@ def test_apply_cached_inherits_searched_winners(tmp_path, monkeypatch):
     assert applied["sgd_update"] == searched["sgd_update"]
     for op, name in applied.items():
         assert variants.effective(op) == name
+
+
+# ---------------------------------------------------------------------------
+# 5. searched cross-op fusion (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_ledger_bypass_raises_ungated_error(tmp_path,
+                                                   monkeypatch):
+    """The fusion families ride the SAME structural gate: a bypass that
+    never recorded a pass is refused for lrn_maxpool too."""
+    monkeypatch.setattr(templates, "check_equivalence",
+                        lambda op, name, force=False: {"status": "pass"})
+    templates.clear_ledger()
+    with pytest.raises(templates.UngatedCandidateError):
+        at.search_op("lrn_maxpool", budget=4,
+                     cache=at.AutotuneCache(str(tmp_path / "c.json")))
+
+
+def test_search_times_fused_candidate_per_family(tmp_path):
+    """The acceptance sweep: one budgeted search over the three fusion
+    families times >=1 FUSED candidate (fuse axis on) per family, every
+    timed fused point carrying a passing composed-golden ledger record —
+    the gate is the only path to a timing."""
+    templates.clear_ledger()
+    rep = at.search_workflow(
+        budget=30, ops=["lrn_maxpool", "conv_stem", "flash_attn"],
+        cache=at.AutotuneCache(str(tmp_path / "c.json")))
+    for op in ("lrn_maxpool", "conv_stem", "flash_attn"):
+        fused_timed = [
+            t for t in rep[op]["trace"]
+            if t["outcome"] == "timed"
+            and templates.fusion_config(op, t["variant"]) is not None]
+        assert fused_timed, (op, rep[op]["trace"])
+        for t in fused_timed:
+            assert templates.passed(op, t["variant"]), (op, t)
+
+
+def test_discover_fusions_finds_adjacent_pair():
+    wf = _tiny_workflow("FuseDiscT")
+    wf.initialize(device=None)
+    found = at.discover_fusions(wf)
+    assert set(found) == {"lrn_maxpool"}
+    (sig,) = found["lrn_maxpool"]
+    assert set(sig) == {"lrn", "maxpool"}
+    # a per-layer override on either member blocks the claim
+    wf.forwards[2].variant_override = "slices"
+    assert at.discover_fusions(wf) == {}
+    wf.forwards[2].variant_override = None
+    # ...as does the maxabs flavor
+    wf.forwards[2].use_abs = True
+    assert at.discover_fusions(wf) == {}
+
+
+def test_fused_winner_changes_step_trace_and_table():
+    """Selecting the fused lrn_maxpool winner makes the normalization
+    unit claim its pooling successor (fusion_pairs names the pair, the
+    pooling unit passes through), the trajectory matches the composed
+    path at rtol 1e-5, and variant_table reports the fused winner for
+    BOTH member ops — reported == traced."""
+    import jax
+
+    def run(sel):
+        variants.clear_selection()
+        if sel:
+            variants.select(*sel)
+        wf = _tiny_workflow(f"FuseT_{sel[1] if sel else 'composed'}")
+        wf.initialize(device=None)
+        with variants.pallas_interpret():
+            step = wf.build_fused_step()
+            state = step.init_state()
+            rs = np.random.RandomState(5)
+            x = rs.randn(4, 12, 12, 3).astype(np.float32)
+            y = rs.randint(0, 4, 4)
+            pairs = [(i, j, v.name) for i, j, v in step.fusion_pairs()]
+            table = step.variant_table()
+            for _ in range(3):
+                state, _ = step.train(state, x, y)
+            params = jax.tree_util.tree_map(np.asarray,
+                                            state["params"])
+        return params, pairs, table
+
+    p_ref, pairs_ref, tab_ref = run(None)
+    assert pairs_ref == []
+    assert "lrn_maxpool" not in tab_ref
+
+    name = "fused[rt=2,io=native,fuse=1]"
+    p_f, pairs_f, tab_f = run(("lrn_maxpool", name))
+    assert pairs_f == [(1, 2, name)]          # norm claims its pool
+    assert tab_f["lrn_maxpool"] == name
+    assert tab_f["lrn"] == f"lrn_maxpool/{name}"
+    assert tab_f["maxpool"] == f"lrn_maxpool/{name}"
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_f)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    # the conv-stem epilogue family: the conv claims the SAME norm unit
+    # (left-to-right precedence), trajectory still equal
+    cname = "gen[pack=s2d,acc=native,epi=lrn]"
+    p_c, pairs_c, tab_c = run(("conv_stem", cname))
+    assert pairs_c == [(0, 1, cname)]
+    assert tab_c["conv_stem"] == cname
+    assert tab_c["lrn"] == f"conv_stem/{cname}"
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_c)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_precedence_conv_epilogue_wins_the_shared_lrn():
+    """When BOTH a conv epilogue winner and a fused lrn_maxpool winner
+    want the same norm unit, pairs claim left-to-right: the conv takes
+    the norm, the pool stays unfused — a unit joins at most one pair."""
+    variants.clear_selection()
+    variants.select("conv_stem", "gen[pack=s2d,acc=native,epi=lrn]")
+    variants.select("lrn_maxpool", "fused[rt=2,io=native,fuse=1]")
+    wf = _tiny_workflow("FusePrecT")
+    wf.initialize(device=None)
+    with variants.pallas_interpret():
+        step = wf.build_fused_step()
+        pairs = [(i, j) for i, j, _ in step.fusion_pairs()]
+    assert pairs == [(0, 1)]
+
+
+def test_fusion_gates_block_claim():
+    """No claim under GSPMD (a pallas_call cannot be auto-partitioned),
+    under a member override, or for the maxabs flavor."""
+    variants.select("lrn_maxpool", "fused[rt=2,io=native,fuse=1]")
+    wf = _tiny_workflow("FuseGateT")
+    wf.initialize(device=None)
+    with variants.pallas_interpret():
+        step = wf.build_fused_step()
+        assert step.fusion_pairs()
+        # member override pins a member lowering: the pair is off
+        wf.forwards[2].variant_override = "reduce_window"
+        assert step.fusion_pairs() == []
+        wf.forwards[2].variant_override = None
+        assert step.fusion_pairs()
+    # outside the interpret context on CPU, resolve() falls back to the
+    # composed incumbent: no claim (same gate as every pallas variant)
+    assert step.fusion_pairs() == []
+
+
+def test_search_charges_fused_candidate_combined_share(tmp_path):
+    """priority_order gives the PURE fusion op the combined share of
+    its members (the profile attributes time per member op)."""
+    import json as _json
+    prof = tmp_path / "prof.json"
+    prof.write_text(_json.dumps(
+        {"ops": {"lrn": 0.2, "maxpool": 0.15, "conv_stem": 0.1}}))
+    ordered = dict(at.priority_order(
+        ["lrn", "maxpool", "lrn_maxpool", "conv_stem"], str(prof)))
+    assert ordered["lrn_maxpool"] == pytest.approx(0.35)
+    assert ordered["lrn"] == pytest.approx(0.2)
+    assert ordered["conv_stem"] == pytest.approx(0.1)
+
+
+def test_layer_profile_splits_fused_share_back_to_members():
+    """A fused kernel's time in a profile record is attributed back to
+    its member ops by the pre-fusion share ratio (equal split when the
+    members carry no shares of their own) — the search's priority order
+    stays meaningful after a fusion winner lands."""
+    lp = _load_layer_profile_module()
+    split = lp.split_fused_shares(
+        {"lrn_maxpool": 0.3, "lrn": 0.2, "maxpool": 0.1,
+         "conv_stem": 0.05})
+    assert "lrn_maxpool" not in split
+    assert split["lrn"] == pytest.approx(0.4)       # 0.2 + 0.3*(2/3)
+    assert split["maxpool"] == pytest.approx(0.2)   # 0.1 + 0.3*(1/3)
+    assert split["conv_stem"] == pytest.approx(0.05)
+    # no member shares: equal split
+    split2 = lp.split_fused_shares({"lrn_maxpool": 0.4})
+    assert split2["lrn"] == pytest.approx(0.2)
+    assert split2["maxpool"] == pytest.approx(0.2)
+    # no fused key: untouched
+    assert lp.split_fused_shares({"lrn": 0.1}) == {"lrn": 0.1}
+    # write_profile applies the split and keeps the raw form
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        rec = lp.write_profile(
+            [{"name": "u", "class": "U", "op": "lrn_maxpool",
+              "run_time_s": 0.4, "run_count": 1},
+             {"name": "v", "class": "V", "op": None,
+              "run_time_s": 0.6, "run_count": 1}],
+            os.path.join(td, "p.json"))
+    assert "lrn_maxpool" not in rec["ops"]
+    assert rec["ops"]["lrn"] == pytest.approx(0.2)
+    assert rec["ops_raw"]["lrn_maxpool"] == pytest.approx(0.4)
+
+
+def test_autotune_workflow_searches_fusion_in_graph(tmp_path):
+    """--autotune --autotune-budget: the workflow's adjacent (lrn,
+    maxpool) pair makes lrn_maxpool searchable IN-GRAPH, and
+    apply_cached re-applies a searched fused winner in a fresh process
+    with zero timing."""
+    templates.clear_ledger()
+    cache_path = str(tmp_path / "c.json")
+    wf = _tiny_workflow("FuseSearchT")
+    rep = at.autotune_workflow(wf, steps=1, repeats=1, batch=4,
+                               cache_path=cache_path, budget=40,
+                               ops=["lrn_maxpool"])
+    assert rep["lrn_maxpool"]["source"] == "searched"
+    assert rep["lrn_maxpool"]["timer"] == "in_graph"
+    fused_timed = [
+        t for t in rep["lrn_maxpool"]["trace"]
+        if t["outcome"] == "timed"
+        and templates.fusion_config("lrn_maxpool",
+                                    t["variant"]) is not None]
+    assert fused_timed
+    winner = rep["lrn_maxpool"]["variant"]
+    assert variants.effective("lrn_maxpool") == winner
+    # fresh process twin: apply_cached probes the fusion-pair key
+    variants.clear_selection()
+    wf2 = _tiny_workflow("FuseSearchT2")
+    applied = at.apply_cached(wf2, cache_path=cache_path)
+    assert applied.get("lrn_maxpool") == winner
+
+
+def test_member_search_suspends_fusion_claim(monkeypatch, tmp_path):
+    """While a MEMBER op (lrn) times in-graph, a selected fused
+    lrn_maxpool winner stands down — otherwise the claimed pair makes
+    every member candidate trace the same program and a noise-picked
+    'winner' persists under the member's cache key. Restored after."""
+    variants.select("lrn_maxpool", "fused[rt=2,io=native,fuse=1]")
+    seen = []
+
+    def spy_timer(wf, mesh, compute_dtype, steps, repeats, batch):
+        seen.append(variants.selected("lrn_maxpool"))
+        return 0.001
+
+    monkeypatch.setattr(at, "_time_variant", spy_timer)
+    templates.clear_ledger()
+    wf = _tiny_workflow("SuspendT")
+    at.search_workflow(wf, ops=["lrn"], budget=4,
+                       cache=at.AutotuneCache(str(tmp_path / "c.json")))
+    assert seen and all(s is None for s in seen)
+    assert variants.selected("lrn_maxpool") \
+        == "fused[rt=2,io=native,fuse=1]"
+
+
+def test_members_tune_before_their_fusion_op(tmp_path, monkeypatch):
+    """search_workflow orders MEMBER ops before the fusion op that
+    composes them (even when the combined share ranks the fusion op
+    first): the fusion decision competes against tuned members."""
+    import json as _json
+    prof = tmp_path / "prof.json"
+    prof.write_text(_json.dumps({"ops": {"lrn": 0.3, "maxpool": 0.2}}))
+    order = []
+    orig = at.search_op
+
+    def spy(op, **kw):
+        order.append(op)
+        return orig(op, **kw)
+
+    monkeypatch.setattr(at, "search_op", spy)
+    templates.clear_ledger()
+    at.search_workflow(budget=8, ops=["lrn_maxpool", "lrn", "maxpool"],
+                       profile_path=str(prof),
+                       cache=at.AutotuneCache(str(tmp_path / "c.json")))
+    assert order.index("lrn_maxpool") > order.index("lrn")
+    assert order.index("lrn_maxpool") > order.index("maxpool")
+
+
+def test_variant_table_keeps_unclaimed_sibling_entry():
+    """A chain with TWO (norm, pool) pairs where only the first is
+    claimable (the second pool carries a per-layer override): the
+    op-level maxpool entry must keep the still-composed sibling's
+    override name — the pair's claim reports through the lrn_maxpool
+    entry, never by clobbering a lowering another unit really traced."""
+    prng.seed_all(1)
+    loader = SyntheticClassifierLoader(
+        n_classes=4, sample_shape=(20, 20, 3), n_validation=8,
+        n_train=16, minibatch_size=4, noise=0.5)
+    wf = StandardWorkflow(
+        layers=[{"type": "conv_strictrelu", "n_kernels": 8, "kx": 5,
+                 "ky": 5, "stride": (2, 2), "s2d": "off",
+                 "weights_stddev": 0.1},
+                {"type": "norm", "n": 5},
+                {"type": "max_pooling", "ksize": (2, 2)},
+                {"type": "norm", "n": 5},
+                {"type": "max_pooling", "ksize": (2, 2),
+                 "lowering": "slices"},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "weights_stddev": 0.1}],
+        loader=loader, loss="softmax", n_classes=4,
+        decision_config={"max_epochs": 1, "fail_iterations": 9},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name="MixedPairT")
+    wf.initialize(device=None)
+    name = "fused[rt=2,io=native,fuse=1]"
+    variants.select("lrn_maxpool", name)
+    with variants.pallas_interpret():
+        step = wf.build_fused_step()
+        pairs = [(i, j) for i, j, _ in step.fusion_pairs()]
+        table = step.variant_table()
+    assert pairs == [(1, 2)]              # only the override-free pair
+    assert table["lrn_maxpool"] == name
+    # the claimed pair's member report fills in ONLY where no unclaimed
+    # unit traces: the second (overridden) pool keeps its own name, the
+    # second norm keeps the plain lrn resolution
+    assert table["maxpool"] == "slices"
+    assert "lrn_maxpool/" not in table["lrn"]
+
+
+def test_unclaimed_conv_stem_reports_epi_none_twin():
+    """An UNCLAIMED applicable auto stem under an epi=lrn conv_stem
+    winner traces the epilogue-less program (no epilogue is passed), so
+    variant_effective must report the epi=none twin — the conv-side
+    mirror of the attention drop=0-twin rule."""
+    wf = _tiny_workflow("ConvTwinT")
+    wf.initialize(device=None)
+    conv = wf.forwards[0]
+    variants.select("conv_stem", "gen[pack=s2d,acc=f32,epi=lrn]")
+    assert conv.variant_effective() == "gen[pack=s2d,acc=f32,epi=none]"
+    variants.select("conv_stem", "gen[pack=s2d,acc=f32,epi=none]")
+    assert conv.variant_effective() == "gen[pack=s2d,acc=f32,epi=none]"
+    variants.select("conv_stem", "s2d")
+    assert conv.variant_effective() == "s2d"
+
+
+def test_attention_reports_drop_zero_twin_of_fused_winner():
+    """The attention unit feeds no dropout mask, so a selected drop=1
+    flash winner traces the UNFUSED program — variant_effective must
+    name the drop=0 twin (reported == traced)."""
+    from veles_tpu.znicz.attention import MultiHeadAttention
+    unit = MultiHeadAttention(None, n_heads=2, causal=True,
+                              use_flash="on", name="mha_drop")
+    unit.input = type("A", (), {"shape": (1, 4096, 16)})()
+    with variants.pallas_interpret():
+        variants.select(
+            "flash_attn",
+            "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=1]")
+        assert unit.variant_effective() \
+            == "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=0]"
+        variants.select(
+            "flash_attn",
+            "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=0]")
+        assert unit.variant_effective() \
+            == "pallas[blk_q=128,blk_k=128,kv_order=fwd,drop=0]"
 
 
 def test_launcher_rejects_budget_without_autotune():

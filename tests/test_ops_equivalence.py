@@ -364,6 +364,62 @@ def test_conv_space_to_depth_exact():
                                    err_msg=str((xshape, wshape, s, pad)))
 
 
+def test_composed_golden_lrn_maxpool_is_bitwise_composition():
+    """The composed fusion goldens (ISSUE 13) must be EXACTLY the
+    sequential application of the member goldens — bitwise, numpy-only:
+    a fused kernel gated on the composed golden is then transitively
+    gated on every member's golden."""
+    x = rng.randn(2, 8, 8, 16).astype(np.float32)
+    k, alpha, beta, n = 2.0, 1e-3, 0.75, 5
+    ksize, stride = (3, 3), (2, 2)
+    y_lrn = ref.lrn_forward(x, k, alpha, beta, n)
+    y_seq, idx = ref.maxpool_forward(y_lrn, ksize, stride, False)
+    y_cmp = ref.lrn_maxpool_forward(x, k, alpha, beta, n, ksize, stride)
+    np.testing.assert_array_equal(y_cmp, y_seq)
+    g = rng.randn(*y_seq.shape).astype(np.float32)
+    dx_seq = ref.lrn_backward(
+        x, ref.maxpool_backward(g, idx, y_lrn.shape), k, alpha, beta, n)
+    dx_cmp = ref.lrn_maxpool_backward(x, g, k, alpha, beta, n, ksize,
+                                      stride)
+    np.testing.assert_array_equal(dx_cmp, dx_seq)
+
+
+def test_composed_golden_conv_lrn_is_bitwise_composition():
+    x = rng.randn(2, 19, 19, 3).astype(np.float32)
+    w = (rng.randn(5, 5, 3, 8) * 0.1).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    stride, padding, act = (4, 4), (0, 0), "strictrelu"
+    k, alpha, beta, n = 2.0, 1e-3, 0.75, 5
+    y_conv = ref.conv2d_forward(x, w, b, stride, padding, act)
+    y_seq = ref.lrn_forward(y_conv, k, alpha, beta, n)
+    y_cmp = ref.conv_lrn_forward(x, w, b, stride, padding, act,
+                                 k, alpha, beta, n)
+    np.testing.assert_array_equal(y_cmp, y_seq)
+    g = rng.randn(*y_seq.shape).astype(np.float32)
+    g_conv = ref.lrn_backward(y_conv, g, k, alpha, beta, n)
+    seq = ref.conv2d_backward(x, w, y_conv, g_conv, stride, padding, act)
+    cmp_ = ref.conv_lrn_backward(x, w, b, g, stride, padding, act,
+                                 k, alpha, beta, n)
+    for a, b_ in zip(cmp_, seq):
+        np.testing.assert_array_equal(a, b_)
+
+
+def test_composed_golden_attn_dropout_is_bitwise_composition():
+    q, k, v = (rng.randn(1, 16, 2, 4).astype(np.float32)
+               for _ in range(3))
+    mask = ref.make_dropout_mask(np.random.RandomState(3),
+                                 (1, 16, 2, 4), 0.4)
+    y_seq = ref.dropout_forward(
+        ref.mha_forward(q, k, v, causal=True), mask)
+    y_cmp = ref.attn_dropout_forward(q, k, v, mask, causal=True)
+    np.testing.assert_array_equal(y_cmp, y_seq)
+    # the backward leg of the composition IS the member golden: dropout
+    # backward routes the pooled error through the same mask
+    g = rng.randn(1, 16, 2, 4).astype(np.float32)
+    np.testing.assert_array_equal(ref.dropout_backward(g, mask),
+                                  g * mask)
+
+
 def test_finite_difference_gradcheck_composite_stack():
     """Independent-of-autodiff validation: central finite differences on
     a conv+LRN+pool+FC+softmax-CE stack match jax.grad to float64

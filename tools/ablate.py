@@ -4,6 +4,8 @@ Usage (on a machine with the TPU visible):
     python tools/ablate.py full no-LRN no-dropout no-bigFC
     python tools/ablate.py --zero          # ZeRO update A/B (needs >=2 devices)
     python tools/ablate.py --collectives   # grad_reduce variant A/B (ISSUE 12)
+    python tools/ablate.py --fusion        # fused vs composed lrn+maxpool A/B
+                                           # (ISSUE 13; CPU mesh via interpret)
 
 Each variant builds the AlexNet fused train step with a layer family
 removed and reports samples/s via train_repeat — the deltas attribute
@@ -429,6 +431,155 @@ def measure_collectives_ab() -> dict:
     return record
 
 
+def measure_fusion_ab() -> dict:
+    """A/B the searched cross-op fusion (ISSUE 13): the SAME dp-mode
+    AlexNet step with the composed (lrn, maxpool) pair vs the fused
+    `lrn_maxpool` Pallas point claiming it, on a mesh over every local
+    device (the 8-device CPU mesh runs the kernel in interpret mode —
+    wall-clock there is a functional proxy, the real number is the
+    on-chip twin queued in tools/tpu_watch_r8.sh). Reports per arm:
+    samples/s (train_repeat windows, the layer-ablation protocol) and
+    the step's variant_table (the fused arm must NAME the fused winner
+    for both member ops — reported == traced); plus the PRE-FUSION
+    per-op shares from a short granular profile (tools/layer_profile.py
+    — the ratio the search splits a fused kernel's time back by).
+    Record lands in FUSION_AB_RECORD.json (env VELES_FUSION_AB_PATH);
+    CPU smoke knobs FUSION_AB_BATCH/WIDTH/POINT (the ZERO_AB
+    precedent)."""
+    import importlib.util
+    import json
+
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.ops import variants
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.samples.alexnet import alexnet_layers
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    devs = jax.devices()
+    mesh = make_mesh(devs) if len(devs) > 1 else None
+    n_data = len(devs) if mesh is not None else 1
+    batch = int(os.environ.get("FUSION_AB_BATCH", str(BATCH)))
+    width = float(os.environ.get("FUSION_AB_WIDTH", "1.0"))
+    point = os.environ.get("FUSION_AB_POINT",
+                           "fused[rt=2,io=native,fuse=1]")
+    steps = int(os.environ.get("FUSION_AB_STEPS", str(K)))
+    if batch % max(n_data, 1):
+        raise SystemExit(f"--fusion: batch {batch} not divisible by "
+                         f"the {n_data}-device data axis")
+    on_cpu = jax.default_backend() == "cpu"
+    record = {"metric": "cross_op_fusion_ab", "n_devices": n_data,
+              "device_kind": devs[0].device_kind, "batch": batch,
+              "width": width, "steps_per_window": steps,
+              "fused_point": point,
+              "pallas": "interpret" if on_cpu else "compiled",
+              "arms": {}}
+
+    def build(name):
+        prng.seed_all(1)
+        loader = SyntheticClassifierLoader(
+            n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
+            n_train=128, minibatch_size=batch, noise=0.5)
+        return StandardWorkflow(
+            layers=list(alexnet_layers(64, width,
+                                       int(4096 * width) or 64)),
+            loader=loader, loss="softmax", n_classes=64,
+            decision_config={"max_epochs": 1, "fail_iterations": 9},
+            gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
+            name=name)
+
+    # pre-fusion per-op shares: the granular graph (which never fuses)
+    # attributes time per MEMBER op — the ratio layer_profile's
+    # split_fused_shares uses and the search's combined-share input
+    spec = importlib.util.spec_from_file_location(
+        "layer_profile", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "layer_profile.py"))
+    lp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lp)
+    wf_prof = build("FusionAB-profile")
+    wf_prof.initialize(device=None)
+    record["pre_fusion_shares"] = lp.op_shares(
+        lp.profile_workflow(wf_prof, steps=2))
+
+    prev = variants.selected("lrn_maxpool")
+    import contextlib
+    ctx = variants.pallas_interpret() if on_cpu \
+        else contextlib.nullcontext()
+    try:
+        with ctx:
+            for name, sel in (("composed", "composed"),
+                              ("fused", point)):
+                variants.select("lrn_maxpool", sel)
+                wf = build(f"FusionAB-{name}")
+                wf.initialize(device=None)
+                step = wf.build_fused_step(
+                    mesh=mesh, mode="dp" if mesh is not None else "auto",
+                    compute_dtype="bfloat16")
+                state = step.init_state()
+                rng = np.random.RandomState(0)
+                x = rng.randn(batch, 227, 227, 3).astype(np.float32)
+                y = rng.randint(0, 64, batch)
+                if mesh is not None:
+                    xs, ys_, _ = step.input_put_specs()
+                    import jax.sharding as jsh
+                    x = jax.device_put(x, jsh.NamedSharding(mesh, xs))
+                    y = jax.device_put(y, jsh.NamedSharding(mesh, ys_))
+                else:
+                    # one-time pre-stage per arm BY DESIGN (cf.
+                    # measure()): the timed windows must not pay H2D
+                    # velint: disable=sync-feed
+                    x, y = jax.device_put(x), jax.device_put(y)
+                state, _ = step.train_repeat(state, x, y, steps)
+                # post-warm sync barrier BY DESIGN (cf. measure())
+                # velint: disable=sync-feed
+                np.asarray(state["params"][-1]["bias"][:1])
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    state, _ = step.train_repeat(state, x, y, steps)
+                    # measurement barrier BY DESIGN (cf. measure())
+                    # velint: disable=sync-feed
+                    np.asarray(state["params"][-1]["bias"][:1])
+                    best = min(best, time.perf_counter() - t0)
+                arm = {
+                    "samples_per_sec": round(batch * steps / best, 1),
+                    "fusion_pairs": len(step.fusion_pairs()),
+                    "variants": step.variant_table(),
+                }
+                record["arms"][name] = arm
+                print(f"ABLATE fusion[{name}]: "
+                      f"{arm['samples_per_sec']:.0f} samples/s, "
+                      f"{arm['fusion_pairs']} fused pair(s)",
+                      flush=True)
+                del state
+    finally:
+        if prev is None:
+            variants.clear_selection("lrn_maxpool")
+        else:
+            variants.select("lrn_maxpool", prev)
+    comp = record["arms"]["composed"]
+    fus = record["arms"]["fused"]
+    record["deltas"] = {
+        "step_time_ratio": round(
+            comp["samples_per_sec"]
+            / max(fus["samples_per_sec"], 1e-9), 4),
+        "speedup": round(
+            fus["samples_per_sec"]
+            / max(comp["samples_per_sec"], 1e-9), 4),
+    }
+    path = os.environ.get("VELES_FUSION_AB_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "FUSION_AB_RECORD.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"ABLATE fusion: fused/composed speedup "
+          f"{record['deltas']['speedup']:.3f} "
+          f"({record['pallas']} pallas) -> {path}", flush=True)
+    return record
+
+
 def _time_isolated_reduce(step, mesh, repeats: int = 3) -> float:
     """Seconds per call of JUST the selected grad_reduce exchange over
     the step's total flat gradient size (one concatenated vector) —
@@ -471,6 +622,11 @@ def _time_isolated_reduce(step, mesh, repeats: int = 3) -> float:
 
 if __name__ == "__main__":
     args = sys.argv[1:]
+    if "--fusion" in args:
+        measure_fusion_ab()
+        args = [a for a in args if a != "--fusion"]
+        if not args:
+            raise SystemExit(0)
     if "--collectives" in args:
         measure_collectives_ab()
         args = [a for a in args if a != "--collectives"]

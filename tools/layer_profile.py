@@ -102,6 +102,35 @@ def op_shares(records: List[Dict[str, Any]]) -> Dict[str, float]:
     return {k: round(v / total, 4) for k, v in out.items()}
 
 
+def split_fused_shares(shares: Dict[str, float]) -> Dict[str, float]:
+    """Attribute a FUSED kernel's time back to its member ops. A record
+    whose shares carry a fusion-op key (e.g. "lrn_maxpool" from an
+    on-chip span capture of a fused step — the granular graph never
+    fuses, so its own records always carry per-member keys) would make
+    the search see ONE op where two live: the fused pair's time must
+    land on `lrn` and `maxpool`, split by the PRE-FUSION share ratio
+    (the members' own shares in the same record; equal split when both
+    are absent/zero), or a later search round would starve the
+    neighbor's budget. The inverse of `priority_order`'s combined-share
+    charging — between them, fused time is neither dropped nor
+    double-counted."""
+    from veles_tpu.ops import templates
+    out = dict(shares)
+    for op in list(out):
+        members = templates.fusion_members(op)
+        if not members:
+            continue
+        fused = out.pop(op)
+        base = [max(float(out.get(m, 0.0)), 0.0) for m in members]
+        total = sum(base)
+        if total <= 0.0:
+            base = [1.0] * len(members)
+            total = float(len(members))
+        for m, b in zip(members, base):
+            out[m] = round(out.get(m, 0.0) + fused * b / total, 4)
+    return out
+
+
 def fold_trace_spans(trace_path: str) -> Dict[str, Any]:
     """Total duration per span name from a PR-7 --trace capture
     (Chrome-trace JSON) — driver-level context for the record. Missing
@@ -130,13 +159,20 @@ def write_profile(records: List[Dict[str, Any]], path: str,
                   trace_json: Optional[str] = None) -> Dict[str, Any]:
     """Assemble + atomically persist the machine-readable record the
     search consumes. Returns the record."""
+    raw = op_shares(records)
+    split = split_fused_shares(raw)
     record = {
         "schema": "veles-layer-profile",
         "version": 1,
         "units": records,
-        "ops": op_shares(records),
+        # the search consumes PER-MEMBER shares: any fused-kernel key is
+        # split back to its member ops (split_fused_shares) so a fusion
+        # winner landing never starves its neighbor's budget
+        "ops": split,
         **(meta or {}),
     }
+    if split != raw:
+        record["ops_raw"] = raw
     if trace_json:
         spans = fold_trace_spans(trace_json)
         if spans:

@@ -31,6 +31,10 @@
 #      (f32/bf16/int8_block/int8_ef/hier2). Single-chip tunnels exit
 #      with the >=2-device message — still queued so a pod window
 #      captures it
+#   8. tools/ablate.py --fusion            -> ISSUE 13 on-chip twin of
+#      the CPU-mesh cross-op fusion A/B: composed vs fused lrn+maxpool
+#      Pallas point (compiled here, not interpret — the number that
+#      actually decides whether the fused winner ships as a default)
 # Probe the flaky axon tunnel in a loop; the moment it answers, run the
 # queue in priority order, each timeout-bounded so one hang cannot eat
 # the warm window. Everything lands in tpu_watch/ + ONCHIP_LATE.md.
@@ -87,6 +91,13 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       timeout 1200 python tools/ablate.py --collectives \
       > tpu_watch/r8_collective_ab.txt 2>&1
     log "7 ablate --collectives rc=$? last: $(tail -1 tpu_watch/r8_collective_ab.txt | head -c 200)"
+    # 8. ISSUE 13: fused vs composed lrn+maxpool A/B with COMPILED
+    # Pallas (the CPU-mesh record in the repo is interpret-mode — this
+    # is the measurement that decides the fused default)
+    VELES_FUSION_AB_PATH=tpu_watch/r8_fusion_ab.json \
+      timeout 1200 python tools/ablate.py --fusion \
+      > tpu_watch/r8_fusion_ab.txt 2>&1
+    log "8 ablate --fusion rc=$? last: $(tail -1 tpu_watch/r8_fusion_ab.txt | head -c 200)"
     {
       echo "# ONCHIP_LATE — r8 watcher capture ($(date -u +%FT%TZ))"
       echo
@@ -105,6 +116,8 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       echo '```'; tail -3 tpu_watch/r8_bench_tuned.txt; echo '```'
       echo "## 7. tools/ablate.py --collectives (quantized/hierarchical grad_reduce A/B)"
       echo '```'; tail -7 tpu_watch/r8_collective_ab.txt; echo '```'
+      echo "## 8. tools/ablate.py --fusion (compiled fused-vs-composed lrn+maxpool A/B)"
+      echo '```'; tail -4 tpu_watch/r8_fusion_ab.txt; echo '```'
     } > ONCHIP_LATE.md
     log "capture done -> ONCHIP_LATE.md"
     exit 0

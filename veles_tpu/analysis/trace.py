@@ -30,7 +30,11 @@ Rules (docs/ANALYSIS.md):
   Covers OPTIMIZER-STATE specs too: a ZeRO-sharded step's velocity/
   moment plan (parallel.mesh.zero_plan) is checked leaf-by-leaf — the
   flat (padded,) vector must be divisible by the data axis, split into
-  equal local slices, and must not drop elements of the leaf it encodes;
+  equal local slices, and must not drop elements of the leaf it encodes.
+  Since ISSUE 13 it also covers the FUSED PAIR's traced step: a
+  selected cross-op fusion winner (lrn_maxpool) claims an adjacent unit
+  pair, and the fused kernel's geometry must equal what the claimed
+  pass-through unit declared at initialize time (`_fusion_findings`);
 - `pre-vma-numerics` (warn): the structured form of
   `_compat.warn_pre_vma_numerics` — GPipe / seq×TP builds on pre-vma
   jax have ~1e-3 trained-loss deviation;
@@ -305,6 +309,52 @@ def _collective_findings(step, mesh) -> List[Finding]:
     return out
 
 
+def _fusion_findings(step) -> List[Finding]:
+    """Fused-pair half of the sharding-mismatch audit (ISSUE 13): when a
+    selected fusion winner claims an adjacent unit pair, the trailing
+    unit becomes a pass-through — so the fused kernel must reproduce
+    EXACTLY the geometry that unit declared at initialize time (its
+    output Array shape, which every downstream layer sized its params
+    against). A post-init reconfiguration (ksize/stride edited on the
+    live unit) silently drifts the two apart: the fused trace would feed
+    downstream layers a differently-shaped tensor than the one their
+    weights were built for. Runs mesh or no mesh — the fusion claim is
+    mode-gated inside fusion_pairs() itself."""
+    pairs_fn = getattr(step, "fusion_pairs", None)
+    if pairs_fn is None:
+        return []
+    out: List[Finding] = []
+    for i, j, v in pairs_fn():
+        a, b = step.forwards[i], step.forwards[j]
+        if getattr(a, "variant_op", None) != "lrn":
+            # conv epilogue: elementwise fold, geometry untouched —
+            # the claimed LRN unit's output shape equals its input's
+            continue
+        in_shape = tuple(getattr(getattr(a, "input", None), "shape",
+                                 ()) or ())
+        decl = tuple(getattr(getattr(b, "output", None), "shape",
+                             ()) or ())
+        if len(in_shape) != 4 or len(decl) != 4:
+            continue
+        from veles_tpu.ops.pallas_kernels import _pool_out_hw
+        ky, kx = b.ksize
+        sy, sx = b.stride
+        oh, ow = _pool_out_hw(in_shape[1], in_shape[2], ky, kx, sy, sx)
+        traced = (in_shape[0], oh, ow, in_shape[3])
+        site = (f"{getattr(a, 'name', a)}+{getattr(b, 'name', b)} "
+                f"-> {v.name}")
+        if traced != decl:
+            out.append(Finding(
+                "sharding-mismatch", SEV_ERROR, repr(b),
+                f"fused pair {v.name!r} would trace a "
+                f"{traced} output where the claimed pass-through "
+                f"pooling unit declared {decl}: the pair's geometry "
+                "drifted after initialize (ksize/stride edited on the "
+                "live unit?) — downstream layers would consume a "
+                "silently different tensor", site))
+    return out
+
+
 def _optstate_findings(step, mesh) -> List[Finding]:
     """Optimizer-state half of the sharding audit: a ZeRO-sharded step
     carries its velocities/Adam moments as flat vectors split over the
@@ -452,10 +502,12 @@ def audit_fused_step(step, x, y, w=None, state=None,
 
     findings: List[Finding] = []
     sharding = _sharding_findings(step)
+    sharding += _fusion_findings(step)   # fused-pair geometry (any mode)
     findings += sharding
     if any(f.severity == SEV_ERROR for f in sharding):
-        # a broken partition plan: building state / tracing would crash
-        # on the very defect just reported — stop at the static verdict
+        # a broken partition plan (or a drifted fused-pair geometry):
+        # building state / tracing would crash on the very defect just
+        # reported — stop at the static verdict
         return findings
     mesh = getattr(step, "mesh", None)
     is_pipeline = hasattr(step, "_microbatch")

@@ -45,8 +45,9 @@ from veles_tpu.logger import Logger
 from veles_tpu.ops import variants
 
 __all__ = ["AutotuneCache", "autotune_workflow", "discover_tunables",
-           "op_cache_key", "default_cache_path", "search_workflow",
-           "search_op", "priority_order", "default_profile_path"]
+           "discover_fusions", "op_cache_key", "default_cache_path",
+           "search_workflow", "search_op", "priority_order",
+           "default_profile_path"]
 
 
 def default_cache_path() -> str:
@@ -159,6 +160,58 @@ def discover_tunables(wf) -> Dict[str, List[Dict]]:
     return found
 
 
+def discover_fusions(wf) -> Dict[str, List[Dict]]:
+    """{fusion_op: [signature, ...]} for every adjacent unit pair a
+    fusion template could claim in this workflow (today: lrn followed by
+    a max pooling — max flavor, no per-layer overrides on either side;
+    the same gate FusedTrainStep.fusion_pairs applies at trace time).
+    The signature joins BOTH members' variant signatures, so a fused
+    winner's cache key covers the pair's full configuration."""
+    found: Dict[str, List[Dict]] = {}
+    fwds = list(getattr(wf, "forwards", ()))
+    for a, b in zip(fwds, fwds[1:]):
+        if getattr(a, "variant_op", None) != "lrn" \
+                or getattr(b, "variant_op", None) != "maxpool" \
+                or getattr(b, "use_abs", False):
+            continue
+        if getattr(a, "variant_override", None) is not None \
+                or getattr(b, "variant_override", None) is not None:
+            continue
+        sig_a = a.variant_signature() if hasattr(a, "variant_signature") \
+            else None
+        sig_b = b.variant_signature() if hasattr(b, "variant_signature") \
+            else None
+        if sig_a is None or sig_b is None:
+            continue
+        found.setdefault("lrn_maxpool", []).append(
+            {"lrn": sig_a, "maxpool": sig_b})
+    return found
+
+
+@contextlib.contextmanager
+def _suspend_fusions(op: str):
+    """While a MEMBER op's candidates time, any fusion op claiming it
+    stands down: with a fused winner selected the pair is claimed and
+    flipping the member's lowering would never change the traced
+    program — every candidate would time within noise and a
+    noise-picked "winner" would persist under the member's cache key.
+    The member's decision is what the UNFUSED trace uses, so it is
+    timed unfused; the fusion selection is restored even on error."""
+    from veles_tpu.ops import templates
+    suspended: Dict[str, str] = {}
+    for fop in templates.template_ops():
+        if op in templates.fusion_members(fop):
+            prev = variants.selected(fop)
+            if prev is not None:
+                suspended[fop] = prev
+            variants.clear_selection(fop)
+    try:
+        yield
+    finally:
+        for fop, prev in suspended.items():
+            variants.select(fop, prev)
+
+
 def _sync(state) -> None:
     """Device barrier that works through the remote PJRT tunnel: fetch one
     scalar (block_until_ready is not a reliable barrier there — bench.py
@@ -233,7 +286,11 @@ def apply_cached(wf, *, compute_dtype=None,
     device_kind = jax.devices()[0].device_kind
     compute_dtype = _resolve_compute_dtype(compute_dtype)
     keys: Dict[str, List[str]] = {}
-    for op, sigs in discover_tunables(wf).items():
+    # fusion ops (lrn_maxpool) key like workflow ops: their adjacent-
+    # pair signatures join the probe so a searched fused winner applies
+    tunables = dict(discover_tunables(wf))
+    tunables.update(discover_fusions(wf))
+    for op, sigs in tunables.items():
         ks = []
         space = templates.space_signature(op)
         if space:
@@ -322,6 +379,14 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
             # microbench would time a degenerate identity) and under an
             # explicit `ops` restriction that omits it.
             searchable.append("grad_reduce")
+        for fop in discover_fusions(wf):
+            # cross-op fusion spaces (lrn_maxpool): searchable exactly
+            # when the workflow contains a claimable adjacent pair —
+            # timed IN-GRAPH (selecting a fused point changes what
+            # FusedTrainStep traces for the pair)
+            if (not ops or fop in ops) and fop in templates.CONTRACTS \
+                    and fop not in searchable:
+                searchable.append(fop)
     if searchable:
         # ONE search implementation: delegate the template-backed ops
         # to search_workflow (priority order, budget split, in-graph
@@ -352,15 +417,17 @@ def autotune_workflow(wf, *, mesh=None, compute_dtype=None,
                      and (not v.pallas or variants.pallas_ok())]
             prev = variants.selected(op)
             timings: Dict[str, Any] = {}
-            for name in cands:
-                variants.select(op, name)
-                try:
-                    timings[name] = _time_variant(
-                        wf, mesh, compute_dtype, steps, repeats, batch)
-                except Exception as e:  # noqa: BLE001 — one broken
-                    # candidate (e.g. a pallas kernel a backend rejects)
-                    # must not abort the whole tune
-                    timings[name] = f"error: {e!s:.200}"
+            with _suspend_fusions(op):
+                for name in cands:
+                    variants.select(op, name)
+                    try:
+                        timings[name] = _time_variant(
+                            wf, mesh, compute_dtype, steps, repeats,
+                            batch)
+                    except Exception as e:  # noqa: BLE001 — one broken
+                        # candidate (e.g. a pallas kernel a backend
+                        # rejects) must not abort the whole tune
+                        timings[name] = f"error: {e!s:.200}"
             ok = {k: v for k, v in timings.items()
                   if isinstance(v, float)}
             if not ok:
@@ -439,7 +506,20 @@ def priority_order(ops: List[str],
                   if isinstance(v, (int, float))}
     except (OSError, ValueError, AttributeError):
         pass
-    return sorted(((op, shares.get(op, 0.0)) for op in ops),
+
+    def share_of(op: str) -> float:
+        """A PURE fusion op (lrn_maxpool) is charged against the
+        COMBINED share of its member ops — the profile attributes time
+        per member (tools/layer_profile.py splits any fused kernel's
+        time back), so the pair's candidate budget reflects everything
+        a fused winner would replace."""
+        from veles_tpu.ops import templates
+        s = shares.get(op, 0.0)
+        for m in templates.fusion_members(op):
+            s += shares.get(m, 0.0)
+        return s
+
+    return sorted(((op, share_of(op)) for op in ops),
                   key=lambda kv: -kv[1])
 
 
@@ -706,6 +786,9 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
         if not getattr(wf, "is_initialized", False):
             wf.initialize(device=None)
         wf_sigs = discover_tunables(wf)
+        # adjacent fused pairs are in-graph-timeable too: a selected
+        # fused point changes what the step traces for the pair
+        wf_sigs.update(discover_fusions(wf))
     #: ops the WORKFLOW names (in-graph-timeable) — before the extra
     #: signatures below widen wf_sigs for cache-keying only
     discovered = set(wf_sigs)
@@ -714,6 +797,10 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
             wf_sigs.setdefault(op, sig_fn())
     on_cpu = jax.default_backend() == "cpu"
     ordered = priority_order(all_ops, profile_path)
+    # MEMBER ops tune before their fusion op (stable: share order kept
+    # within each group): the fusion decision then competes against
+    # tuned member lowerings, not their defaults
+    ordered.sort(key=lambda kv: bool(templates.fusion_members(kv[0])))
     shares = allocate_budget(
         ordered, budget,
         floors={op: incumbent_floor(op) for op, _ in ordered})
@@ -726,10 +813,11 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
             if wf is not None and op in discovered:
                 timer = (lambda: _time_variant(
                     wf, mesh, compute_dtype, steps, repeats, batch))
-            report[op] = search_op(
-                op, budget=shares[op], cache=cache,
-                compute_dtype=compute_dtype, force=force,
-                repeats=repeats, workflow_sigs=wf_sigs.get(op),
-                in_graph_timer=timer)
+            with _suspend_fusions(op):   # see the contextmanager's doc
+                report[op] = search_op(
+                    op, budget=shares[op], cache=cache,
+                    compute_dtype=compute_dtype, force=force,
+                    repeats=repeats, workflow_sigs=wf_sigs.get(op),
+                    in_graph_timer=timer)
             report[op]["priority_share"] = share
     return report

@@ -45,6 +45,11 @@ _LRN_TILE_MAX = 4096
 _LRN_VMEM_BLOCK_BYTES = 1 << 20
 #: fused-SGD row blocking seed (the pre-search hand-written value)
 _SGD_ROW_TILE = 8
+#: fused LRN+maxpool sample tile seed: SAMPLES per VMEM block (each
+#: "row" of this kernel's grid is one sample's whole (H, W, C) band —
+#: the pooling windows never cross it); 2 keeps AlexNet-L1 blocks near
+#: the ~1MB LRN heuristic
+_LRN_POOL_ROW_TILE = 2
 #: flash-attention block seeds (tuned by hand on v5e 2026-07-29; the
 #: search explores the full blk_q x blk_k x kv_order space around them)
 _FLASH_BLK_Q = 512
@@ -255,13 +260,211 @@ lrn_pallas.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
 
 
 # ---------------------------------------------------------------------------
+# fused LRN + maxpool: one VMEM pass over the shared activation
+# (searched cross-op fusion, ops/templates.py `lrn_maxpool`). LRN and the
+# pooling that follows it both stream the SAME activation rows — composed
+# they read it from HBM twice (and write the LRN intermediate once);
+# fused, each (row_tile, H, W, C) sample band is loaded once, normalized
+# and pooled in VMEM, and only the pooled output returns to HBM.
+# ---------------------------------------------------------------------------
+
+
+def _window_sum_last(a, half: int):
+    """±half across-channel window sum over the LAST axis of an N-d
+    block (the 4-D twin of `_window_sum`)."""
+    zeros = [(0, 0)] * (a.ndim - 1)
+    out = a
+    for d in range(1, half + 1):
+        out = out + jnp.pad(a[..., d:], zeros + [(0, d)]) \
+            + jnp.pad(a[..., :-d], zeros + [(d, 0)])
+    return out
+
+
+def _pool_out_hw(h: int, w: int, ky: int, kx: int, sy: int, sx: int):
+    """Ceil-mode pooled extent (edge windows truncate — the one
+    geometry every maxpool golden/lowering/unit shares)."""
+    oh = -(-(h - ky) // sy) + 1 if h > ky else 1
+    ow = -(-(w - kx) // sx) + 1 if w > kx else 1
+    return oh, ow
+
+
+def _pool_pad_hw(y, ky: int, kx: int, sy: int, sx: int, fill):
+    """Pad the spatial axes of (nt, H, W, C) so every ceil-mode window
+    is fully resident; returns (padded, oh, ow)."""
+    _, h, w, _ = y.shape
+    oh, ow = _pool_out_hw(h, w, ky, kx, sy, sx)
+    hp = (oh - 1) * sy + ky
+    wp = (ow - 1) * sx + kx
+    y = jnp.pad(y, ((0, 0), (0, hp - h), (0, wp - w), (0, 0)),
+                constant_values=fill)
+    return y, oh, ow
+
+
+def _pool_window_slices(yp, ky, kx, sy, sx, oh, ow):
+    """The ky·kx shifted strided views of the padded block — one per
+    window tap, each (nt, oh, ow, C), in window scan order (the order
+    ties break by, matching the goldens' argmax)."""
+    return [yp[:, dy:dy + (oh - 1) * sy + 1:sy,
+               dx:dx + (ow - 1) * sx + 1:sx, :]
+            for dy in range(ky) for dx in range(kx)]
+
+
+def _dilate_hw(a, sy: int, sx: int):
+    """Stride-dilate the two spatial axes (value at (i, j) lands at
+    (i·sy, j·sx)) via interleave-with-zeros — stack+reshape only, no
+    scatter (Mosaic-friendly)."""
+    nt, oh, ow, c = a.shape
+    if sy > 1:
+        z = jnp.zeros_like(a)
+        a = jnp.stack([a] + [z] * (sy - 1), axis=2) \
+            .reshape(nt, oh * sy, ow, c)
+    if sx > 1:
+        z = jnp.zeros_like(a)
+        a = jnp.stack([a] + [z] * (sx - 1), axis=3) \
+            .reshape(nt, a.shape[1], ow * sx, c)
+    return a
+
+
+def _place_hw(a, dy: int, dx: int, hp: int, wp: int):
+    """Embed a dilated contribution at spatial offset (dy, dx) of an
+    (hp, wp) canvas (pad, then crop the zero interleave tail)."""
+    a = jnp.pad(a, ((0, 0), (dy, max(0, hp - dy - a.shape[1])),
+                    (dx, max(0, wp - dx - a.shape[2])), (0, 0)))
+    return a[:, :hp, :wp, :]
+
+
+def _lrn_pool_fwd_kernel(x_ref, y_ref, *, half: int, k: float,
+                         alpha: float, beta: float, ky: int, kx: int,
+                         sy: int, sx: int):
+    x = x_ref[...].astype(jnp.float32)
+    s = k + alpha * _window_sum_last(x * x, half)
+    y = x * _pow_neg(s, beta)
+    yp, oh, ow = _pool_pad_hw(y, ky, kx, sy, sx, -jnp.inf)
+    out = None
+    for sl in _pool_window_slices(yp, ky, kx, sy, sx, oh, ow):
+        out = sl if out is None else jnp.maximum(out, sl)
+    y_ref[...] = out.astype(y_ref.dtype)
+
+
+def _lrn_pool_bwd_kernel(x_ref, g_ref, dx_ref, *, half: int, k: float,
+                         alpha: float, beta: float, ky: int, kx: int,
+                         sy: int, sx: int):
+    """One-pass backward of the composed pair: recompute the LRN output,
+    route the pooled error to each window's FIRST max (the goldens' and
+    select_and_scatter's tie rule — equality routing alone would send a
+    tied window's gradient to every tied element, e.g. post-ReLU zeros),
+    then the closed-form LRN backward — all on the resident block."""
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    s = k + alpha * _window_sum_last(x * x, half)
+    d = _pow_neg(s, beta)
+    y = x * d
+    yp, oh, ow = _pool_pad_hw(y, ky, kx, sy, sx, -jnp.inf)
+    hp, wp = yp.shape[1], yp.shape[2]
+    slices = _pool_window_slices(yp, ky, kx, sy, sx, oh, ow)
+    m = slices[0]
+    for sl in slices[1:]:
+        m = jnp.maximum(m, sl)
+    n_taps = ky * kx
+    win = None
+    for lin, sl in enumerate(slices):
+        cand = jnp.where(sl == m, jnp.int32(lin), jnp.int32(n_taps))
+        win = cand if win is None else jnp.minimum(win, cand)
+    g_lrn_p = None
+    for lin, (dy, dx) in enumerate((dy, dx) for dy in range(ky)
+                                   for dx in range(kx)):
+        placed = _place_hw(
+            _dilate_hw(jnp.where(win == lin, g, 0.0), sy, sx),
+            dy, dx, hp, wp)
+        g_lrn_p = placed if g_lrn_p is None else g_lrn_p + placed
+    g_lrn = g_lrn_p[:, :x.shape[1], :x.shape[2], :]
+    tsum = _window_sum_last(g_lrn * x * d / s, half)
+    dx_ref[...] = (g_lrn * d
+                   - (2.0 * alpha * beta) * x * tsum).astype(dx_ref.dtype)
+
+
+def _lrn_pool_call(kernel, args, out_hwc, k, alpha, beta, n: int,
+                   ksize, stride, row_tile: Optional[int],
+                   io_dtype: str):
+    """Common wrapper: grid over SAMPLE tiles (each program owns
+    `row_tile` whole (H, W, C) bands, so both the channel window and the
+    pooling windows stay in-block). `row_tile`/`io_dtype` are the
+    searched axes (ops/templates.py), exactly the LRN pair's."""
+    x = args[0]
+    nb = x.shape[0]
+    blk_dt = jnp.float32 if io_dtype == "f32" else x.dtype
+    rt = max(1, int(row_tile if row_tile is not None
+                    else _LRN_POOL_ROW_TILE))
+    rt = min(rt, max(nb, 1))
+    pad = (-nb) % rt
+    xs = []
+    for a in args:
+        a = a.astype(blk_dt)
+        if pad:
+            a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+        xs.append(a)
+    in_specs = [pl.BlockSpec((rt,) + a.shape[1:],
+                             lambda i: (i, 0, 0, 0),
+                             memory_space=pltpu.VMEM) for a in xs]
+    out = pl.pallas_call(
+        functools.partial(kernel, half=n // 2, k=float(k),
+                          alpha=float(alpha), beta=float(beta),
+                          ky=int(ksize[0]), kx=int(ksize[1]),
+                          sy=int(stride[0]), sx=int(stride[1])),
+        out_shape=jax.ShapeDtypeStruct((nb + pad,) + out_hwc, blk_dt),
+        grid=((nb + pad) // rt,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rt,) + out_hwc, lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(*xs)
+    return out[:nb].astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7,
+                                                    8))
+def lrn_maxpool_pallas(x, k: float = 2.0, alpha: float = 1e-4,
+                       beta: float = 0.75, n: int = 5,
+                       ksize=(3, 3), stride=(2, 2),
+                       row_tile: Optional[int] = None,
+                       io_dtype: str = "native"):
+    """Differentiable fused LRN→maxpool: ONE row-streaming Pallas pass
+    per direction over the shared (N, H, W, C) activation (fwd:
+    normalize + pool in VMEM; bwd: recompute + first-max error routing +
+    closed-form LRN backward). Ceil-mode pooling geometry, max flavor
+    only (maxabs pairs stay composed). Gated by the COMPOSED
+    ops.reference golden (`lrn_maxpool_forward/backward`) through the
+    equivalence ledger before the search may time it."""
+    oh, ow = _pool_out_hw(x.shape[1], x.shape[2], ksize[0], ksize[1],
+                          stride[0], stride[1])
+    return _lrn_pool_call(_lrn_pool_fwd_kernel, (x,),
+                          (oh, ow, x.shape[3]), k, alpha, beta, n,
+                          ksize, stride, row_tile, io_dtype)
+
+
+def _lrn_pool_fwd_rule(x, k, alpha, beta, n, ksize, stride, row_tile,
+                       io_dtype):
+    return lrn_maxpool_pallas(x, k, alpha, beta, n, ksize, stride,
+                              row_tile, io_dtype), x
+
+
+def _lrn_pool_bwd_rule(k, alpha, beta, n, ksize, stride, row_tile,
+                       io_dtype, x, g):
+    return (_lrn_pool_call(_lrn_pool_bwd_kernel, (x, g),
+                           tuple(x.shape[1:]), k, alpha, beta, n,
+                           ksize, stride, row_tile, io_dtype),)
+
+
+lrn_maxpool_pallas.defvjp(_lrn_pool_fwd_rule, _lrn_pool_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
 # blocked (flash-style) attention: tile over KV inside one chip
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
-                  reverse_kv: bool = False):
+def _flash_kernel(q_ref, k_ref, v_ref, *refs, scale: float, causal: bool,
+                  reverse_kv: bool = False, dropped: bool = False):
     """Grid (B·H, q_blocks, k_blocks) with KV innermost: each step streams
     ONE (blk_k, d) K/V tile through VMEM (O(blk) footprint — long-context
     safe) and folds it into the online-softmax scratch; the last KV step
@@ -269,7 +472,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     backward's softmax residual). `reverse_kv` visits KV tiles
     last-to-first (the index map streams tile nk−1−t at step t) — the
     online softmax is order-invariant, so numerics match to fp rounding;
-    the axis exists for the search to probe prefetch locality."""
+    the axis exists for the search to probe prefetch locality. With
+    `dropped` (the searched `drop` fusion axis, ops/templates.py) a
+    pre-scaled dropout mask streams as a fourth input blocked like Q and
+    multiplies the OUTPUT block in the same final write — the composed
+    path's extra HBM round trip over the attention output disappears."""
+    if dropped:
+        mk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -319,7 +530,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ki == nk - 1)
     def _():
-        o_ref[0] = acc_scr[:] / l_scr[:]
+        o = acc_scr[:] / l_scr[:]
+        if dropped:
+            o = o * mk_ref[0].astype(jnp.float32)
+        o_ref[0] = o
         lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
 
 
@@ -424,26 +638,33 @@ def _kspec(blk_k, d):
 
 
 def _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
-                    kv_order: str = "fwd"):
+                    kv_order: str = "fwd", mask=None):
     """(B·H, S, D) f32 in -> (out, lse); lse is (B·H, S, 1). `kv_order`
-    "rev" streams KV tiles last-to-first (searched axis)."""
+    "rev" streams KV tiles last-to-first (searched axis). `mask` (same
+    shape as qf, pre-scaled 0-or-1/keep) applies dropout to the output
+    block inside the kernel's final write (searched `drop` axis)."""
     bh, s, d = qf.shape
     rev = kv_order == "rev"
     nk = s // blk_k
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               reverse_kv=rev)
+                               reverse_kv=rev, dropped=mask is not None)
     if rev:
         kvspec = pl.BlockSpec((1, blk_k, d),
                               lambda b, i, t: (b, nk - 1 - t, 0),
                               memory_space=pltpu.VMEM)
     else:
         kvspec = _kspec(blk_k, d)
+    in_specs = [_qspec(blk_q, d), kvspec, kvspec]
+    args = [qf, kf, vf]
+    if mask is not None:
+        in_specs.append(_qspec(blk_q, d))
+        args.append(mask)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)),
         grid=(bh, s // blk_q, nk),
-        in_specs=[_qspec(blk_q, d), kvspec, kvspec],
+        in_specs=in_specs,
         out_specs=(_qspec(blk_q, d), _qspec(blk_q, 1)),
         scratch_shapes=[
             pltpu.VMEM((blk_q, 1), jnp.float32),   # running max
@@ -451,7 +672,7 @@ def _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
             pltpu.VMEM((blk_q, d), jnp.float32),   # unnormalized out
         ],
         interpret=_interpret(),
-    )(qf, kf, vf)
+    )(*args)
     return out, lse
 
 
@@ -469,11 +690,19 @@ def _flash_attn_fwd(qf, kf, vf, scale, causal, blk_q, blk_k, kv_order):
 
 def _flash_attn_bwd(scale, causal, blk_q, blk_k, kv_order, res, do):
     qf, kf, vf, out, lse = res
-    bh, s, d = qf.shape
     do = do.astype(jnp.float32)
     # D_i = rowsum(dO ⊙ O) — the softmax-jacobian diagonal term; tiny
     # elementwise reduce, XLA fuses it, no kernel needed
     di = jnp.sum(do * out, axis=-1, keepdims=True)        # (bh, s, 1)
+    return _flash_bwd_pallas(qf, kf, vf, do, lse, di, scale, causal,
+                             blk_q, blk_k)
+
+
+def _flash_bwd_pallas(qf, kf, vf, do, lse, di, scale, causal,
+                      blk_q, blk_k):
+    """The two backward pallas_calls (dQ, then dK/dV on the transposed
+    grid) — shared by the plain and dropout-fused custom-VJP pairs."""
+    bh, s, d = qf.shape
     lspec = pl.BlockSpec((1, blk_q, 1), lambda b, i, t: (b, i, 0),
                          memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
@@ -510,10 +739,44 @@ def _flash_attn_bwd(scale, causal, blk_q, blk_k, kv_order, res, do):
 _flash_attn.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attn_drop(qf, kf, vf, mf, scale, causal, blk_q, blk_k,
+                     kv_order):
+    """Dropout-fused flash attention: the pre-scaled mask multiplies the
+    output block inside the forward kernel's final write."""
+    return _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
+                           kv_order, mask=mf)[0]
+
+
+def _flash_attn_drop_fwd(qf, kf, vf, mf, scale, causal, blk_q, blk_k,
+                         kv_order):
+    out, lse = _flash_fwd_core(qf, kf, vf, scale, causal, blk_q, blk_k,
+                               kv_order, mask=mf)
+    return out, (qf, kf, vf, mf, out, lse)
+
+
+def _flash_attn_drop_bwd(scale, causal, blk_q, blk_k, kv_order, res, g):
+    qf, kf, vf, mf, out_m, lse = res
+    g = g.astype(jnp.float32)
+    # grad wrt the UNMASKED attention output is dO = g ⊙ mask (dropout
+    # backward); the softmax-jacobian diagonal D = rowsum(dO ⊙ O) equals
+    # rowsum(g ⊙ O·mask), so the MASKED output the forward saved feeds
+    # it directly — no unmasked residual needed
+    do = g * mf
+    di = jnp.sum(g * out_m, axis=-1, keepdims=True)
+    dq, dk, dv = _flash_bwd_pallas(qf, kf, vf, do, lse, di, scale,
+                                   causal, blk_q, blk_k)
+    # the mask is RNG output, nothing upstream consumes its gradient
+    return dq, dk, dv, jnp.zeros_like(mf)
+
+
+_flash_attn_drop.defvjp(_flash_attn_drop_fwd, _flash_attn_drop_bwd)
+
+
 def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
                            causal: bool = False, blk_q: int = _FLASH_BLK_Q,
                            blk_k: int = _FLASH_BLK_K,
-                           kv_order: str = "fwd"):
+                           kv_order: str = "fwd", drop_mask=None):
     """Intra-chip blocked attention, DIFFERENTIABLE (custom-VJP pair of
     Pallas kernels). q/k/v: (B, S, H, D) -> (B, S, H, D). Requires
     S % 128 == 0 (pad upstream). Grid (B·H, S/blk_q, S/blk_k), KV
@@ -525,7 +788,11 @@ def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
     path at B1·S16384·H8·D64 causal — 2.3× — while small-S workloads
     should just use ops.attention). `blk_q`/`blk_k`/`kv_order` are the
     searched tuning axes (ops/templates.py); kv_order applies to the
-    forward's KV streaming (the backward keeps its own fixed orders)."""
+    forward's KV streaming (the backward keeps its own fixed orders).
+    `drop_mask` ((B, S, H, D), pre-scaled 0-or-1/keep — the dropout
+    registry op's output) fuses the dropout over the attention output
+    into the kernel's final write (the searched `drop` axis; gated by
+    the composed `ops.reference.attn_dropout_forward` golden)."""
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -543,8 +810,16 @@ def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
     def heads_first(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    out = _flash_attn(heads_first(q).astype(jnp.float32),
-                      heads_first(k).astype(jnp.float32),
-                      heads_first(v).astype(jnp.float32),
-                      float(scale), causal, blk_q, blk_k, kv_order)
+    if drop_mask is None:
+        out = _flash_attn(heads_first(q).astype(jnp.float32),
+                          heads_first(k).astype(jnp.float32),
+                          heads_first(v).astype(jnp.float32),
+                          float(scale), causal, blk_q, blk_k, kv_order)
+    else:
+        out = _flash_attn_drop(
+            heads_first(q).astype(jnp.float32),
+            heads_first(k).astype(jnp.float32),
+            heads_first(v).astype(jnp.float32),
+            heads_first(jnp.asarray(drop_mask)).astype(jnp.float32),
+            float(scale), causal, blk_q, blk_k, kv_order)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -388,6 +388,76 @@ def lrn_backward(x: np.ndarray, err_y: np.ndarray, k: float = 2.0,
 
 
 # ---------------------------------------------------------------------------
+# composed goldens (NO 2015 parity — the gates for the searched CROSS-OP
+# fusion templates, ops/templates.py). Each is built by COMPOSING the
+# existing per-op goldens above, nothing else: tests assert these helpers
+# are BITWISE equal to applying the member goldens sequentially, so a
+# fused Pallas kernel gated against a composed golden is transitively
+# gated against every member op's golden.
+# ---------------------------------------------------------------------------
+
+def lrn_maxpool_forward(x: np.ndarray, k: float = 2.0, alpha: float = 1e-4,
+                        beta: float = 0.75, n: int = 5,
+                        ksize: Tuple[int, int] = (3, 3),
+                        stride: Tuple[int, int] = (2, 2)) -> np.ndarray:
+    """LRN then max pooling over the same activation — the composed
+    golden the fused `lrn_maxpool` template points are gated against."""
+    y = lrn_forward(x, k, alpha, beta, n)
+    return maxpool_forward(y, ksize, stride, False)[0]
+
+
+def lrn_maxpool_backward(x: np.ndarray, err_y: np.ndarray, k: float = 2.0,
+                         alpha: float = 1e-4, beta: float = 0.75,
+                         n: int = 5, ksize: Tuple[int, int] = (3, 3),
+                         stride: Tuple[int, int] = (2, 2)) -> np.ndarray:
+    """Backward of the composed pair: scatter the pooled error to the
+    recorded winners (first max in window scan order — the argmax
+    convention every maxpool golden and lowering shares), then the LRN
+    backward."""
+    y = lrn_forward(x, k, alpha, beta, n)
+    _, idx = maxpool_forward(y, ksize, stride, False)
+    g_lrn = maxpool_backward(err_y, idx, y.shape)
+    return lrn_backward(x, g_lrn, k, alpha, beta, n)
+
+
+def conv_lrn_forward(x: np.ndarray, w: np.ndarray, b: np.ndarray,
+                     stride: Tuple[int, int] = (1, 1),
+                     padding: Tuple[int, int] = (0, 0),
+                     activation: str = "linear", k: float = 2.0,
+                     alpha: float = 1e-4, beta: float = 0.75,
+                     n: int = 5) -> np.ndarray:
+    """conv+bias+activation with the LRN folded into the epilogue — the
+    composed golden for the conv_stem template's `epi=lrn` points."""
+    return lrn_forward(conv2d_forward(x, w, b, stride, padding,
+                                      activation), k, alpha, beta, n)
+
+
+def conv_lrn_backward(x: np.ndarray, w: np.ndarray, b: np.ndarray,
+                      err_y: np.ndarray,
+                      stride: Tuple[int, int] = (1, 1),
+                      padding: Tuple[int, int] = (0, 0),
+                      activation: str = "linear", k: float = 2.0,
+                      alpha: float = 1e-4, beta: float = 0.75, n: int = 5
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(err_x, dW, db) of the composed conv+LRN epilogue."""
+    y_conv = conv2d_forward(x, w, b, stride, padding, activation)
+    g_conv = lrn_backward(y_conv, err_y, k, alpha, beta, n)
+    return conv2d_backward(x, w, y_conv, g_conv, stride, padding,
+                           activation)
+
+
+def attn_dropout_forward(q: np.ndarray, k: np.ndarray, v: np.ndarray,
+                         mask: np.ndarray, scale: float = None,
+                         causal: bool = False) -> np.ndarray:
+    """Attention with the pre-scaled dropout mask applied to the output
+    block — the composed golden for the flash_attn template's `drop=1`
+    points (mask (B, S, H, D), values 0 or 1/keep; the backward leg is
+    `dropout_backward` on the incoming error, composed in tests)."""
+    return dropout_forward(mha_forward(q, k, v, scale=scale,
+                                       causal=causal), mask)
+
+
+# ---------------------------------------------------------------------------
 # fused SGD+momentum update (parity: veles/znicz/nn_units.py weight-update
 # kernels; the golden for the `sgd_update` lowering variants)
 # ---------------------------------------------------------------------------

@@ -41,6 +41,7 @@ __all__ = [
     "template_ops", "materialize", "space_signature",
     "check_equivalence", "equivalence_record", "passed", "clear_ledger",
     "ledger_table", "bench_candidate", "UngatedCandidateError",
+    "fusion_members", "fusion_config", "fusion_point",
 ]
 
 
@@ -89,6 +90,17 @@ class KernelTemplate:
     #: variants get Variant.stateful from it so the fused step can size
     #: its state slot from the NAME alone.
     stateful: Optional[Callable[[Dict[str, Any]], bool]] = None
+    #: name of the axis that decides whether a point FUSES a neighbor's
+    #: work ("fuse"/"epi"/"drop"); a point is a FUSED point when that
+    #: axis's value is not in _FUSE_OFF. None = the template has no
+    #: fusion structure (a pure tuning-constant space).
+    fuse_axis: Optional[str] = None
+    #: the member registry ops a pure-fusion op's candidates compose
+    #: (lrn_maxpool -> ("lrn", "maxpool")); the budgeted search charges
+    #: a fused candidate against the COMBINED profile share of these.
+    #: Empty for templates whose op is itself a unit op (conv_stem,
+    #: flash_attn — their fuse axis rides the op's own share).
+    fuses: Tuple[str, ...] = ()
 
     def __post_init__(self):
         self.seed = self.validate(self.seed)
@@ -205,6 +217,47 @@ def materialize(op: str, name: str) -> Optional["variants.Variant"]:
             doc=f"generated from template {t.base} at {cfg}")
         return variants.register(v)
     return None
+
+
+# -- cross-op fusion structure (ISSUE 13) -----------------------------------
+#: fuse-axis values that mean "do NOT fuse" — the composed point
+_FUSE_OFF = (0, "none", "off", None)
+
+
+def fusion_members(op: str) -> Tuple[str, ...]:
+    """The member registry ops whose work a pure-fusion op's candidates
+    claim (() for ordinary ops) — the search's combined-share charging
+    and tools/layer_profile.py's split both read this."""
+    out: List[str] = []
+    for t in templates_for(op):
+        for m in t.fuses:
+            if m not in out:
+                out.append(m)
+    return tuple(out)
+
+
+def fusion_config(op: str, name: Any) -> Optional[Dict[str, Any]]:
+    """Parsed config of `name` IF it is a FUSED point of one of op's
+    templates (its fuse axis is on); None for composed/foreign names —
+    the one rule FusedTrainStep, variant_table and the jaxpr auditor
+    share to decide whether a selection actually claims a neighbor."""
+    for t in templates_for(op):
+        if t.fuse_axis is None:
+            continue
+        cfg = t.parse(name) if isinstance(name, str) else None
+        if cfg is not None and cfg.get(t.fuse_axis) not in _FUSE_OFF:
+            return cfg
+    return None
+
+
+def fusion_point(op: str, unit: Any = None):
+    """The variant `op` resolves to right now IF that resolution is a
+    FUSED point (pallas gating included — under GSPMD or a pallas-less
+    backend resolve() falls back to the composed incumbent and this
+    returns None). The trace-time gate behind the pass-through-unit
+    rule."""
+    v = variants.resolve(op, unit=unit)
+    return v if fusion_config(op, v.name) is not None else None
 
 
 def space_signature(op: str) -> List[Dict[str, Any]]:
@@ -373,11 +426,15 @@ BENCHES["lrn"] = _lrn_bench
 # -- flash_attn: block shapes + KV streaming order --------------------------
 
 def _flash_build(cfg):
-    def apply(q, k, v, scale=None, causal=False):
+    def apply(q, k, v, scale=None, causal=False, drop_mask=None):
         from veles_tpu.ops import pallas_kernels as pk
         return pk.flash_attention_pallas(
             q, k, v, scale=scale, causal=causal, blk_q=cfg["blk_q"],
-            blk_k=cfg["blk_k"], kv_order=cfg["kv_order"])
+            blk_k=cfg["blk_k"], kv_order=cfg["kv_order"],
+            drop_mask=drop_mask if cfg["drop"] else None)
+    #: the contract/bench read the fuse axis off the closure so a fused
+    #: point is exercised (and timed) WITH its mask leg
+    apply.fusion_drop = cfg["drop"]
     return apply
 
 
@@ -408,8 +465,33 @@ def _flash_contract(apply):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=5e-4, atol=5e-5,
                                        err_msg=name)
-    return {"checked": "flash fwd vs ops.reference.mha_forward + bwd vs "
-                       "einsum vjp, causal and not"}
+    checked = ("flash fwd vs ops.reference.mha_forward + bwd vs "
+               "einsum vjp, causal and not")
+    if getattr(apply, "fusion_drop", 0):
+        # FUSED point: the in-kernel dropout epilogue vs the COMPOSED
+        # golden (attn_dropout_forward = mha_forward ⊙ mask; bwd vs the
+        # einsum-then-dropout_backward composition through jax.grad)
+        mask = (ref.make_dropout_mask(np.random.RandomState(17),
+                                      (b, s, h, d), 0.4)
+                .astype(np.float32))
+        mj = jnp.asarray(mask)
+        got = np.asarray(apply(q, k, v, causal=True, drop_mask=mask))
+        np.testing.assert_allclose(
+            got, ref.attn_dropout_forward(q, k, v, mask, causal=True),
+            rtol=2e-4, atol=2e-5)
+        gf = jax.grad(
+            lambda *a: jnp.sum(apply(*a, causal=True, drop_mask=mj) * w),
+            argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(
+            lambda *a: jnp.sum(
+                oa.mha_forward(*a, causal=True) * mj * w),
+            argnums=(0, 1, 2))(q, k, v)
+        for name, a, b_ in zip("qkv", gf, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=5e-4, atol=5e-5,
+                                       err_msg=f"drop {name}")
+        checked += " + dropout epilogue vs composed attn_dropout golden"
+    return {"checked": checked}
 
 
 def _flash_bench_shape():
@@ -420,9 +502,10 @@ def _flash_bench_shape():
 
 
 def _flash_bench_key(cfg):
-    """The (blk_q, blk_k, kv_order) the kernel ACTUALLY runs at the
-    bench shapes — flash_attention_pallas shrinks requested blocks to
-    divisors of S (fit()), so e.g. blk_k=1024 at S=512 IS blk_k=512."""
+    """The (blk_q, blk_k, kv_order, drop) the kernel ACTUALLY runs at
+    the bench shapes — flash_attention_pallas shrinks requested blocks
+    to divisors of S (fit()), so e.g. blk_k=1024 at S=512 IS
+    blk_k=512."""
     s = _flash_bench_shape()[1]
 
     def fit(blk):
@@ -431,7 +514,8 @@ def _flash_bench_key(cfg):
             blk //= 2
         return blk
 
-    return (fit(cfg["blk_q"]), fit(cfg["blk_k"]), cfg["kv_order"])
+    return (fit(cfg["blk_q"]), fit(cfg["blk_k"]), cfg["kv_order"],
+            cfg["drop"])
 
 
 def _flash_bench(apply, repeats):
@@ -441,9 +525,18 @@ def _flash_bench(apply, repeats):
     key = jax.random.PRNGKey(1)
     q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.float32)
                for kk in jax.random.split(key, 3))
+    kw = {}
+    if getattr(apply, "fusion_drop", 0):
+        # a FUSED point is timed with its mask leg — that is the kernel
+        # a winning selection would actually trace
+        kw["drop_mask"] = (
+            (jax.random.uniform(jax.random.PRNGKey(6),
+                                (b, s, h, d)) < 0.5)
+            .astype(jnp.float32) * 2.0)
 
     def fwd_bwd(q, k, v):
-        y, vjp = jax.vjp(lambda *a: apply(*a, causal=True), q, k, v)
+        y, vjp = jax.vjp(lambda *a: apply(*a, causal=True, **kw),
+                         q, k, v)
         return y, vjp(y)
 
     return _time_jitted(fwd_bwd, (q, k, v), repeats)
@@ -455,12 +548,19 @@ register_template(KernelTemplate(
           Axis("blk_k", (128, 256, 512, 1024), doc="KV rows per tile"),
           Axis("kv_order", ("fwd", "rev"),
                doc="forward-pass KV tile visit order (online softmax is "
-                   "order-invariant; probes prefetch locality)")),
+                   "order-invariant; probes prefetch locality)"),
+          Axis("drop", (0, 1),
+               doc="FUSE axis: apply a pre-scaled dropout mask inside "
+                   "the kernel's output-block write (drops the composed "
+                   "path's extra HBM round trip over the attention "
+                   "output); gated by the composed attn_dropout "
+                   "golden")),
     build=_flash_build,
-    seed={"blk_q": 512, "blk_k": 1024, "kv_order": "fwd"},
-    bench_key=_flash_bench_key,
+    seed={"blk_q": 512, "blk_k": 1024, "kv_order": "fwd", "drop": 0},
+    bench_key=_flash_bench_key, fuse_axis="drop",
     doc="blocked flash attention over blk_q x blk_k x streaming order "
-        "(hand incumbent: 512/1024/fwd, tuned v5e 2026-07-29)"))
+        "x dropout-epilogue fusion (hand incumbent: 512/1024/fwd, "
+        "unfused, tuned v5e 2026-07-29)"))
 CONTRACTS["flash_attn"] = _flash_contract
 BENCHES["flash_attn"] = _flash_bench
 
@@ -791,12 +891,20 @@ BENCHES["maxpool"] = _maxpool_bench
 # -- conv_stem: input packing x accumulator dtype ---------------------------
 
 def _conv_stem_build(cfg):
-    pack, acc = cfg["pack"], cfg["acc"]
+    pack, acc, epi = cfg["pack"], cfg["acc"], cfg["epi"]
 
-    def apply(x, w, b, stride, padding, activation):
+    def apply(x, w, b, stride, padding, activation, epilogue=None):
         from veles_tpu.ops import xla as ox
-        return ox.conv2d_forward(x, w, b, stride, padding, activation,
-                                 s2d=(pack == "s2d"), acc=acc)
+        y = ox.conv2d_forward(x, w, b, stride, padding, activation,
+                              s2d=(pack == "s2d"), acc=acc)
+        if epi == "lrn" and epilogue is not None:
+            # the claimed successor's LRN folded into the epilogue: the
+            # step passes the NORM unit's hyperparameters when a fused
+            # winner claims an adjacent (conv_stem, lrn) pair
+            y = ox.lrn_forward(y, epilogue["k"], epilogue["alpha"],
+                               epilogue["beta"], epilogue["n"])
+        return y
+    apply.fusion_epi = epi
     return apply
 
 
@@ -821,8 +929,29 @@ def _conv_stem_contract(apply):
     np.testing.assert_allclose(np.asarray(dx), gx, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(dw), gw, rtol=1e-4, atol=1e-3)
     np.testing.assert_allclose(np.asarray(db), gb, rtol=1e-4, atol=1e-4)
-    return {"checked": "stem conv fwd+bwd (stride-4 thin-channel) vs "
-                       "ops.reference, rtol 1e-4"}
+    checked = ("stem conv fwd+bwd (stride-4 thin-channel) vs "
+               "ops.reference, rtol 1e-4")
+    if getattr(apply, "fusion_epi", "none") == "lrn":
+        # FUSED point: bias+act+LRN epilogue vs the COMPOSED golden
+        epi = {"k": 2.0, "alpha": 1e-3, "beta": 0.75, "n": 5}
+        y2, vjp2 = jax.vjp(
+            lambda xx, ww, bb: apply(xx, ww, bb, stride, padding, act,
+                                     epilogue=epi), x, w, b)
+        y2g = ref.conv_lrn_forward(x, w, b, stride, padding, act, **epi)
+        np.testing.assert_allclose(np.asarray(y2), y2g, rtol=1e-4,
+                                   atol=1e-4)
+        g2 = rs.randn(*y2g.shape).astype(np.float32)
+        dx2, dw2, db2 = vjp2(g2)
+        gx2, gw2, gb2 = ref.conv_lrn_backward(
+            x, w, b, g2, stride, padding, act, **epi)
+        np.testing.assert_allclose(np.asarray(dx2), gx2, rtol=1e-4,
+                                   atol=1e-4, err_msg="epi dx")
+        np.testing.assert_allclose(np.asarray(dw2), gw2, rtol=1e-4,
+                                   atol=1e-3, err_msg="epi dw")
+        np.testing.assert_allclose(np.asarray(db2), gb2, rtol=1e-4,
+                                   atol=1e-4, err_msg="epi db")
+        checked += " + LRN epilogue vs composed conv_lrn golden"
+    return {"checked": checked}
 
 
 def _conv_stem_bench(apply, repeats):
@@ -834,11 +963,16 @@ def _conv_stem_bench(apply, repeats):
     x = jax.random.normal(k1, (n, hw, hw, 3), jnp.float32)
     w = jax.random.normal(k2, (11, 11, 3, co), jnp.float32) * 0.05
     b = jax.random.normal(k3, (co,), jnp.float32)
+    kw = {}
+    if getattr(apply, "fusion_epi", "none") == "lrn":
+        # a FUSED point is timed with its folded epilogue — that is the
+        # program a winning selection would actually trace
+        kw["epilogue"] = {"k": 2.0, "alpha": 1e-4, "beta": 0.75, "n": 5}
 
     def fwd_bwd(xx, ww, bb):
         y, vjp = jax.vjp(
             lambda a, c, d: apply(a, c, d, (4, 4), (0, 0),
-                                  "strictrelu"), xx, ww, bb)
+                                  "strictrelu", **kw), xx, ww, bb)
         return y, vjp(y)
 
     return _time_jitted(fwd_bwd, (x, w, b), repeats)
@@ -846,8 +980,9 @@ def _conv_stem_bench(apply, repeats):
 
 def _conv_stem_bench_key(cfg):
     # the microbench runs f32 inputs, where the accumulator axis traces
-    # the same program — only the packing distinguishes kernels there
-    return (cfg["pack"],)
+    # the same program — packing and the epilogue fusion distinguish
+    # kernels there (epi=lrn points are timed WITH the folded LRN)
+    return (cfg["pack"], cfg["epi"])
 
 
 register_template(KernelTemplate(
@@ -858,9 +993,125 @@ register_template(KernelTemplate(
           Axis("acc", ("native", "f32"),
                doc="conv accumulator dtype under sub-f32 compute: "
                    "XLA's dtype-following default vs pinned f32 "
-                   "(preferred_element_type)")),
-    build=_conv_stem_build, seed={"pack": "s2d", "acc": "native"},
-    pallas=False, bench_key=_conv_stem_bench_key,
-    doc="strided thin-channel entry conv over packing x accumulator"))
+                   "(preferred_element_type)"),
+          Axis("epi", ("none", "lrn"),
+               doc="FUSE axis: fold the successor LRN unit into the "
+                   "bias+activation epilogue (the normalization unit's "
+                   "work claimed at the matmul boundary); gated by the "
+                   "composed conv_lrn golden")),
+    build=_conv_stem_build,
+    seed={"pack": "s2d", "acc": "native", "epi": "none"},
+    pallas=False, bench_key=_conv_stem_bench_key, fuse_axis="epi",
+    doc="strided thin-channel entry conv over packing x accumulator x "
+        "LRN-epilogue fusion"))
 CONTRACTS["conv_stem"] = _conv_stem_contract
 BENCHES["conv_stem"] = _conv_stem_bench
+
+
+# -- lrn_maxpool: the searched CROSS-OP fusion (ISSUE 13) -------------------
+#    LRN and the pooling behind it both stream the same activation; the
+#    fused point does both in one VMEM pass (ops/pallas_kernels.py
+#    lrn_maxpool_pallas). The op is a PURE fusion op: its candidates
+#    compose the (lrn, maxpool) member ops, the search charges a fused
+#    candidate against their COMBINED profile share, and FusedTrainStep
+#    lets the normalization unit claim its pooling successor's work
+#    when a fused winner is selected (the pooling unit passes through
+#    for that trace). Every point — composed or fused — is gated by the
+#    COMPOSED ops.reference golden (lrn_maxpool_forward/backward).
+
+def _lrn_pool_build(cfg):
+    if not cfg["fuse"]:
+        # the composed point: exactly the two member lowerings the
+        # UNFUSED step would trace (XLA LRN + reduce_window pooling) —
+        # the incumbent the fused candidates must beat
+        def apply(x, *, k, alpha, beta, n, ksize, stride):
+            from veles_tpu.ops import xla as ox
+            y = ox.lrn_forward(x, k, alpha, beta, n)
+            return ox.maxpool_forward(y, tuple(ksize), tuple(stride),
+                                      False)
+        apply.fused = False
+        return apply
+
+    def apply(x, *, k, alpha, beta, n, ksize, stride):
+        from veles_tpu.ops import pallas_kernels as pk
+        return pk.lrn_maxpool_pallas(x, k, alpha, beta, n,
+                                     tuple(ksize), tuple(stride),
+                                     row_tile=cfg["rt"],
+                                     io_dtype=cfg["io"])
+    apply.fused = True
+    return apply
+
+
+def _lrn_pool_contract(apply):
+    import jax
+    import numpy as np
+
+    from veles_tpu.ops import reference as ref
+    rs = np.random.RandomState(21)
+    k, alpha, beta, n = 2.0, 1e-4, 0.75, 5
+    ksize, stride = (3, 3), (2, 2)
+    # 8x8 exercises the ceil-mode edge window (Hp=9 > 8); 9x9 is exact
+    for hw in (8, 9):
+        x = rs.randn(2, hw, hw, 16).astype(np.float32)
+        y, vjp = jax.vjp(
+            lambda xx: apply(xx, k=k, alpha=alpha, beta=beta, n=n,
+                             ksize=ksize, stride=stride), x)
+        yg = ref.lrn_maxpool_forward(x, k, alpha, beta, n, ksize,
+                                     stride)
+        np.testing.assert_allclose(np.asarray(y), yg, atol=2e-5,
+                                   err_msg=f"hw={hw}")
+        g = rs.randn(*yg.shape).astype(np.float32)
+        (dx,) = vjp(g)
+        np.testing.assert_allclose(
+            np.asarray(dx),
+            ref.lrn_maxpool_backward(x, g, k, alpha, beta, n, ksize,
+                                     stride),
+            atol=2e-5, err_msg=f"hw={hw} bwd")
+    return {"checked": "fused LRN+maxpool fwd+bwd vs the COMPOSED "
+                       "ops.reference golden (ceil-mode edge windows "
+                       "included), atol 2e-5"}
+
+
+def _lrn_pool_bench(apply, repeats):
+    import jax
+    import jax.numpy as jnp
+    shape = (8, 13, 13, 16) if _on_cpu() else (256, 55, 55, 96)
+    x = jax.random.normal(jax.random.PRNGKey(8), shape, jnp.float32)
+
+    def fwd_bwd(xx):
+        y, vjp = jax.vjp(
+            lambda a: apply(a, k=2.0, alpha=1e-4, beta=0.75, n=5,
+                            ksize=(3, 3), stride=(2, 2)), xx)
+        return y, vjp(y)[0]
+
+    return _time_jitted(fwd_bwd, (x,), repeats)
+
+
+def _lrn_pool_bench_key(cfg):
+    # every fuse=0 point IS the composed incumbent (rt/io are fused-
+    # kernel axes): one timing covers them all
+    return ("composed",) if not cfg["fuse"] else (cfg["rt"], cfg["io"])
+
+
+register_template(KernelTemplate(
+    op="lrn_maxpool", base="fused",
+    axes=(Axis("rt", (1, 2, 4, 8),
+               doc="SAMPLES per VMEM block (each holds a whole "
+                   "(H, W, C) band, so channel and pooling windows "
+                   "never cross blocks)"),
+          Axis("io", ("native", "f32"),
+               doc="HBM staging dtype (the LRN template's axis: "
+                   "caller's dtype vs f32 blocks)"),
+          Axis("fuse", (0, 1),
+               doc="FUSE axis: 0 = the composed member lowerings (the "
+                   "incumbent), 1 = one row-streaming Pallas pass "
+                   "doing LRN then maxpool over the same tile")),
+    build=_lrn_pool_build,
+    seed={"rt": 2, "io": "native", "fuse": 0},
+    bench_key=_lrn_pool_bench_key, fuse_axis="fuse",
+    fuses=("lrn", "maxpool"),
+    doc="searched cross-op fusion of the (lrn, maxpool) unit pair — "
+        "sample tile x staging dtype x fuse on/off, every point gated "
+        "on the composed golden"))
+CONTRACTS["lrn_maxpool"] = _lrn_pool_contract
+BENCHES["lrn_maxpool"] = _lrn_pool_bench

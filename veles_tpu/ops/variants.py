@@ -306,6 +306,35 @@ register(Variant("maxpool", "slices", _maxpool_slices,
                      "backward = selects + zero-pads (fusion-friendly)"))
 
 
+# -- lrn_maxpool: the searched (lrn, maxpool) CROSS-OP fusion ---------------
+#    apply(x, *, k, alpha, beta, n, ksize, stride) -> pooled output;
+#    differentiable. A PURE fusion op (ISSUE 13): "composed" is the
+#    incumbent (identical math to the two units tracing separately);
+#    the generated ``fused[rt=..,io=..,fuse=..]`` points come from
+#    ops.templates, every one gated on the COMPOSED ops.reference
+#    golden. When a fused winner is selected, FusedTrainStep lets the
+#    normalization unit claim its pooling successor's work (the pooling
+#    unit becomes a pass-through for that trace) — see
+#    parallel/fused.py fusion_pairs().
+
+def _lrn_maxpool_composed(x, *, k, alpha, beta, n, ksize, stride):
+    from veles_tpu.ops import xla as ox
+    y = ox.lrn_forward(x, k, alpha, beta, n)
+    return ox.maxpool_forward(y, tuple(ksize), tuple(stride), False)
+
+
+register_op(
+    "lrn_maxpool", default="composed", fallback="composed",
+    doc="searched cross-op fusion of an adjacent (lrn, maxpool) unit "
+        "pair: both ops stream the same activation rows, so the fused "
+        "Pallas point does LRN then pooling in ONE VMEM pass "
+        "(ops/templates.py; LRN alone was ~24% of the AlexNet step "
+        "pre-Pallas — ROOFLINE.md)")
+register(Variant("lrn_maxpool", "composed", _lrn_maxpool_composed,
+                 doc="the unfused incumbent: member lowerings traced "
+                     "separately (XLA LRN + reduce_window pooling)"))
+
+
 # -- conv stem: strided thin-channel entry conv -----------------------------
 #    apply(x, w, b, stride, padding, activation) -> y; differentiable.
 #    Units with s2d="auto" consult resolve("conv_stem") for the decision;

@@ -569,6 +569,75 @@ class FusedTrainStep:
 
     # -- forward chain -------------------------------------------------------
 
+    def _pair_fusion(self, u, nxt):
+        """The FUSED registry variant claiming the adjacent (u, nxt)
+        pair at trace time, or None (composed winner / pallas gated /
+        per-layer overrides / incompatible flavors). One rule shared by
+        _forward, variant_table and the jaxpr auditor's fused-pair pass
+        — traced == reported == audited."""
+        import types
+
+        from veles_tpu.ops import templates
+        if nxt is None:
+            return None
+        op_a = getattr(u, "variant_op", None)
+        op_b = getattr(nxt, "variant_op", None)
+        # a per-layer override pins a MEMBER lowering: claiming the pair
+        # would silently bypass it
+        if getattr(u, "variant_override", None) is not None \
+                or getattr(nxt, "variant_override", None) is not None:
+            return None
+        # the pallas gate rides a shim unit (the _sgd_variant precedent):
+        # the members' variant_override must not leak into the FUSION
+        # op's resolution
+        shim = types.SimpleNamespace(
+            allow_pallas=self.mode != "gspmd")
+        if op_a == "lrn" and op_b == "maxpool" \
+                and not getattr(nxt, "use_abs", False):
+            return templates.fusion_point("lrn_maxpool", unit=shim)
+        if op_a == "conv_stem" and op_b == "lrn":
+            # only auto-mode applicable stems consult the registry end
+            # to end (the unit's own fused_apply gate)
+            if getattr(u, "s2d", None) != "auto" \
+                    or not getattr(u, "input", None) \
+                    or not u._s2d_applicable(u.input.shape[-1]):
+                return None
+            return templates.fusion_point("conv_stem", unit=shim)
+        return None
+
+    def fusion_pairs(self):
+        """[(i, i+1, Variant), ...] adjacent unit pairs the CURRENT
+        registry selections claim, left-to-right (a unit joins at most
+        one pair — when both a conv epilogue and an lrn_maxpool winner
+        want the same LRN unit, the earlier pair wins). Resolved fresh
+        per call: trace-time state, like variants.resolve itself."""
+        out = []
+        claimed: set = set()
+        fwds = self.forwards
+        for i, u in enumerate(fwds[:-1]):
+            if i in claimed or (i + 1) in claimed:
+                continue
+            v = self._pair_fusion(u, fwds[i + 1])
+            if v is not None:
+                out.append((i, i + 1, v))
+                claimed.update((i, i + 1))
+        return out
+
+    def _apply_fused_pair(self, v, u, nxt, params_u, x):
+        """Trace one claimed pair: the leading unit's op consumes both
+        members' work through the fused variant; the trailing unit is a
+        pass-through for this trace."""
+        if getattr(u, "variant_op", None) == "lrn":
+            return v.apply(x, k=u.k, alpha=u.alpha, beta=u.beta, n=u.n,
+                           ksize=tuple(nxt.ksize),
+                           stride=tuple(nxt.stride))
+        # conv_stem epilogue: conv+bias+act with the successor LRN
+        # folded in
+        return v.apply(x, params_u["weights"], params_u["bias"],
+                       u.stride, u.padding, u.activation,
+                       epilogue={"k": nxt.k, "alpha": nxt.alpha,
+                                 "beta": nxt.beta, "n": nxt.n})
+
     def _forward(self, params, x, key, train: bool,
                  local_trace: bool = False):
         # uint8-wire prologue: traced into the step, so it fuses into
@@ -584,7 +653,7 @@ class FusedTrainStep:
         seq_axis = (SEQ_AXIS if self.mode == "seq" and not local_trace
                     else None)
         ep_axis = DATA_AXIS if self.ep and not local_trace else None
-        for i, u in enumerate(self.forwards):
+        for u in self.forwards:
             if hasattr(u, "seq_axis_name"):
                 # set at trace time so several step objects (different
                 # modes) over one workflow each trace the right kernel
@@ -604,6 +673,22 @@ class FusedTrainStep:
                 # so several step objects over one workflow each trace
                 # the right lowering (same pattern as seq_axis_name)
                 u.allow_pallas = self.mode != "gspmd"
+        # searched cross-op fusion (ISSUE 13): a fused winner lets the
+        # leading unit claim its successor's work — the successor
+        # becomes a pass-through for this trace. Key folds keep the
+        # ABSOLUTE unit index either way, so fused and composed traces
+        # draw identical RNG streams.
+        fused = {i: (j, v) for i, j, v in self.fusion_pairs()}
+        skip = {j for j, _ in fused.values()}
+        for i, u in enumerate(self.forwards):
+            if i in skip:
+                continue
+            if i in fused:
+                j, v = fused[i]
+                x = self._apply_fused_pair(v, u, self.forwards[j],
+                                           params[i], x)
+                x = self._constrain_tp_act(x, j)
+                continue
             k = jax.random.fold_in(key, i) if u.fused_needs_key else None
             x = u.fused_apply(params[i], x, key=k, train=train)
             x = self._constrain_tp_act(x, i)
@@ -1284,13 +1369,23 @@ class FusedTrainStep:
         """{op: variant-name} this step would trace right now, for every
         tunable op its forward chain contains — what bench records and
         the supervisor's exit report embed so a measured number always
-        names the lowerings that produced it."""
+        names the lowerings that produced it. A claimed fused pair
+        reports the FUSED winner for the fusion op itself, and for each
+        member op (qualified as ``<fusion-op>/<winner>``) UNLESS an
+        unclaimed unit of that op still traces a normal lowering — an
+        op-level entry must never name a lowering no unit traced, and a
+        still-composed sibling's (possibly overridden) name must not be
+        clobbered by the pair's claim."""
         from veles_tpu import _compat
         from veles_tpu.ops import variants
         table: Dict[str, str] = {}
-        for u in self.forwards:
+        pairs = self.fusion_pairs()           # mirror _forward's claims
+        claimed = {i for i, _, _ in pairs} | {j for _, j, _ in pairs}
+        for i, u in enumerate(self.forwards):
             op = getattr(u, "variant_op", None)
-            if op is None:
+            if op is None or i in claimed:
+                # a claimed unit traces the fused kernel, not its own
+                # registry resolution — reported below, qualified
                 continue
             u.allow_pallas = self.mode != "gspmd"   # mirror _forward
             # units whose traced lowering can diverge from the raw
@@ -1302,6 +1397,16 @@ class FusedTrainStep:
                 else variants.resolve(op, unit=u).name
             if name is not None:
                 table[op] = name
+        for i, j, v in pairs:
+            a, b = self.forwards[i], self.forwards[j]
+            if getattr(a, "variant_op", None) == "lrn":
+                table["lrn_maxpool"] = v.name
+                table.setdefault("lrn", f"lrn_maxpool/{v.name}")
+                table.setdefault("maxpool", f"lrn_maxpool/{v.name}")
+            else:       # conv_stem epilogue claiming the successor LRN
+                table.setdefault("conv_stem", v.name)
+                table.setdefault(getattr(b, "variant_op", "lrn"),
+                                 f"conv_stem/{v.name}")
         if self.zero_active and not _compat.GRAD_TRANSPOSE_PSUM:
             # the ZeRO reduce-scatter resolves through the registry like
             # any tunable lowering: a measured number must name which

@@ -126,14 +126,25 @@ class MultiHeadAttention(Forward):
         """The flash_attn variant this unit would actually trace — the
         einsum path when the gate keeps the kernel out — or None when no
         flash decision exists for this configuration (sequence-parallel
-        modes run the ring/Ulysses kernels)."""
+        modes run the ring/Ulysses kernels). A winner whose `drop` fuse
+        axis is on reports its drop=0 TWIN: this unit feeds no dropout
+        mask (its graph dropout follows the wo projection — a different
+        tensor), so the kernel that actually traces is the unfused
+        program, and the table must name that."""
         if self.parallel_mode != "local" \
                 or self.seq_axis_name is not None or not self.input:
             return None
         s = self.input.shape[1]
         if not self._flash_ok(s):
             return "xla_mha"
-        return self._flash_variant().name
+        name = self._flash_variant().name
+        from veles_tpu.ops import templates
+        if templates.fusion_config("flash_attn", name) is not None:
+            for t in templates.templates_for("flash_attn"):
+                cfg = t.parse(name)
+                if cfg is not None and t.fuse_axis is not None:
+                    return t.name({**cfg, t.fuse_axis: 0})
+        return name
 
     def ring_params(self) -> Dict[str, Any]:
         """Inner-hop tiling for the sequence-parallel RING path, taken

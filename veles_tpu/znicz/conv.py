@@ -97,14 +97,26 @@ class Conv(Forward):
         to (stride 1 / wide cin) traces direct regardless of the
         selection — reporting the raw registry resolution for those
         would name a variant the step never traced. None = this layer
-        carries no stem decision worth reporting."""
+        carries no stem decision worth reporting. An `epi=lrn` winner
+        reports its epi=none TWIN here: this method serves UNCLAIMED
+        layers (FusedTrainStep skips claimed pairs and reports them
+        itself), and an unclaimed stem passes no epilogue — the traced
+        program is the epilogue-less one (the attention drop=0-twin
+        rule)."""
         if self.s2d == "on":
             return "s2d"
         if self.s2d == "off":
             return "direct"
         if not self.input or not self._s2d_applicable(self.input.shape[-1]):
             return None
-        return variants.resolve("conv_stem", unit=self).name
+        name = variants.resolve("conv_stem", unit=self).name
+        from veles_tpu.ops import templates
+        if templates.fusion_config("conv_stem", name) is not None:
+            for t in templates.templates_for("conv_stem"):
+                cfg = t.parse(name)
+                if cfg is not None and t.fuse_axis is not None:
+                    return t.name({**cfg, t.fuse_axis: "none"})
+        return name
 
     def variant_signature(self):
         """Tunable only when s2d='auto' AND the rewrite applies here."""

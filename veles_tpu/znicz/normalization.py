@@ -65,7 +65,17 @@ class _LRNShimMeta(type):
 
 
 class LRNormalizerForward(Forward, metaclass=_LRNShimMeta):
-    """y = x · (k + α·Σ_window x²)^(−β), window of n channels."""
+    """y = x · (k + α·Σ_window x²)^(−β), window of n channels.
+
+    Cross-op fusion (ISSUE 13): when the searched `lrn_maxpool` winner
+    is a FUSED point and this unit's immediate successor in the fused
+    chain is a max pooling (max flavor, no per-layer overrides on either
+    side), this unit CLAIMS the pooling's work — FusedTrainStep traces
+    the one-pass `lrn_maxpool_pallas` kernel for the pair and the
+    pooling unit passes through for that trace (fusion_pairs() names the
+    claim; variant_table reports the fused winner for both member ops).
+    Symmetrically, a `conv_stem` winner with `epi=lrn` lets the
+    PRECEDING stem conv claim THIS unit's work as its epilogue."""
 
     #: lowering-variant registry op this unit consults at fused trace
     #: time (candidates: banded_matmul | cached_residual |

@@ -72,6 +72,14 @@ class _PoolShimMeta(type):
 
 
 class MaxPooling(Pooling, metaclass=_PoolShimMeta):
+    """Cross-op fusion note (ISSUE 13): when the searched `lrn_maxpool`
+    winner is a FUSED point and this unit immediately follows an LRN in
+    the fused chain (max flavor only — MaxAbsPooling never fuses — and
+    no per-layer overrides on either side), the NORMALIZATION unit
+    claims this unit's work: FusedTrainStep traces the one-pass fused
+    kernel for the pair and this unit becomes a pass-through for that
+    trace. Granular mode and every composed selection are untouched."""
+
     use_abs = False
 
     #: lowering-variant registry op (candidates: "reduce_window" —
