@@ -87,6 +87,33 @@ def _mem_record():
         return {"error": str(e)[:200]}
 
 
+def _memory_record(step, x, y, w=None):
+    """Predicted-vs-measured per-device memory (analysis pass 6,
+    ISSUE 14), embedded next to the memstats snapshot: the static
+    resident/high-water prediction for the step that was measured, and
+    the measured live/peak maxima to hold it against. trace=False — the
+    STATIC model only; the accounting must never cost the measured
+    value a make_jaxpr walk. Guarded like _mem_record."""
+    try:
+        from veles_tpu.analysis.resources import step_resource_report
+        rep = step_resource_report(step, x, y, w, trace=False)
+        meas = _mem_record() or {}
+        return {
+            "predicted_per_device": {
+                "resident": rep["resident_per_device"],
+                "highwater": rep["highwater_per_device"],
+                "static_only": rep.get("static_only"),
+                "components": rep["components"],
+            },
+            "measured": {
+                "live_bytes_max": meas.get("live_bytes_max"),
+                "peak_bytes_max": meas.get("peak_bytes_max"),
+            },
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _telemetry_overhead(step_time_s: float) -> dict:
     """Measured tracing-on vs tracing-off A/B: the record proves what
     --trace costs relative to THIS run's measured step time. `on` times
@@ -391,6 +418,10 @@ def child_main() -> None:
         # per-device memory under the measured config (memstats): the
         # ZeRO optimizer-state delta is a recorded number, not a claim
         "device_memory": _mem_record(),
+        # predicted-vs-measured per-device memory (analysis pass 6):
+        # the static HBM model for the measured step, held against the
+        # memstats maxima right next to it
+        "memory": _memory_record(step, x, y),
         # the measured price of --trace relative to THIS step time
         # (the <1% tracing budget, A/B on/off)
         "telemetry": _telemetry_overhead(step_time_s),
@@ -530,6 +561,7 @@ def e2e_child_main() -> None:
                         if hasattr(step, "collective_accounting")
                         else None),
         "device_memory": _mem_record(),
+        "memory": _memory_record(step, warm.x, warm.y, warm.w),
         "device_kind": jax.devices()[0].device_kind,
         "batch_per_chip": batch,
         "n_samples_packed": n,

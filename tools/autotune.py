@@ -66,6 +66,16 @@ def main(argv=None) -> int:
                         "order (default: $VELES_LAYER_PROFILE_PATH or "
                         "LAYER_PROFILE.json — write it with "
                         "tools/layer_profile.py)")
+    p.add_argument("--vmem-budget", type=int, default=None,
+                   metavar="BYTES",
+                   help="override the per-device VMEM budget the "
+                        "search prunes against (analysis pass 6: a "
+                        "generated point whose static footprint "
+                        "exceeds it is skipped without timing or "
+                        "budget cost) — what-if runs on CPU, where no "
+                        "budget exists by default, or tighter-than-"
+                        "device exploration; also honored as "
+                        "$VELES_VMEM_BUDGET")
     args = p.parse_args(argv)
 
     if args.budget is not None and args.budget < 1:
@@ -78,6 +88,12 @@ def main(argv=None) -> int:
         # silent no-op — the flat enumeration never reads the profile
         p.error("--profile-json orders the budgeted search: "
                 "combine with --budget N")
+    if args.vmem_budget is not None and not args.budget:
+        # same precedent: only the budgeted search prunes
+        p.error("--vmem-budget bounds the budgeted search's generated "
+                "points: combine with --budget N")
+    if args.vmem_budget is not None and args.vmem_budget < 1:
+        p.error("--vmem-budget must be a positive byte count")
 
     import jax
 
@@ -125,7 +141,8 @@ def main(argv=None) -> int:
                 wf, ops=searched, budget=args.budget, cache=cache,
                 compute_dtype=compute_dtype, steps=steps,
                 repeats=args.repeats, batch=batch, force=args.force,
-                profile_path=args.profile_json)
+                profile_path=args.profile_json,
+                vmem_budget=args.vmem_budget)
         flat_ops = [op for op in (only or variants.ops())
                     if op not in report]
         if flat_ops:
@@ -143,6 +160,10 @@ def main(argv=None) -> int:
         if rec.get("trials"):
             line += (f"  trials={rec['trials']}/{rec.get('budget', '?')}"
                      f"  share={rec.get('priority_share', 0):.2f}")
+        if rec.get("pruned"):
+            # the no-silent-caps rule: points the VMEM budget dropped
+            # are named in the per-point log; the count rides the line
+            line += f"  pruned={len(rec['pruned'])}"
         if rec.get("timings_s"):
             line += "  " + "  ".join(
                 f"{k}={v if isinstance(v, str) else f'{v * 1e3:.2f}ms'}"

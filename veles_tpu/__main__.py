@@ -100,8 +100,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
     p.add_argument("--verify-workflow", nargs="?", const="graph",
-                   default=None, choices=("graph", "audit"),
-                   metavar="{graph,audit}",
+                   default=None, choices=("graph", "audit", "resources"),
+                   metavar="{graph,audit,resources}",
                    help="statically verify the constructed workflow "
                         "(analysis pass: dangling/shadowed link_attrs "
                         "aliases, AND-gate control cycles, unreachable "
@@ -113,7 +113,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "auditor over the initialized workflow's fused "
                         "step (f64 promotion, host syncs, dropped "
                         "donation, sharding drift; traces, never "
-                        "compiles)")
+                        "compiles). --verify-workflow=resources ALSO "
+                        "runs the static resource analyzer (pass 6): "
+                        "kernel VMEM footprints vs the device budget "
+                        "and the per-device HBM model (params + grads "
+                        "+ ZeRO optimizer vectors + activation "
+                        "high-water + feed buffers) vs the memstats "
+                        "device limit")
     p.add_argument("--serve", nargs="?", const=0, default=None, type=int,
                    metavar="PORT",
                    help="serve the (snapshot-restored) model over HTTP "

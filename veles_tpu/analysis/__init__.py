@@ -1,7 +1,7 @@
 """Static analysis for veles_tpu: make wiring, tracing and hot-path
 mistakes checkable BEFORE anything runs — on CPU, in CI.
 
-Five passes (docs/ANALYSIS.md has the full rule catalogue):
+Six passes (docs/ANALYSIS.md has the full rule catalogue):
 
 - `graph`  — workflow-graph verifier over a constructed `Workflow`
   (dangling/shadowed aliases, AND-gate cycles, unreachable units,
@@ -17,6 +17,10 @@ Five passes (docs/ANALYSIS.md has the full rule catalogue):
 - `protocol` — HTTP endpoint contracts (shared token, bounded bodies)
   and the project-wide thread-owner stop() teardown contract (rides
   the velint gate).
+- `resources` — static VMEM/HBM footprint pass: kernel VMEM verdicts
+  that PRUNE the budgeted search (`--verify-workflow=resources`), and
+  the per-device workflow HBM model behind the launcher pre-flight,
+  bench "memory" records and the serving capacity hint.
 
 `findings.Finding` is the shared record the workflow-facing passes
 emit; `concurrency`/`protocol` emit `lint.LintFinding` so they share

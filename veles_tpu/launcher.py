@@ -20,6 +20,7 @@ import os
 import sys
 from typing import Any, Callable, Optional
 
+from veles_tpu.analysis.resources import ResourcePreflightError
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.resilience import EXIT_NONFINITE, NonFiniteLossError
@@ -363,6 +364,36 @@ class Launcher(Logger):
                 print(f"verify-workflow: audit traced the fused step "
                       f"({len(audit_finds)} finding(s))", flush=True)
                 findings += audit_finds
+        elif self.verify_workflow == "resources":
+            # pass 6 (analysis/resources.py): both static memory
+            # ledgers — the kernel VMEM verdicts for the current
+            # registry selections and the per-device workflow HBM
+            # model (params + grads + ZeRO optimizer vectors + ef +
+            # liveness-walk activations + feed buffers) vs the device
+            # limit. Traces, never compiles — "exit without training"
+            # still holds.
+            if not hasattr(self.workflow, "build_fused_step"):
+                print(f"verify-workflow: resources skipped — "
+                      f"{type(self.workflow).__name__} has no fused "
+                      f"step (StandardWorkflow-family only)",
+                      flush=True)
+            else:
+                from veles_tpu.analysis.resources import \
+                    workflow_resource_findings
+                res_finds, rep = workflow_resource_findings(
+                    self.workflow)
+                comps = ", ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(rep.get("components", {}).items()))
+                print(f"verify-workflow: resources predicted "
+                      f"{rep.get('highwater_per_device', 0)} B/device "
+                      f"high-water, {rep.get('resident_per_device', 0)}"
+                      f" B resident (limit "
+                      f"{rep.get('limit_per_device') or 'unknown'}; "
+                      f"{comps})", flush=True)
+                print(f"verify-workflow: resources section "
+                      f"({len(res_finds)} finding(s))", flush=True)
+                findings += res_finds
         # concurrency section: the whole-program thread/endpoint
         # contracts (analysis passes 4/5) over the installed package —
         # the same findings tools/velint.py --ci ratchets on, surfaced
@@ -511,6 +542,19 @@ class Launcher(Logger):
                     from veles_tpu.parallel.memstats import \
                         device_memory_stats
                     mem = device_memory_stats()
+                    # the pass-6 pre-flight prediction rides the same
+                    # payload, so the supervisor's exit report can
+                    # promote the predicted-vs-measured memory delta
+                    # next to the measured snapshot (ISSUE 14)
+                    rep = getattr(wf, "resource_report", None)
+                    if mem is not None and rep:
+                        mem = dict(mem)
+                        mem["predicted"] = {
+                            "resident_per_device":
+                                rep.get("resident_per_device"),
+                            "highwater_per_device":
+                                rep.get("highwater_per_device"),
+                        }
                 except Exception:  # noqa: BLE001 — stats never kill a beat
                     mem = None
                 try:
@@ -702,6 +746,15 @@ class Launcher(Logger):
                        EXIT_NONFINITE)
             self.workflow.stop()
             return EXIT_NONFINITE
+        except ResourcePreflightError as e:
+            # pass-6 pre-flight (analysis/resources.py): the static HBM
+            # model says this (model, mesh, batch, ZeRO) combination
+            # exceeds the device limit — refuse in seconds, with the
+            # per-component breakdown, instead of OOMing minutes into
+            # the compile
+            self.error("run refused by the resource pre-flight: %s", e)
+            self.workflow.stop()
+            return 1
         finally:
             for fn in installed_hooks:   # next run re-registers fresh
                 _rhooks.remove_epoch_hook(fn)

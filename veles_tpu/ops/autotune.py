@@ -37,6 +37,7 @@ from __future__ import annotations
 import contextlib
 import hashlib
 import json
+import logging
 import os
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -304,14 +305,33 @@ def apply_cached(wf, *, compute_dtype=None,
         keys.setdefault(op, [op_cache_key(
             device_kind, op, base + templates.space_signature(op),
             compute_dtype)])
+    from veles_tpu.analysis import resources as vres
     applied: Dict[str, str] = {}
     for op, ks in keys.items():
         for key in ks:
             hit = cache.get(key)
-            if hit is not None and variants.has(op, hit.get("variant")):
-                variants.select(op, hit["variant"])
-                applied[op] = hit["variant"]
-                break
+            if hit is None or not variants.has(op, hit.get("variant")):
+                continue
+            # cache-refusal rule (ISSUE 14): a persisted winner whose
+            # static VMEM footprint no longer fits THIS device_kind's
+            # budget (the cache may have been tuned on a roomier chip,
+            # or the budget overridden for a what-if run) is refused —
+            # the current selection stands rather than selecting a
+            # point that would fail at compile time on-chip
+            ver = vres.kernel_verdict(
+                op, hit["variant"],
+                shapes=vres.shapes_from_signatures(op, tunables.get(op)),
+                dtype=compute_dtype, device_kind=device_kind)
+            if ver is not None:
+                logging.getLogger("veles.autotune").warning(
+                    "autotune cache: refusing %s winner %r — VMEM "
+                    "footprint %d B exceeds the %s budget %d B",
+                    op, hit["variant"], ver["footprint"], device_kind,
+                    ver["vmem_budget"])
+                continue
+            variants.select(op, hit["variant"])
+            applied[op] = hit["variant"]
+            break
     return applied
 
 
@@ -575,7 +595,28 @@ def _trials_counter():
     return tm.default_registry().counter(
         "veles_autotune_trials_total",
         "budgeted-search candidate evaluations by outcome "
-        "(timed / equiv_fail / error)", labelnames=("op", "outcome"))
+        "(timed / equiv_fail / error / pruned)",
+        labelnames=("op", "outcome"))
+
+
+def _prune_verdict(op: str, template, cfg, shapes, compute_dtype,
+                   vbudget: Optional[int]) -> Optional[Dict[str, Any]]:
+    """The search's static-infeasibility pre-check (ISSUE 14,
+    analysis/resources.py): None when the point fits (or no budget /
+    footprint rule exists), else {"footprint", "vmem_budget"}. A
+    module-level seam on purpose — the ledger-bypass property test
+    monkeypatches it away and asserts `_timed_trial`'s independent
+    re-check still refuses to time the point."""
+    if vbudget is None or template.vmem_footprint is None:
+        return None
+    try:
+        f = int(template.vmem_footprint(cfg, dict(shapes or {}),
+                                        compute_dtype))
+    except Exception:  # noqa: BLE001 — a broken rule must degrade to
+        return None    # "unknown, don't prune", never abort the search
+    if f > vbudget:
+        return {"footprint": f, "vmem_budget": vbudget}
+    return None
 
 
 def search_op(op: str, *, budget: int,
@@ -584,8 +625,9 @@ def search_op(op: str, *, budget: int,
               compute_dtype: Any = None,
               force: bool = False, repeats: int = 2,
               workflow_sigs: Optional[List[Dict]] = None,
-              in_graph_timer: Optional[Callable[[], float]] = None
-              ) -> Dict[str, Any]:
+              in_graph_timer: Optional[Callable[[], float]] = None,
+              vmem_shapes: Optional[Dict[str, Any]] = None,
+              vmem_budget: Optional[int] = None) -> Dict[str, Any]:
     """Budgeted coordinate-descent search over one op's candidate set:
     the hand-written tunable variants first (the incumbents), then the
     template config space, moving one axis at a time from the template
@@ -603,6 +645,7 @@ def search_op(op: str, *, budget: int,
     sgd_update)."""
     import jax
 
+    from veles_tpu.analysis import resources as vres
     from veles_tpu.ops import templates
     cache = cache or AutotuneCache(cache_path)
     device_kind = jax.devices()[0].device_kind
@@ -611,9 +654,24 @@ def search_op(op: str, *, budget: int,
     key = op_cache_key(device_kind, op, sigs, compute_dtype)
     hit = None if force else cache.get(key)
     if hit is not None and variants.has(op, hit.get("variant")):
-        variants.select(op, hit["variant"])
-        return {"variant": hit["variant"], "source": "cache",
-                "key": key, "trials": 0}
+        # the same cache-refusal rule as apply_cached (the budget is
+        # NOT part of the cache key): a winner persisted under a
+        # roomier budget must not short-circuit a tightened re-run —
+        # fall through to the search, which prunes the point
+        ver = vres.kernel_verdict(op, hit["variant"],
+                                  shapes=vmem_shapes,
+                                  dtype=compute_dtype,
+                                  device_kind=device_kind,
+                                  budget=vmem_budget)
+        if ver is None:
+            variants.select(op, hit["variant"])
+            return {"variant": hit["variant"], "source": "cache",
+                    "key": key, "trials": 0}
+        logging.getLogger("veles.autotune").warning(
+            "autotune cache: refusing %s winner %r — VMEM footprint "
+            "%d B exceeds the %s budget %d B; re-searching", op,
+            hit["variant"], ver["footprint"], device_kind,
+            ver["vmem_budget"])
     if budget < 1:
         # a too-small total budget can allocate an op zero trials:
         # that is a SKIP (current selection stands), not an error —
@@ -627,14 +685,28 @@ def search_op(op: str, *, budget: int,
     timings: Dict[str, float] = {}
     trace: List[Dict[str, Any]] = []
     state = {"trials": 0}
+    #: per-device VMEM budget for static pruning (analysis pass 6):
+    #: None (CPU / unknown device_kind, no override) = pruning inactive
+    vbudget = vres.vmem_budget(device_kind, override=vmem_budget)
+    pruned: set = set()
 
     def _timed_trial(name: str) -> float:
         """Time ONE gated candidate. The ledger check is the structural
-        gate: no passing equivalence record, no timing — ever."""
+        gate: no passing equivalence record, no timing — ever. The VMEM
+        verdict is its twin (ISSUE 14): an over-budget point is refused
+        HERE, independently of the prune branch, so a bypassed prune
+        can never reach the timing path."""
         if not templates.passed(op, name):
             raise templates.UngatedCandidateError(
                 f"{op}/{name}: refusing to time a candidate with no "
                 "passing ops.reference equivalence record")
+        ver = vres.kernel_verdict(op, name, shapes=vmem_shapes,
+                                  dtype=compute_dtype, budget=vbudget)
+        if ver is not None:
+            raise vres.InfeasibleCandidateError(
+                f"{op}/{name}: refusing to time a candidate whose "
+                f"static VMEM footprint ({ver['footprint']} B) exceeds "
+                f"the device budget ({ver['vmem_budget']} B)")
         if in_graph_timer is not None:
             variants.select(op, name)
             return in_graph_timer()
@@ -665,7 +737,8 @@ def search_op(op: str, *, budget: int,
                     timings[name] = t
                     rec.update(outcome="timed", time_s=round(t, 6))
                     counter.labels(op=op, outcome="timed").inc()
-            except templates.UngatedCandidateError:
+            except (templates.UngatedCandidateError,
+                    vres.InfeasibleCandidateError):
                 raise   # structural bug, never swallowed as a trial error
             except Exception as e:  # noqa: BLE001 — one broken candidate
                 # (a backend-rejected kernel) must not abort the search
@@ -689,6 +762,23 @@ def search_op(op: str, *, budget: int,
 
     def gen_trial(t, cfg) -> Optional[float]:
         name = t.name(cfg)
+        if name in pruned:
+            return None
+        # static VMEM pruning (ISSUE 14): an over-budget point is
+        # statically infeasible — skipped WITHOUT timing it or burning
+        # budget, logged per point (the PR-8 no-silent-caps rule) and
+        # counted as outcome="pruned" on the trials metric
+        ver = _prune_verdict(op, t, cfg, vmem_shapes, compute_dtype,
+                             vbudget)
+        if ver is not None:
+            pruned.add(name)
+            counter.labels(op=op, outcome="pruned").inc()
+            trace.append({"variant": name, "outcome": "pruned", **ver})
+            logging.getLogger("veles.autotune").info(
+                "pruned %s/%s: VMEM footprint %d B > %s budget %d B "
+                "(never timed, no budget spent)", op, name,
+                ver["footprint"], device_kind, ver["vmem_budget"])
+            return None
         if in_graph_timer is None and t.bench_key is not None:
             bk = t.bench_key(cfg)
             if seen_bench.setdefault(bk, name) != name:
@@ -745,7 +835,9 @@ def search_op(op: str, *, budget: int,
         "trace": trace,
         "equivalence": {t_["variant"]: ("fail" if t_["outcome"]
                                         == "equiv_fail" else "pass")
-                        for t_ in trace},
+                        for t_ in trace
+                        if t_["outcome"] != "pruned"},
+        "pruned": sorted(pruned),
         "budget": budget, "trials": state["trials"],
         "timer": "in_graph" if in_graph_timer is not None
         else "microbench",
@@ -764,7 +856,9 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
                     profile_path: Optional[str] = None,
                     mesh=None, steps: int = 4, repeats: int = 2,
                     batch: Optional[int] = None,
-                    force: bool = False) -> Dict[str, Dict[str, Any]]:
+                    force: bool = False,
+                    vmem_budget: Optional[int] = None
+                    ) -> Dict[str, Dict[str, Any]]:
     """Budgeted search across every template-backed op: workflow ops
     (lrn, …) time IN-GRAPH through `wf`'s fused step, ops below the unit
     graph (flash_attn, sgd_update) through their template microbench.
@@ -813,11 +907,19 @@ def search_workflow(wf=None, *, ops: Optional[List[str]] = None,
             if wf is not None and op in discovered:
                 timer = (lambda: _time_variant(
                     wf, mesh, compute_dtype, steps, repeats, batch))
+            from veles_tpu.analysis import resources as vres
             with _suspend_fusions(op):   # see the contextmanager's doc
                 report[op] = search_op(
                     op, budget=shares[op], cache=cache,
                     compute_dtype=compute_dtype, force=force,
                     repeats=repeats, workflow_sigs=wf_sigs.get(op),
-                    in_graph_timer=timer)
+                    in_graph_timer=timer,
+                    # static VMEM pruning evaluates each point at the
+                    # WORKFLOW's shapes when the op is in-graph (the
+                    # kernel a winner would actually trace), else at
+                    # the microbench's canonical shapes
+                    vmem_shapes=vres.shapes_from_signatures(
+                        op, wf_sigs.get(op)),
+                    vmem_budget=vmem_budget)
             report[op]["priority_share"] = share
     return report

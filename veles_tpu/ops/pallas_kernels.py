@@ -56,6 +56,19 @@ _FLASH_BLK_Q = 512
 _FLASH_BLK_K = 1024
 
 
+def flash_fit_block(s: int, blk: int) -> int:
+    """The block size `flash_attention_pallas` ACTUALLY runs for a
+    requested `blk` at sequence length `s`: shrink to the largest
+    power-of-two divisor of S so any S % 128 == 0 sequence works (e.g.
+    S=4608 gets blk_k=512). Shared by the kernel wrapper, the search's
+    bench-alias key and the static VMEM footprint model
+    (ops/templates.py) — the pruned geometry IS the traced geometry."""
+    blk = min(blk, s)
+    while blk > 128 and s % blk:
+        blk //= 2
+    return blk
+
+
 def available() -> bool:
     """True when the default backend can run compiled Pallas TPU kernels."""
     try:
@@ -796,14 +809,7 @@ def flash_attention_pallas(q, k, v, scale: Optional[float] = None,
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(d)
-    # shrink requested blocks to the largest power-of-two divisor of S so
-    # any S % 128 == 0 sequence works (e.g. S=4608 gets blk_k=512)
-    def fit(blk):
-        blk = min(blk, s)
-        while blk > 128 and s % blk:
-            blk //= 2
-        return blk
-    blk_q, blk_k = fit(blk_q), fit(blk_k)
+    blk_q, blk_k = flash_fit_block(s, blk_q), flash_fit_block(s, blk_k)
     assert s % blk_q == 0 and s % blk_k == 0, \
         f"seq len {s} must be divisible by 128 (got blocks {blk_q},{blk_k})"
 

@@ -101,6 +101,16 @@ class KernelTemplate:
     #: Empty for templates whose op is itself a unit op (conv_stem,
     #: flash_attn — their fuse axis rides the op's own share).
     fuses: Tuple[str, ...] = ()
+    #: declarative VMEM model (ISSUE 14, analysis/resources.py):
+    #: (config, shapes, dtype) -> resident bytes of the point's Pallas
+    #: blocks (double-buffered in/out block bytes + scratch, derived
+    #: from the kernel's BlockSpecs in ops/pallas_kernels.py; worst
+    #: direction wins). `shapes` is an op-specific dim dict — missing
+    #: keys fall back to the rule's canonical bench shapes, the very
+    #: kernel the microbench would run. None = no static footprint
+    #: (non-Pallas ops): unknown is never pruned.
+    vmem_footprint: Optional[
+        Callable[[Dict[str, Any], Dict[str, Any], Any], int]] = None
 
     def __post_init__(self):
         self.seed = self.validate(self.seed)
@@ -362,6 +372,27 @@ def _time_jitted(fn, args, repeats: int) -> float:
 
 
 # ===========================================================================
+# VMEM footprint rules (ISSUE 14): the declarative cost model behind the
+# search's static pruning (analysis/resources.py owns the budget table
+# and verdicts). Each rule mirrors its kernel's BlockSpecs in
+# ops/pallas_kernels.py: Pallas pipelines grid steps with DOUBLE-
+# BUFFERED in/out blocks, so resident bytes = 2 x (in-block + out-block
+# bytes) + scratch. In-kernel temporaries beyond the declared refs are
+# a documented under-count (docs/ANALYSIS.md blind spots).
+# ===========================================================================
+
+
+def _dtype_width(dtype) -> int:
+    """Byte width of a compute-dtype spec ('bfloat16', np dtype, None =
+    f32) without requiring numpy to know the name."""
+    if dtype is None:
+        return 4
+    s = str(dtype)
+    return {"bfloat16": 2, "bf16": 2, "float16": 2, "f16": 2,
+            "float64": 8, "f64": 8}.get(s, 4)
+
+
+# ===========================================================================
 # Registered templates: the tuning axes of ops/pallas_kernels.py
 # ===========================================================================
 
@@ -409,6 +440,14 @@ def _lrn_bench(apply, repeats):
     return _time_jitted(fwd_bwd, (x,), repeats)
 
 
+def _lrn_vmem(cfg, shapes, dtype):
+    """Both LRN passes block (rt, C); the backward is the worst
+    direction — 2 inputs (x, err) + 1 output, each double-buffered."""
+    c = int(shapes.get("c") or (16 if _on_cpu() else 96))
+    w = 4 if cfg["io"] == "f32" else _dtype_width(dtype)
+    return 2 * 3 * cfg["rt"] * c * w
+
+
 register_template(KernelTemplate(
     op="lrn", base="pallas",
     axes=(Axis("rt", (32, 64, 128, 256, 512, 1024, 2048),
@@ -417,6 +456,7 @@ register_template(KernelTemplate(
                doc="HBM staging dtype: caller's dtype (bf16 under the "
                    "fused step — half the bytes) vs f32 blocks")),
     build=_lrn_build, seed={"rt": 512, "io": "native"},
+    vmem_footprint=_lrn_vmem,
     doc="one-VMEM-pass LRN pair over row-tile x staging-dtype (the "
         "hand-written pallas_one_pass uses the ~1MB heuristic tile)"))
 CONTRACTS["lrn"] = _lrn_contract
@@ -504,18 +544,47 @@ def _flash_bench_shape():
 def _flash_bench_key(cfg):
     """The (blk_q, blk_k, kv_order, drop) the kernel ACTUALLY runs at
     the bench shapes — flash_attention_pallas shrinks requested blocks
-    to divisors of S (fit()), so e.g. blk_k=1024 at S=512 IS
+    to divisors of S (flash_fit_block), so e.g. blk_k=1024 at S=512 IS
     blk_k=512."""
+    from veles_tpu.ops.pallas_kernels import flash_fit_block
     s = _flash_bench_shape()[1]
-
-    def fit(blk):
-        blk = min(blk, s)
-        while blk > 128 and s % blk:
-            blk //= 2
-        return blk
-
-    return (fit(cfg["blk_q"]), fit(cfg["blk_k"]), cfg["kv_order"],
+    return (flash_fit_block(s, cfg["blk_q"]),
+            flash_fit_block(s, cfg["blk_k"]), cfg["kv_order"],
             cfg["drop"])
+
+
+def _flash_vmem(cfg, shapes, dtype):
+    """Worst of the three flash grids (fwd / dQ / dK-dV), each with its
+    declared blocks double-buffered plus its scratch — all f32 inside
+    the kernels. Blocks are clamped to divisors of S exactly like the
+    traced kernel (flash_fit_block), so the pruned geometry IS the one
+    that would compile."""
+    from veles_tpu.ops.pallas_kernels import flash_fit_block
+    _, s0, _, d0 = _flash_bench_shape()
+    s = int(shapes.get("s") or s0)
+    d = int(shapes.get("d") or d0)
+    bq = flash_fit_block(s, cfg["blk_q"])
+    bk = flash_fit_block(s, cfg["blk_k"])
+    f32 = 4
+
+    def col(rows):          # one (rows, d) block
+        return rows * d * f32
+
+    def vec(rows):          # one (rows, 1) block
+        return rows * f32
+
+    # fwd: q + k + v [+ mask] in, out + lse out; scratch m/l/acc
+    fwd = 2 * (col(bq) + 2 * col(bk)
+               + (col(bq) if cfg.get("drop") else 0)
+               + col(bq) + vec(bq)) + 2 * vec(bq) + col(bq)
+    # dQ: q/do + k/v + lse/di in, dq out; scratch dq accumulator
+    dq = 2 * (2 * col(bq) + 2 * col(bk) + 2 * vec(bq)
+              + col(bq)) + col(bq)
+    # dK/dV (transposed grid): q/do + k/v + lse/di in, dk + dv out;
+    # scratch dk/dv accumulators
+    dkv = 2 * (2 * col(bq) + 2 * col(bk) + 2 * vec(bq)
+               + 2 * col(bk)) + 2 * col(bk)
+    return max(fwd, dq, dkv)
 
 
 def _flash_bench(apply, repeats):
@@ -558,6 +627,7 @@ register_template(KernelTemplate(
     build=_flash_build,
     seed={"blk_q": 512, "blk_k": 1024, "kv_order": "fwd", "drop": 0},
     bench_key=_flash_bench_key, fuse_axis="drop",
+    vmem_footprint=_flash_vmem,
     doc="blocked flash attention over blk_q x blk_k x streaming order "
         "x dropout-epilogue fusion (hand incumbent: 512/1024/fwd, "
         "unfused, tuned v5e 2026-07-29)"))
@@ -649,12 +719,20 @@ def _sgd_bench(apply, repeats):
     return _time_jitted(step, (tree,), repeats)
 
 
+def _sgd_vmem(cfg, shapes, dtype):
+    """One (rt, 128) f32 block per buffer: 3 inputs (p, g, v) + 2
+    outputs, double-buffered (the SMEM scalar vector is negligible)."""
+    from veles_tpu.ops import pallas_kernels as pk
+    rt = max(pk._MIN_ROW_TILE, cfg["rt"])
+    return 2 * 5 * rt * pk._LANE * 4
+
+
 register_template(KernelTemplate(
     op="sgd_update", base="pallas_rows",
     axes=(Axis("rt", (8, 16, 32, 64, 128, 256, 512, 1024),
                doc="rows per program of the flattened (rows, 128) "
                    "update grid"),),
-    build=_sgd_pallas_build, seed={"rt": 8},
+    build=_sgd_pallas_build, seed={"rt": 8}, vmem_footprint=_sgd_vmem,
     doc="fused SGD+momentum+weight-decay update (one VMEM pass over 3 "
         "buffers) over its row blocking; the hand-written kernel froze "
         "rt=8"))
@@ -1093,6 +1171,33 @@ def _lrn_pool_bench_key(cfg):
     return ("composed",) if not cfg["fuse"] else (cfg["rt"], cfg["io"])
 
 
+def _lrn_pool_vmem(cfg, shapes, dtype):
+    """Fused points block whole (rt, H, W, C) sample bands; the
+    backward is the worst direction (x + g in, dx out) and the kernel
+    additionally materializes the padded recomputed LRN output plus the
+    first-max routing mask in f32 — modeled as temporaries on top of
+    the double-buffered refs. Composed points trace XLA: zero Pallas
+    footprint."""
+    if not cfg["fuse"]:
+        return 0
+    _, h0, w0, c0 = (8, 13, 13, 16) if _on_cpu() else (256, 55, 55, 96)
+    h = int(shapes.get("h") or h0)
+    w = int(shapes.get("w") or w0)
+    c = int(shapes.get("c") or c0)
+    ky, kx = shapes.get("ksize") or (3, 3)
+    sy, sx = shapes.get("stride") or (2, 2)
+    from veles_tpu.ops.pallas_kernels import _pool_out_hw
+    oh, ow = _pool_out_hw(h, w, ky, kx, sy, sx)
+    wd = 4 if cfg["io"] == "f32" else _dtype_width(dtype)
+    rt = cfg["rt"]
+    in_b = rt * h * w * c * wd
+    out_b = rt * oh * ow * c * wd
+    # padded recompute canvas (hp, wp) + the int32 routing mask
+    hp, wp = (oh - 1) * sy + ky, (ow - 1) * sx + kx
+    tmp = rt * hp * wp * c * 4 + rt * oh * ow * c * 4
+    return 2 * (2 * in_b + out_b) + tmp
+
+
 register_template(KernelTemplate(
     op="lrn_maxpool", base="fused",
     axes=(Axis("rt", (1, 2, 4, 8),
@@ -1109,7 +1214,7 @@ register_template(KernelTemplate(
     build=_lrn_pool_build,
     seed={"rt": 2, "io": "native", "fuse": 0},
     bench_key=_lrn_pool_bench_key, fuse_axis="fuse",
-    fuses=("lrn", "maxpool"),
+    fuses=("lrn", "maxpool"), vmem_footprint=_lrn_pool_vmem,
     doc="searched cross-op fusion of the (lrn, maxpool) unit pair — "
         "sample tile x staging dtype x fuse on/off, every point gated "
         "on the composed golden"))

@@ -359,6 +359,46 @@ class FusedTrainStep:
                     n_shards=n)
         return acct
 
+    def resource_profile(self) -> Dict[str, Any]:
+        """Static per-device byte model of this step's persistent state
+        (analysis pass 6, analysis/resources.py): params (modeled
+        replicated over the data axis — exact for local/dp, an
+        over-count under gspmd TP sharding, a documented blind spot),
+        the transient full-size per-shard gradient, the optimizer flat
+        vectors under the ZeRO plan (1/N per device, pad included) and
+        the optional error-feedback residual slot. Host shapes only —
+        no device allocation, callable before any compile."""
+        from veles_tpu.parallel.mesh import zero_plan_local_elems
+        n = (self.mesh.shape.get(DATA_AXIS, 1)
+             if self.mesh is not None else 1)
+        params = 0
+        per_layer: List[int] = []
+        for u in self.forwards:
+            lb = 0
+            for a in u.param_arrays().values():
+                if a:
+                    arr = np.asarray(a.mem)
+                    lb += int(arr.size) * arr.itemsize
+            per_layer.append(lb)
+            params += lb
+        if self.zero_active:
+            opt = sum(
+                zero_plan_local_elems(plan)
+                * (2 if isinstance(cfg, optim.AdamConfig) else 1) * 4
+                for plan, cfg in zip(self.zero_plans(), self.cfgs))
+            ef = 0
+            if self.ef_active():
+                ef = sum(rl for lens in self.ef_lens()
+                         for rl in lens.values()) * 4
+        else:
+            opt = sum(
+                lb * (2 if isinstance(cfg, optim.AdamConfig) else 1)
+                for lb, cfg in zip(per_layer, self.cfgs))
+            ef = 0
+        return {"n_data_shards": n, "params_bytes": params,
+                "grads_bytes": params, "optimizer_state_bytes": opt,
+                "ef_bytes": ef, "zero_active": self.zero_active}
+
     def optimizer_state_bytes(self, state) -> Dict[int, int]:
         """{device_id: bytes} the optimizer-state pytree (state["vel"])
         occupies per device — the measured form of the ZeRO memory claim

@@ -55,6 +55,42 @@ def bytes_per_device(arrays) -> Dict[int, int]:
     return _attribute(arrays)[0]
 
 
+def _backend_ready() -> bool:
+    """True when jax is imported AND a backend has been created — the
+    shared never-initializes guard (see device_memory_stats)."""
+    if "jax" not in sys.modules:
+        return False
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not hasattr(xb, "_backends"):
+        return False
+    return bool(xb._backends)
+
+
+def device_memory_limits() -> Optional[Dict[str, int]]:
+    """{device_id: bytes_limit} where the backend's allocator reports
+    one (TPU) — the denominator of every static-HBM-model comparison
+    (analysis pass 6: the Launcher pre-flight, --verify-workflow=
+    resources, the serving capacity hint). None on CPU (no allocator
+    limit) and in backendless processes; same never-initializes
+    contract as device_memory_stats."""
+    if not _backend_ready():
+        return None
+    import jax
+    out: Dict[str, int] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backendless process: no limits
+        return None
+    for dev in devices:
+        try:
+            ms = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without stats
+            ms = None
+        if ms and "bytes_limit" in ms:
+            out[str(dev.id)] = int(ms["bytes_limit"])
+    return out or None
+
+
 def device_memory_stats() -> Optional[Dict[str, Any]]:
     """Compact per-device memory snapshot, or None when jax is not
     even imported — or imported but no backend has been CREATED yet —

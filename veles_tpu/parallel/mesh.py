@@ -101,6 +101,21 @@ def zero_unflatten(flat, leaf: ZeroLeaf):
     return flat[:leaf.size].reshape(leaf.shape)
 
 
+def zero_plan_local_elems(plan: Any) -> int:
+    """Per-SHARD element count of one layer's update-sharding plan: the
+    sum of local slice lengths (pad included — the plan's own remainder
+    rule). The static half of the ZeRO memory claim: optimizer-state
+    bytes/device = local elems x slots x itemsize, consumed by the
+    resource analyzer (analysis pass 6) so its prediction and the
+    traced state geometry can never use two different rules."""
+    total = 0
+    for lp in jax.tree_util.tree_leaves(
+            plan, is_leaf=lambda x: isinstance(x, ZeroLeaf)):
+        if isinstance(lp, ZeroLeaf):
+            total += lp.local
+    return total
+
+
 def zero_ef_plan(plan: Any, resid_len) -> Any:
     """The OPTIONAL error-feedback slot of the update-sharding plan
     (ISSUE 12 / EQuARX, arxiv 2506.17615): map every ZeroLeaf of a

@@ -95,6 +95,36 @@ def read_heartbeat(path: str) -> Dict[str, Any]:
         return {"epoch": -1, "ts": 0.0}
 
 
+def memory_delta(mem: Optional[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Predicted-vs-measured per-device memory delta from one heartbeat
+    `mem` payload (analysis pass 6: the child's pre-flight prediction
+    rides the beat next to the memstats snapshot). Pairs like with
+    like: the allocator PEAK (TPU) against the predicted high-water,
+    else the live-array resident set (CPU meshes) against the predicted
+    resident bytes. None when either side is missing — the report must
+    never fabricate a comparison."""
+    if not isinstance(mem, dict):
+        return None
+    pred = mem.get("predicted")
+    if not isinstance(pred, dict):
+        return None
+    measured = mem.get("peak_bytes_max")
+    predicted = pred.get("highwater_per_device")
+    basis = "peak_vs_highwater"
+    if measured is None:
+        measured = mem.get("live_bytes_max")
+        predicted = pred.get("resident_per_device")
+        basis = "live_vs_resident"
+    if not measured or predicted is None:
+        return None
+    return {"predicted_per_device": int(predicted),
+            "measured_per_device": int(measured),
+            "delta_frac": round((int(predicted) - int(measured))
+                                / int(measured), 4),
+            "basis": basis}
+
+
 def strip_flags(argv: Sequence[str],
                 flags: Dict[str, bool]) -> List[str]:
     """Remove flag occurrences from a command line. `flags` maps flag
@@ -412,6 +442,14 @@ class Supervisor(Logger):
                         report_obj[key] = dict(a[key])
                         report_obj[key]["from_attempt"] = a.get("attempt")
                         break
+            # predicted-vs-measured memory delta (analysis pass 6,
+            # ISSUE 14), promoted alongside "mem": the child's beat
+            # carries the pre-flight prediction next to the measured
+            # snapshot — the scheduler-facing answer to "was the
+            # static HBM model right for the run that just ended"
+            delta = memory_delta(report_obj.get("mem"))
+            if delta is not None:
+                report_obj["memory"] = delta
             try:
                 # the supervisor's OWN registry view (restarts,
                 # generation) — one producer with the child's promoted

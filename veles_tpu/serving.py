@@ -64,6 +64,11 @@ class RequestTimeout(RuntimeError):
     """A queued request missed request_timeout_s."""
 
 
+#: sentinel for the lazily-computed capacity hint (None is a valid
+#: "hint unavailable" value, so absence needs its own marker)
+_UNSET = object()
+
+
 class InferenceServer(Logger):
     """Serve a trained workflow's forward pass over HTTP."""
 
@@ -108,6 +113,9 @@ class InferenceServer(Logger):
         #: requests shed with 503 (overload + drain) / timed out
         self.n_rejected = 0
         self.n_timeouts = 0
+        #: lazily computed /healthz capacity hint (analysis pass 6);
+        #: _UNSET -> computed once on first health() call
+        self._capacity: Any = _UNSET
         # telemetry plane: serving admission/latency ride the ONE
         # process registry (telemetry/metrics.py) behind GET /metrics;
         # instruments are pre-bound here (the hot request path never
@@ -343,9 +351,29 @@ class InferenceServer(Logger):
             for it in take:
                 it["done"].set()
 
+    def _capacity_hint(self) -> Optional[Dict[str, Any]]:
+        """Static capacity-planning hint (analysis pass 6, ROADMAP
+        direction 2): model bytes + a per-max_batch forward activation
+        estimate vs the device limit. Computed ONCE from host shapes —
+        /healthz stays cheap — and guarded: a hint must never break
+        liveness reporting."""
+        if self._capacity is not _UNSET:
+            return self._capacity
+        try:
+            from veles_tpu.analysis.resources import serving_capacity
+            self._capacity = serving_capacity(self.workflow,
+                                              self.max_batch)
+        except Exception as e:  # noqa: BLE001 — hint, not health
+            self.debug("serving capacity hint unavailable: %s", e)
+            self._capacity = None
+        return self._capacity
+
     def health(self) -> Dict[str, Any]:
         """/healthz payload: liveness + the dispatch counters an
-        operator needs to see a batching/overload problem at a glance."""
+        operator needs to see a batching/overload problem at a glance,
+        plus the static capacity hint (predicted model/batch bytes and
+        how many batch rings fit the device — the load balancer's
+        replica-sizing input)."""
         with self._cv:
             status = "draining" if (self._draining or self._stopping) \
                 else "ok"
@@ -357,7 +385,8 @@ class InferenceServer(Logger):
                     "n_rejected": self.n_rejected,
                     "n_timeouts": self.n_timeouts,
                     "queue_limit": self.queue_limit,
-                    "max_batch": self.max_batch}
+                    "max_batch": self.max_batch,
+                    "capacity": self._capacity_hint()}
 
     def model_info(self) -> Dict[str, Any]:
         wf = self.workflow

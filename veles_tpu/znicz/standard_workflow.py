@@ -263,6 +263,10 @@ class StandardWorkflow(Workflow):
         # digest-keyed idempotent push relies on (resilience/mirror.py)
         d.pop("device_feed", None)
         d.pop("feed_stats", None)
+        # ditto the pre-flight prediction (analysis pass 6): it embeds
+        # the HOST's device limit, which must not leak into a snapshot
+        # another host restores
+        d.pop("resource_report", None)
         return d
 
     def initialize(self, device=None, **kwargs: Any) -> None:
@@ -424,6 +428,24 @@ class StandardWorkflow(Workflow):
         correct) — and each FeedBatch's Decision metadata is replayed
         onto the loader, so the epoch bookkeeping below is unchanged
         from the synchronous loop it replaces."""
+        # static resource pre-flight (analysis pass 6, docs/ANALYSIS.md
+        # — ISSUE 14): predict the per-device HBM footprint BEFORE the
+        # first compile. The cheap resident model always runs (it rides
+        # the heartbeat, so the supervisor reports predicted-vs-
+        # measured); the traced high-water walk + limit comparison run
+        # only when a device limit is known (TPU) — warn above 80%,
+        # refuse above it with a per-component byte breakdown instead
+        # of OOMing minutes into the compile.
+        from veles_tpu.analysis import resources as _resources
+        try:
+            self.resource_report = _resources.preflight(
+                self, step, feed_ahead=feed_ahead)
+        except _resources.ResourcePreflightError:
+            raise
+        except Exception as e:  # noqa: BLE001 — an estimate must never
+            # kill a run the measurement machinery exists to observe
+            self.debug("resource pre-flight unavailable: %s", e)
+            self.resource_report = None
         if accum_steps and accum_steps > 1:
             import types
             base = step
