@@ -443,11 +443,15 @@ def test_ring_path_traces_selected_point(eight_devices, monkeypatch):
 def test_templates_cover_whole_registry_but_dropout():
     """maxpool/conv_stem were the last registry ops with no generated
     axes; dropout stays resolution-only by design (its variants differ
-    by RNG stream, not by a tunable config space)."""
+    by RNG stream, not by a tunable config space), and serve_forward
+    (ISSUE 15) is a closed named wire family the SERVING tier gates
+    through the ledger — it carries a contract but no searched space
+    or bench (there is nothing to time outside a serving round)."""
     covered = set(templates.template_ops())
-    assert covered == set(variants.ops()) - {"dropout"}
+    assert covered == set(variants.ops()) - {"dropout", "serve_forward"}
     for op in covered:
         assert op in templates.CONTRACTS and op in templates.BENCHES
+    assert "serve_forward" in templates.CONTRACTS
 
 
 @pytest.mark.parametrize("op,name", [

@@ -256,35 +256,46 @@ def test_inference_server_serves_trained_model():
         except urllib.error.HTTPError as e:
             assert e.code == 400
 
-        # CONCURRENT requests coalesce into fewer forward dispatches
-        # (demand-driven micro-batching) and every caller still gets its
-        # own correct rows back. Deterministic: hold the dispatch lock so
-        # the batcher blocks in its first forward while the rest queue —
-        # they MUST merge into at most one more dispatch.
+        # CONCURRENT requests coalesce into fewer dispatched rounds
+        # (continuous batching on the slot ring) and every caller still
+        # gets its own correct rows back. Deterministic: stall the
+        # ring's in-flight round so the rest queue — they MUST merge
+        # into at most one more round.
         import threading as _thr
         base = srv.n_dispatches
         results = {}
+        release = _thr.Event()
+        orig_fn = srv._fn
+
+        def slow_fn(p, xb):
+            release.wait(10)
+            return orig_fn(p, xb)
+
+        srv._fn = slow_fn
 
         def submit(i):
             results[i] = srv._predict_batched(
                 np.asarray(x[i:i + 2], np.float32))
 
-        with srv._lock:
-            threads = [_thr.Thread(target=submit, args=(i,))
-                       for i in range(4)]
+        threads = [_thr.Thread(target=submit, args=(i,))
+                   for i in range(4)]
+        try:
             for t in threads:
                 t.start()
             deadline = __import__("time").time() + 2.0
-            # wait until every request is enqueued (or already taken by
-            # the blocked batcher round)
+            # wait until round 1 is issued (stalled inside slow_fn) and
+            # the remaining requests are queued behind it
             while __import__("time").time() < deadline:
                 with srv._cv:
                     n_queued = sum(len(it["x"]) for it in srv._pending)
-                if n_queued + 2 >= 8:   # first round took >= 1 request
+                if srv.n_dispatches - base >= 1 and n_queued + 2 >= 8:
                     break
                 __import__("time").sleep(0.01)
-        for t in threads:
-            t.join(timeout=30)
+        finally:
+            release.set()
+            for t in threads:
+                t.join(timeout=30)
+            srv._fn = orig_fn
         assert srv.n_dispatches - base <= 2, (srv.n_dispatches, base)
         for i in range(4):
             got = np.asarray(results[i]).reshape(2, -1)

@@ -1,0 +1,551 @@
+"""The production serving tier (ISSUE 15): continuous batching on the
+device-resident slot ring, GSPMD-sharded forward under the trainer's
+plan, AOT-persisted executables, quantized serving wires behind the
+equivalence ledger, and the loadtest record schema."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _make_workflow(width=24, sample=10, n_classes=4, name="RingWF",
+                   seed=41, train=False):
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.seed_all(seed)
+    loader = SyntheticClassifierLoader(
+        n_classes=n_classes, sample_shape=(sample,), n_validation=40,
+        n_train=160, minibatch_size=40, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": width,
+                 "weights_stddev": 0.1},
+                {"type": "softmax", "output_sample_shape": n_classes,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=n_classes,
+        decision_config={"max_epochs": 2, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name=name)
+    if train:
+        wf.run_fused()
+    else:
+        wf.initialize(device=None)
+    return wf
+
+
+@pytest.fixture(scope="module")
+def ring_wf():
+    return _make_workflow(train=True)
+
+
+def _server(wf, tmp_path=None, **kw):
+    from veles_tpu.serving import InferenceServer
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("aot_cache",
+                  str(tmp_path / "aot.json") if tmp_path else False)
+    return InferenceServer(wf, **kw)
+
+
+def _post(url, rows, timeout=30):
+    req = json.dumps({"inputs": rows}).encode()
+    try:
+        with urllib.request.urlopen(urllib.request.Request(
+                url + "/predict", data=req,
+                headers={"Content-Type": "application/json"}),
+                timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+# -- the ring core ----------------------------------------------------------
+
+
+def test_ring_serves_http_and_counts_rounds(ring_wf):
+    srv = _server(ring_wf).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        x = ring_wf.loader.data.mem[:8]
+        status, resp, _ = _post(url, x.tolist())
+        assert status == 200
+        assert len(resp["outputs"]) == 8
+        assert len(resp["classes"]) == 8
+        h = srv.health()
+        assert h["dispatch"] == "ring"
+        assert h["ring_slots"] == 16
+        assert h["n_dispatches"] >= 1
+        assert h["round_latency_s"] > 0
+        info = srv.model_info()
+        assert info["sharded"] is True       # the 8-device CPU mesh
+        assert info["aot"]["source"] in ("compile", "cache")
+    finally:
+        srv.stop(drain_s=0)
+
+
+def test_ring_output_equals_single_device_forward(ring_wf):
+    """Acceptance: the sharded ring forward equals the single-device
+    forward at rtol 1e-5."""
+    sharded = _server(ring_wf, mesh="auto")
+    local = _server(ring_wf, mesh="off")
+    merge = _server(ring_wf, dispatch="merge")
+    x = ring_wf.loader.data.mem[:8]
+    a = np.asarray(sharded.predict(x)["outputs"])
+    b = np.asarray(local.predict(x)["outputs"])
+    c = np.asarray(merge.predict(x)["outputs"])
+    assert sharded.model_info()["sharded"] is True
+    assert local.model_info()["sharded"] is False
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
+
+
+def test_ring_plan_is_the_trainers_and_audits_clean(ring_wf):
+    """Acceptance: the served forward traces under the trainer's
+    NamedSharding plan — the serve plan's input spec IS
+    input_put_specs()[0], and the jaxpr auditor's sharding-mismatch
+    pass over the serving step finds nothing."""
+    from veles_tpu.analysis.trace import audit_serving
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    srv = _server(ring_wf)
+    plan = srv._plan
+    assert plan["mesh"] is not None
+    assert tuple(plan["x_spec"]) == tuple(
+        srv._step.input_put_specs()[0])
+    assert tuple(plan["x_spec"]) == (DATA_AXIS,)
+    assert audit_serving(srv) == []
+    # and the audit actually bites: a forged ring that cannot lay out
+    # under the plan is an error
+    srv._ring_slots = 3     # not divisible by the 8-shard data axis
+    finds = audit_serving(srv)
+    assert any(f.rule == "sharding-mismatch" for f in finds)
+
+
+def test_ring_occupancy_and_queue_metrics_flow(ring_wf):
+    from veles_tpu.telemetry import metrics as tm
+    reg = tm.default_registry()
+    occ = reg.histogram("veles_serving_ring_occupancy")
+    before = occ._children[()].count
+    srv = _server(ring_wf)
+    srv.predict(ring_wf.loader.data.mem[:5])
+    assert occ._children[()].count == before + 1
+    # the exposition carries both new families (register_standard)
+    expo = reg.exposition()
+    assert "veles_serving_ring_occupancy_bucket" in expo
+    assert "veles_serving_queue_depth" in expo
+
+
+def test_ring_slots_frozen_max_batch_live(ring_wf):
+    """Satellite: the merge window AND max_batch are live-tunable per
+    round; the ring geometry is NOT — it is baked into the compiled
+    executable's shape, so the property is read-only and admission
+    clamps to it."""
+    srv = _server(ring_wf)
+    with pytest.raises(AttributeError):
+        srv.ring_slots = 99
+    # max_batch stays live but is clamped by the frozen ring
+    srv.max_batch = 64
+    with pytest.raises(ValueError, match="max_batch"):
+        srv.predict(np.zeros((17, 10), np.float32))
+    # merge mode: max_batch raise is honored live (a 17-row request is
+    # admitted once the live knob allows it)
+    m = _server(ring_wf, dispatch="merge")
+    with pytest.raises(ValueError):
+        m.predict(np.zeros((17, 10), np.float32))
+    m.max_batch = 32
+    assert len(m.predict(np.zeros((17, 10), np.float32))["outputs"]) \
+        == 17
+
+
+def test_ring_overload_sheds_with_retry_after(ring_wf, tmp_path):
+    """Satellite: ring full + queue at bound -> 503 with a Retry-After
+    derived from the measured per-round latency, not a queue-into-
+    timeout."""
+    srv = _server(ring_wf, tmp_path=tmp_path, queue_limit=2).start()
+    release = threading.Event()
+    orig_fn = srv._fn
+
+    def slow_fn(p, x):
+        release.wait(10)
+        return orig_fn(p, x)
+
+    url = f"http://127.0.0.1:{srv.port}"
+    rows = np.zeros((2, 10), np.float32).tolist()
+    results = []
+    threads = []
+
+    def client():
+        results.append(_post(url, rows))
+
+    try:
+        srv.predict(np.zeros((1, 10), np.float32))  # seed the EWMA
+        srv._fn = slow_fn
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 5
+        while srv._inflight < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        status, payload, headers = _post(url, rows)
+        assert status == 503
+        assert "overloaded" in payload["error"]
+        assert payload["retry_after_s"] > 0
+        assert int(headers["Retry-After"]) >= 1
+        assert srv.health()["retry_after_s"] is not None
+    finally:
+        release.set()
+        for t in threads:
+            t.join(timeout=15)
+        srv.stop(drain_s=0)
+    assert sorted(r[0] for r in results) == [200, 200]
+
+
+# -- AOT persistence --------------------------------------------------------
+
+
+def test_aot_second_start_skips_compile(ring_wf, tmp_path):
+    """Acceptance: a second server start on the same (model, mesh,
+    ring shape) deserializes the persisted executable — zero
+    compiles."""
+    path = str(tmp_path / "aot.json")
+    a = _server(ring_wf, aot_cache=path)
+    assert (a.aot_source, a.aot_compiles) == ("compile", 1)
+    b = _server(ring_wf, aot_cache=path)
+    assert (b.aot_source, b.aot_compiles) == ("cache", 0)
+    x = ring_wf.loader.data.mem[:4]
+    np.testing.assert_allclose(
+        np.asarray(a.predict(x)["outputs"]),
+        np.asarray(b.predict(x)["outputs"]), rtol=1e-6)
+    # a DIFFERENT ring shape is a different executable — compile again
+    c = _server(ring_wf, aot_cache=path, ring_slots=32)
+    assert (c.aot_source, c.aot_compiles) == ("compile", 1)
+
+
+def test_aot_corrupt_blob_degrades_to_recompile(ring_wf, tmp_path):
+    """Satellite: corrupt/truncated artifact -> ONE warning, recompile,
+    server still starts (the autotune-cache discipline)."""
+    path = str(tmp_path / "aot.json")
+    _server(ring_wf, aot_cache=path)
+    idx = json.load(open(path))
+    (key, entry), = idx["entries"].items()
+    with open(entry["file"], "wb") as f:
+        f.write(b"garbage not an executable")
+    logs = []
+    import logging
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            logs.append(record.getMessage())
+
+    h = Capture()
+    # the "veles" logger does not propagate to root — attach there
+    logging.getLogger("veles").addHandler(h)
+    try:
+        b = _server(ring_wf, aot_cache=path)
+    finally:
+        logging.getLogger("veles").removeHandler(h)
+    assert (b.aot_source, b.aot_compiles) == ("compile", 1)
+    corrupt = [m for m in logs if "corrupt" in m or "recompiling" in m]
+    assert len(corrupt) == 1
+    # the fresh compile re-persisted a good blob: next start loads it
+    c = _server(ring_wf, aot_cache=path)
+    assert c.aot_source == "cache"
+
+
+def test_aot_index_schema_skew_rebuilds(ring_wf, tmp_path):
+    path = str(tmp_path / "aot.json")
+    _server(ring_wf, aot_cache=path)
+    # truncated index
+    with open(path, "w") as f:
+        f.write('{"schema": "veles-serving-aot", "ver')
+    b = _server(ring_wf, aot_cache=path)
+    assert b.aot_source == "compile"
+    # version skew
+    idx = json.load(open(path))
+    idx["version"] = 999
+    json.dump(idx, open(path, "w"))
+    c = _server(ring_wf, aot_cache=path)
+    assert c.aot_source == "compile"
+
+
+def test_aot_mesh_geometry_change_refuses_stale(ring_wf, tmp_path):
+    """Satellite: an artifact whose STORED signature disagrees with the
+    requested (model, mesh, ring) build is refused, never executed —
+    the stale-geometry case."""
+    from veles_tpu.serving_aot import ServingAotCache
+    path = str(tmp_path / "aot.json")
+    a = _server(ring_wf, aot_cache=path)
+    idx = json.load(open(path))
+    (key, entry), = idx["entries"].items()
+    # forge: same key, stale geometry in the stored signature
+    entry["signature"]["mesh"] = {"axes": {"data": 2, "seq": 1,
+                                           "model": 1},
+                                  "n_devices": 2, "device_kind": "cpu"}
+    json.dump(idx, open(path, "w"))
+    cache = ServingAotCache(path)
+    assert cache.load(a._aot_signature, None, None) is None
+    b = _server(ring_wf, aot_cache=path)
+    assert (b.aot_source, b.aot_compiles) == ("compile", 1)
+
+
+# -- quantized serving wires ------------------------------------------------
+
+
+def test_serve_forward_variants_pass_the_ledger():
+    from veles_tpu.ops import templates
+    for name in ("f32", "bf16", "int8"):
+        rec = templates.check_equivalence("serve_forward", name)
+        assert rec["status"] == "pass", (name, rec)
+
+
+def test_quantized_refused_unserved_without_passing_record(ring_wf):
+    """Acceptance: a quantized serving variant with no passing ledger
+    record must be REFUSED, not served."""
+    from veles_tpu.ops import templates
+    key = ("serve_forward", "bf16")
+    prev = templates._LEDGER.get(key)
+    templates._LEDGER[key] = {"status": "fail", "error": "forced"}
+    try:
+        with pytest.raises(ValueError, match="refused unserved"):
+            _server(ring_wf, quantize="bf16")
+    finally:
+        if prev is None:
+            templates._LEDGER.pop(key, None)
+        else:
+            templates._LEDGER[key] = prev
+
+
+def test_quantized_wires_serve_close_to_f32(tmp_path):
+    """bf16 + int8 rings serve within the contract tolerance of the
+    f32 forward of the REAL model; the wire actually shrinks params
+    (the width is >= the int8 block so quantization applies)."""
+    wf = _make_workflow(width=96, name="QuantWF", seed=43, train=False)
+    f32 = _server(wf)
+    x = np.asarray(wf.loader.data.mem[:8], np.float32)
+    want = np.asarray(f32.predict(x)["outputs"])
+    for q in ("bf16", "int8"):
+        srv = _server(wf, quantize=q)
+        got = np.asarray(srv.predict(x)["outputs"])
+        np.testing.assert_allclose(got, want, atol=5e-2)
+        info = srv.model_info()
+        assert info["quantize"] == q
+        assert info["param_bytes"]["wire"] \
+            < info["param_bytes"]["f32"]
+
+
+def test_quantize_needs_ring_dispatch(ring_wf):
+    with pytest.raises(ValueError, match="ring"):
+        _server(ring_wf, dispatch="merge", quantize="int8")
+
+
+# -- loadtest ---------------------------------------------------------------
+
+
+def _load_loadtest():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "veles_loadtest", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "loadtest.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadtest_smoke_record_schema_and_registry(tmp_path):
+    """Satellite: `tools/loadtest.py --smoke` (tiny budget, loopback)
+    asserts the record schema and that p50/p99/throughput reached the
+    metrics registry (percentiles are READ BACK from the histogram)."""
+    lt = _load_loadtest()
+    record_path = str(tmp_path / "LOADTEST_RECORD.json")
+    rc = lt.main(["--smoke", "--record", record_path])
+    assert rc == 0
+    rec = json.load(open(record_path))
+    assert rec["schema"] == "veles-loadtest"
+    assert rec["version"] == 1
+    assert rec["status"] == "ok"
+    (leg,) = rec["legs"].values()
+    assert leg["ok"] > 0
+    assert leg["throughput_rps"] > 0
+    assert leg["p50_s"] is not None and leg["p99_s"] is not None
+    assert leg["p99_s"] >= leg["p50_s"]
+    # the registry carries the loadtest families (read-back contract)
+    from veles_tpu.telemetry import metrics as tm
+    reg = tm.default_registry()
+    fam = reg.histogram("veles_loadtest_latency_seconds",
+                        labelnames=("leg",))
+    q = tm.histogram_quantile(fam, 0.99, leg=leg["leg"])
+    assert q is not None
+    assert any(ln.startswith("veles_loadtest_requests_total")
+               for ln in rec["registry"])
+    assert any(ln.startswith("veles_loadtest_latency_seconds_bucket")
+               for ln in rec["registry"])
+
+
+def test_histogram_quantile_reads_back():
+    from veles_tpu.telemetry.metrics import (MetricsRegistry,
+                                             histogram_quantile)
+    reg = MetricsRegistry()
+    fam = reg.histogram("t_h", buckets=(0.1, 1.0, 10.0))
+    assert histogram_quantile(fam, 0.5) is None
+    for v in (0.05,) * 50 + (0.5,) * 40 + (5.0,) * 10:
+        fam.observe(v)
+    p50 = histogram_quantile(fam, 0.50)
+    p99 = histogram_quantile(fam, 0.99)
+    assert 0 < p50 <= 0.1          # the 50th obs sits in bucket 1
+    assert 1.0 < p99 <= 10.0       # the 99th in the last finite bucket
+    with pytest.raises(TypeError):
+        histogram_quantile(reg.gauge("t_g"), 0.5)
+
+
+@pytest.mark.slow
+def test_loadtest_ab_slo_ring_3x_merge():
+    """Acceptance (slow): the continuous-batching ring sustains >= 3x
+    the pre-ring merge-per-round throughput at equal-or-better p99
+    under open-loop poisson arrivals on the 8-device CPU mesh."""
+    lt = _load_loadtest()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        rc = lt.main([
+            "--ab", "--rate", "420", "--duration", "10",
+            "--rows", "64", "--batch", "64", "--ring", "512",
+            "--depth", "16", "--width", "1024", "--sample", "4",
+            "--queue-limit", "24", "--workers", "64", "--repeats", "2",
+            "--min-speedup", "3.0", "--max-p99-ratio", "1.0",
+            "--record", f"{td}/rec.json"])
+        rec = json.load(open(f"{td}/rec.json"))
+        assert rc == 0, rec
+        assert rec["speedup"] >= 3.0
+        assert rec["p99_ratio"] <= 1.0
+
+
+# -- CLI / launcher knobs ---------------------------------------------------
+
+
+def test_serve_knobs_require_serve():
+    from veles_tpu.launcher import Launcher
+    for kw in ({"serve_ring": 64}, {"serve_dispatch": "merge"},
+               {"serve_quantize": "int8"}, {"serve_mesh": "off"},
+               {"serve_batch": 32}):
+        with pytest.raises(SystemExit):
+            Launcher(**kw)
+    ln = Launcher(serve=0, serve_ring=128, serve_dispatch="ring",
+                  serve_quantize="bf16", serve_mesh="auto",
+                  serve_batch=32)
+    assert (ln.serve_ring, ln.serve_quantize) == (128, "bf16")
+    with pytest.raises(SystemExit):
+        Launcher(serve=0, serve_ring=0)
+
+
+def test_serve_cli_parser_accepts_knobs():
+    from veles_tpu.__main__ import build_parser
+    args = build_parser().parse_args(
+        ["wf.py", "--serve", "--serve-ring", "256", "--serve-dispatch",
+         "ring", "--serve-quantize", "int8", "--serve-mesh", "on",
+         "--serve-batch", "64"])
+    assert args.serve == 0
+    assert args.serve_ring == 256
+    assert args.serve_quantize == "int8"
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(
+            ["wf.py", "--serve", "--serve-dispatch", "bogus"])
+
+
+# -- review-pass regressions ------------------------------------------------
+
+
+def test_init_validation_rejects_bad_knobs(ring_wf):
+    from veles_tpu.serving import InferenceServer
+    with pytest.raises(ValueError, match="ring_slots"):
+        InferenceServer(ring_wf, ring_slots=0, aot_cache=False)
+    with pytest.raises(ValueError, match="quantize"):
+        InferenceServer(ring_wf, quantize="int4", aot_cache=False)
+    with pytest.raises(ValueError, match="dispatch"):
+        InferenceServer(ring_wf, dispatch="bogus", aot_cache=False)
+
+
+def test_keepalive_reject_paths_do_not_desync(ring_wf):
+    """A reject path that answers while the request body is still
+    unread (413 here) must CLOSE the connection — otherwise the
+    leftover body bytes parse as the next request line and the
+    connection returns garbage 400s. The normal path keeps the
+    connection alive across requests."""
+    import http.client
+    srv = _server(ring_wf, max_body=64).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("POST", "/predict", b"x" * 128,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 413
+        ok_body = json.dumps(
+            {"inputs": np.zeros((1, 10)).tolist()}).encode()
+        desync = False
+        try:
+            conn.request("POST", "/predict", ok_body[:60],
+                         {"Content-Type": "application/json"})
+            r2 = conn.getresponse()
+            r2.read()
+            desync = r2.status == 400   # leftover bytes parsed as a
+            # request line — the bug this guards against
+        except (http.client.HTTPException, OSError):
+            pass    # server closed the connection: the clean outcome
+        conn.close()
+        assert not desync
+        # the normal path KEEPS the connection alive: two OK requests
+        # ride one connection
+        conn2 = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=10)
+        body = json.dumps(
+            {"inputs": np.zeros((1, 10)).tolist()}).encode()
+        for _ in range(2):
+            conn2.request("POST", "/predict", body,
+                          {"Content-Type": "application/json"})
+            r = conn2.getresponse()
+            r.read()
+            assert r.status == 200
+        conn2.close()
+    finally:
+        srv.stop(drain_s=0)
+
+
+def test_merge_rejects_ring_only_knobs(ring_wf):
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.serving import InferenceServer
+    with pytest.raises(ValueError, match="ring"):
+        InferenceServer(ring_wf, dispatch="merge", ring_slots=32,
+                        aot_cache=False)
+    with pytest.raises(ValueError, match="ring"):
+        InferenceServer(ring_wf, dispatch="merge", mesh="on",
+                        aot_cache=False)
+    # launcher twin: ring geometry validated at flag-parse time
+    with pytest.raises(SystemExit):
+        Launcher(serve=0, serve_ring=32, serve_batch=64)
+    with pytest.raises(SystemExit):
+        Launcher(serve=0, serve_ring=32)        # < the 64 default
+    with pytest.raises(SystemExit):
+        Launcher(serve=0, serve_ring=128, serve_dispatch="merge")
+
+
+def test_loadtest_ab_conflicts_with_ramp_and_url():
+    lt = _load_loadtest()
+    for extra in (["--ramp", "100:1"], ["--url", "http://x"]):
+        with pytest.raises(SystemExit):
+            lt.main(["--ab"] + extra)
+
+
+def test_launcher_merge_conflicts_at_flag_time():
+    from veles_tpu.launcher import Launcher
+    with pytest.raises(SystemExit):
+        Launcher(serve=0, serve_dispatch="merge", serve_quantize="int8")
+    with pytest.raises(SystemExit):
+        Launcher(serve=0, serve_dispatch="merge", serve_mesh="on")
+    # the benign combinations still construct
+    assert Launcher(serve=0, serve_dispatch="merge",
+                    serve_mesh="off").serve_dispatch == "merge"

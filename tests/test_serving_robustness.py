@@ -1,6 +1,12 @@
 """InferenceServer robustness: /healthz, bounded admission (503 on
 overload instead of unbounded queuing), per-request timeouts, and
-graceful drain on shutdown."""
+graceful drain on shutdown.
+
+Two dispatch cores since ISSUE 15: the module fixture pins the MERGE
+core (the pre-ring baseline these tests were written against — they
+stub `_forward_rows`, which only that core calls); the ring core's
+drain/stop/timeout story is covered below with `_fn`-level stubs (the
+one dispatch hook both the loop and the direct path share)."""
 
 import json
 import threading
@@ -35,7 +41,7 @@ def served():
         gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
         name="RobustServeWF")
     wf.run_fused()
-    srv = InferenceServer(wf, max_batch=16).start()
+    srv = InferenceServer(wf, max_batch=16, dispatch="merge").start()
     yield srv
     srv.stop(drain_s=0)
 
@@ -166,7 +172,7 @@ def test_graceful_drain_finishes_inflight_then_refuses():
         decision_config={"max_epochs": 1, "fail_iterations": 50},
         gd_config={"learning_rate": 0.1}, name="DrainWF")
     wf.run_fused()
-    srv = InferenceServer(wf, max_batch=8).start()
+    srv = InferenceServer(wf, max_batch=8, dispatch="merge").start()
     url = f"http://127.0.0.1:{srv.port}"
 
     release = threading.Event()
@@ -202,3 +208,147 @@ def test_graceful_drain_finishes_inflight_then_refuses():
     assert not stopper.is_alive()
     assert results and results[0][0] == 200   # drained, not dropped
     assert srv._httpd is None                 # listener actually closed
+
+
+# -- continuous-batching ring: drain/stop (ISSUE 15 satellite) --------------
+
+
+def _ring_server(max_batch=8, **kw):
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.serving import InferenceServer
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    prng.seed_all(44)
+    loader = SyntheticClassifierLoader(
+        n_classes=3, sample_shape=(6,), n_validation=30, n_train=60,
+        minibatch_size=30, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[{"type": "softmax", "output_sample_shape": 3,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=3,
+        decision_config={"max_epochs": 1, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1}, name="RingDrainWF")
+    wf.initialize(device=None)
+    kw.setdefault("aot_cache", False)
+    return InferenceServer(wf, max_batch=max_batch, dispatch="ring",
+                           **kw).start()
+
+
+def test_ring_stop_completes_resident_and_fails_queued_cleanly():
+    """A request RESIDENT IN A RING SLOT at stop() time completes (its
+    round is delivered before the loop exits); a queued-but-unadmitted
+    request gets a clean 'server stopping' 503 — NEITHER ever hangs on
+    done.wait()."""
+    srv = _ring_server()
+    url = f"http://127.0.0.1:{srv.port}"
+    release = threading.Event()
+    orig_fn = srv._fn
+
+    def slow_fn(p, x):
+        release.wait(10)
+        return orig_fn(p, x)
+
+    srv._fn = slow_fn
+    results = {}
+
+    def client(key):
+        results[key] = _post_predict(url, np.zeros((8, 6)).tolist())
+
+    t1 = threading.Thread(target=client, args=("resident",))
+    t1.start()
+    # resident: admitted into the ring and dispatched (the loop is now
+    # blocked inside the stalled round)
+    deadline = time.time() + 5
+    while srv.n_dispatches < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert srv.n_dispatches >= 1
+    # queued: a full-ring request that cannot join the stalled round
+    t2 = threading.Thread(target=client, args=("queued",))
+    t2.start()
+    deadline = time.time() + 5
+    while len(srv._pending) < 1 and time.time() < deadline:
+        time.sleep(0.01)
+
+    stopper = threading.Thread(target=lambda: srv.stop(drain_s=0.3))
+    stopper.start()
+    deadline = time.time() + 5
+    while not srv._stopping and time.time() < deadline:
+        time.sleep(0.01)
+    release.set()
+    t1.join(timeout=15)
+    t2.join(timeout=15)
+    stopper.join(timeout=15)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert not stopper.is_alive()
+    # the resident request COMPLETED; the queued one got the clean error
+    assert results["resident"][0] == 200
+    assert results["queued"][0] == 503
+    assert "stopping" in results["queued"][1]["error"]
+
+
+def test_ring_graceful_drain_completes_inflight():
+    """stop() with a generous drain bound: in-flight ring work lands
+    200, post-drain work is refused, the listener closes."""
+    srv = _ring_server()
+    url = f"http://127.0.0.1:{srv.port}"
+    release = threading.Event()
+    orig_fn = srv._fn
+
+    def slow_fn(p, x):
+        release.wait(10)
+        return orig_fn(p, x)
+
+    srv._fn = slow_fn
+    results = []
+    t = threading.Thread(target=lambda: results.append(
+        _post_predict(url, np.zeros((2, 6)).tolist())))
+    t.start()
+    deadline = time.time() + 5
+    while srv._inflight < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    stopper = threading.Thread(target=lambda: srv.stop(drain_s=10))
+    stopper.start()
+    deadline = time.time() + 5
+    while not srv._draining and time.time() < deadline:
+        time.sleep(0.01)
+    status, payload = _post_predict(url, np.zeros((1, 6)).tolist())
+    assert status == 503 and "draining" in payload["error"]
+    release.set()
+    t.join(timeout=15)
+    stopper.join(timeout=15)
+    assert not stopper.is_alive()
+    assert results and results[0][0] == 200
+    assert srv._httpd is None
+
+
+def test_ring_queued_request_timeout_is_clean():
+    """A request stuck in the ring queue past request_timeout_s is
+    answered 503 and dropped by the loop — never dispatched into a
+    round nobody reads... and never a hung wait."""
+    srv = _ring_server(request_timeout_s=0.3)
+    url = f"http://127.0.0.1:{srv.port}"
+    release = threading.Event()
+    orig_fn = srv._fn
+
+    def slow_fn(p, x):
+        release.wait(10)
+        return orig_fn(p, x)
+
+    srv._fn = slow_fn
+    first = []
+    t1 = threading.Thread(target=lambda: first.append(
+        _post_predict(url, np.zeros((8, 6)).tolist())))
+    try:
+        t1.start()
+        deadline = time.time() + 5
+        while srv.n_dispatches < 1 and time.time() < deadline:
+            time.sleep(0.01)     # first request stuck inside its round
+        status, payload = _post_predict(url, np.zeros((8, 6)).tolist())
+        assert status == 503
+        assert "timed out" in payload["error"]
+        assert srv.n_timeouts >= 1
+    finally:
+        release.set()
+        t1.join(timeout=15)
+        srv.stop(drain_s=0)

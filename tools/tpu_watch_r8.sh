@@ -35,6 +35,11 @@
 #      the CPU-mesh cross-op fusion A/B: composed vs fused lrn+maxpool
 #      Pallas point (compiled here, not interpret — the number that
 #      actually decides whether the fused winner ships as a default)
+#   9. tools/loadtest.py --ab              -> ISSUE 15 on-chip twin of
+#      the serving-tier A/B: continuous-batching ring (GSPMD-sharded,
+#      AOT-persisted) vs the pre-ring merge core under open-loop
+#      poisson arrivals — on chips the shards are real devices, so
+#      the committed CPU-mesh speedup is the floor, not the claim
 # Probe the flaky axon tunnel in a loop; the moment it answers, run the
 # queue in priority order, each timeout-bounded so one hang cannot eat
 # the warm window. Everything lands in tpu_watch/ + ONCHIP_LATE.md.
@@ -98,6 +103,18 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       timeout 1200 python tools/ablate.py --fusion \
       > tpu_watch/r8_fusion_ab.txt 2>&1
     log "8 ablate --fusion rc=$? last: $(tail -1 tpu_watch/r8_fusion_ab.txt | head -c 200)"
+    # 9. ISSUE 15: serving-tier loadtest twin — the continuous-batching
+    # ring (sharded + AOT-persisted) vs the pre-ring merge core, on
+    # REAL hardware where the GSPMD shards are separate chips (the
+    # CPU-mesh record shares one intra-op pool, so the committed
+    # speedup UNDERSTATES the chip): open-loop poisson A/B + an AOT
+    # cold-start timing pair (second run must log aot=cache)
+    VELES_LOADTEST_RECORD_PATH=tpu_watch/r8_loadtest_ab.json \
+      timeout 1200 python tools/loadtest.py --ab --rate 620 \
+      --duration 10 --rows 64 --batch 64 --ring 512 --depth 12 \
+      --width 512 --sample 8 --queue-limit 24 --workers 64 \
+      > tpu_watch/r8_loadtest_ab.txt 2>&1
+    log "9 loadtest --ab rc=$? last: $(tail -1 tpu_watch/r8_loadtest_ab.txt | head -c 200)"
     {
       echo "# ONCHIP_LATE — r8 watcher capture ($(date -u +%FT%TZ))"
       echo
@@ -118,6 +135,8 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       echo '```'; tail -7 tpu_watch/r8_collective_ab.txt; echo '```'
       echo "## 8. tools/ablate.py --fusion (compiled fused-vs-composed lrn+maxpool A/B)"
       echo '```'; tail -4 tpu_watch/r8_fusion_ab.txt; echo '```'
+      echo "## 9. tools/loadtest.py --ab (serving ring vs merge, ISSUE 15 on-chip twin)"
+      echo '```'; grep ^LOADTEST tpu_watch/r8_loadtest_ab.txt | tail -1; echo '```'
     } > ONCHIP_LATE.md
     log "capture done -> ONCHIP_LATE.md"
     exit 0

@@ -123,7 +123,44 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve", nargs="?", const=0, default=None, type=int,
                    metavar="PORT",
                    help="serve the (snapshot-restored) model over HTTP "
-                        "instead of training: POST /predict, GET /info")
+                        "instead of training: POST /predict, GET /info. "
+                        "Default core: a continuous-batching slot ring, "
+                        "GSPMD-sharded over the local devices, with the "
+                        "compiled serving step persisted in the AOT "
+                        "cache so a replica restart skips compile "
+                        "(docs/SERVING.md)")
+    p.add_argument("--serve-ring", type=int, default=None, metavar="N",
+                   help="rows in the serving slot ring (the fixed-shape "
+                        "device-resident batch the dispatch loop runs "
+                        "every round; default = --serve-batch). Frozen "
+                        "into the AOT-compiled executable's shape — "
+                        "combine with --serve")
+    p.add_argument("--serve-dispatch", default=None,
+                   choices=("ring", "merge"),
+                   help="serving execution core: 'ring' (default) = "
+                        "continuous batching on the slot ring; 'merge' "
+                        "= the pre-ring bucketed micro-batching core "
+                        "(the tools/loadtest.py A/B baseline). Combine "
+                        "with --serve")
+    p.add_argument("--serve-quantize", default=None,
+                   choices=("f32", "bf16", "int8"),
+                   help="serving wire format for model params (the "
+                        "serve_forward registry op): bf16 halves model "
+                        "bytes, int8 is weight-only blockwise (~/4); "
+                        "both are REFUSED unserved without a passing "
+                        "ops.reference equivalence record. Combine "
+                        "with --serve")
+    p.add_argument("--serve-mesh", default=None,
+                   choices=("auto", "on", "off"),
+                   help="GSPMD-shard the served forward over the local "
+                        "device mesh via the trainer's NamedSharding "
+                        "plan: auto (default) shards when >1 device "
+                        "and the ring divides the data axis, on "
+                        "insists, off serves unsharded. Combine with "
+                        "--serve")
+    p.add_argument("--serve-batch", type=int, default=None, metavar="N",
+                   help="per-request row cap for --serve (default 64); "
+                        "the ring size defaults to it")
     p.add_argument("--pp", type=int, default=None, metavar="MICROBATCHES",
                    help="train as a GPipe pipeline over the local devices "
                         "(one stage per device) with this many microbatches")
@@ -503,7 +540,11 @@ def main(argv=None) -> int:
         fused=args.fused, autotune=args.autotune,
         autotune_budget=args.autotune_budget,
         manhole=args.manhole, pp=args.pp,
-        serve=args.serve, accum=args.accum, report=args.report,
+        serve=args.serve, serve_ring=args.serve_ring,
+        serve_dispatch=args.serve_dispatch,
+        serve_quantize=args.serve_quantize,
+        serve_mesh=args.serve_mesh, serve_batch=args.serve_batch,
+        accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,
         compile_cache=not args.no_compile_cache,
         nonfinite_guard=args.nonfinite_guard,

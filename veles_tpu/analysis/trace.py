@@ -309,6 +309,44 @@ def _collective_findings(step, mesh) -> List[Finding]:
     return out
 
 
+def audit_serving(server) -> List[Finding]:
+    """Sharded-serve audit (ISSUE 15): the ring server's forward must
+    trace under the TRAINER'S NamedSharding plan — run the
+    sharding-mismatch pass over the serving step's param specs/mesh,
+    and check the serve plan's ring input spec equals the step's
+    data-axis put spec (the same spec DeviceFeed puts training batches
+    to) and that the frozen ring shape divides the data axis. Empty
+    list = clean; merge-mode servers (the unsharded pre-ring baseline)
+    have nothing to audit."""
+    from veles_tpu.parallel.mesh import DATA_AXIS
+    out: List[Finding] = []
+    step = getattr(server, "_step", None)
+    plan = getattr(server, "_plan", None)
+    if step is None or plan is None:
+        return out
+    out += _sharding_findings(step)
+    mesh = plan["mesh"]
+    if mesh is None:
+        return out
+    want = step.input_put_specs()[0]
+    site = f"serve_plan x_spec {tuple(plan['x_spec'])!r}"
+    if tuple(plan["x_spec"]) != tuple(want):
+        out.append(Finding(
+            "sharding-mismatch", SEV_ERROR, "serving",
+            f"ring input spec {tuple(plan['x_spec'])} diverges from "
+            f"the trainer's data-axis put spec {tuple(want)} "
+            f"(input_put_specs — the DeviceFeed rule)", site))
+    n = mesh.shape.get(DATA_AXIS, 1)
+    slots = server.ring_slots or 0
+    if n > 1 and slots % n:
+        out.append(Finding(
+            "sharding-mismatch", SEV_ERROR, "serving",
+            f"ring_slots ({slots}) not divisible by the mesh data axis "
+            f"({n} shards): the fixed ring batch cannot lay out under "
+            f"the plan", site))
+    return out
+
+
 def _fusion_findings(step) -> List[Finding]:
     """Fused-pair half of the sharding-mismatch audit (ISSUE 13): when a
     selected fusion winner claims an adjacent unit pair, the trailing

@@ -64,5 +64,8 @@ def check_shared_token(handler, token) -> bool:
     if hmac.compare_digest(got, token):
         return True
     handler.send_response(403)
+    # explicit empty body: HTTP/1.1 keep-alive handlers (serving) need
+    # a length on EVERY response or the client blocks reading to EOF
+    handler.send_header("Content-Length", "0")
     handler.end_headers()
     return False

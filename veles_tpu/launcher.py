@@ -40,6 +40,11 @@ class Launcher(Logger):
                  autotune_budget: Optional[int] = None,
                  manhole: Optional[int] = None,
                  pp: Optional[int] = None, serve: Optional[int] = None,
+                 serve_ring: Optional[int] = None,
+                 serve_dispatch: Optional[str] = None,
+                 serve_quantize: Optional[str] = None,
+                 serve_mesh: Optional[str] = None,
+                 serve_batch: Optional[int] = None,
                  accum: Optional[int] = None, report: str = "",
                  tp: Optional[int] = None, sp: Optional[int] = None,
                  ep: bool = False, compile_cache: bool = True,
@@ -100,6 +105,56 @@ class Launcher(Logger):
                 "--serve is a serve-only mode: it conflicts with "
                 "--pp/--fused and distributed -l/-m")
         self.serve_port = serve
+        #: serving-tier knobs (ISSUE 15): ring geometry, dispatch core,
+        #: quantized wire, mesh request, per-request row cap — rejected
+        #: without --serve (the --feed-ahead precedent: a knob nothing
+        #: consumes must fail loud, not be silently inert)
+        if serve is None and any(
+                v is not None for v in (serve_ring, serve_dispatch,
+                                        serve_quantize, serve_mesh,
+                                        serve_batch)):
+            raise SystemExit(
+                "--serve-ring/--serve-dispatch/--serve-quantize/"
+                "--serve-mesh/--serve-batch configure the serving "
+                "tier: combine with --serve")
+        if serve_ring is not None and serve_ring < 1:
+            raise SystemExit(f"--serve-ring needs N >= 1 "
+                             f"(got {serve_ring})")
+        if serve_batch is not None and serve_batch < 1:
+            raise SystemExit(f"--serve-batch needs N >= 1 "
+                             f"(got {serve_batch})")
+        if serve_ring is not None \
+                and serve_ring < (serve_batch or 64):
+            # fail at flag-parse time with the flag names, not a
+            # traceback from deep inside the server build (the ring
+            # must hold a whole max_batch request; 64 = the server's
+            # max_batch default)
+            raise SystemExit(
+                f"--serve-ring ({serve_ring}) must hold a whole "
+                f"--serve-batch request ({serve_batch or 64}): raise "
+                f"--serve-ring or lower --serve-batch")
+        if (serve_dispatch or "ring") == "merge":
+            # every ring-only capability knob fails at flag-parse time
+            # with the flag names, not a traceback after the workflow
+            # initialize (the --serve-ring precedent below)
+            if serve_ring is not None:
+                raise SystemExit("--serve-ring sizes the ring core: it "
+                                 "conflicts with --serve-dispatch merge")
+            if serve_quantize not in (None, "f32"):
+                raise SystemExit(
+                    "--serve-quantize rides the ring core (the merge "
+                    "baseline serves f32): drop --serve-dispatch merge "
+                    "or --serve-quantize")
+            if serve_mesh == "on":
+                raise SystemExit(
+                    "--serve-mesh on requires the ring core (the merge "
+                    "baseline serves unsharded): drop --serve-dispatch "
+                    "merge or use --serve-mesh off")
+        self.serve_ring = serve_ring
+        self.serve_dispatch = serve_dispatch or "ring"
+        self.serve_quantize = serve_quantize or "f32"
+        self.serve_mesh = serve_mesh or "auto"
+        self.serve_batch = serve_batch
         #: GPipe pipeline mode: microbatch count (stages = local devices)
         if pp is not None and pp < 1:
             raise SystemExit(f"--pp needs a microbatch count >= 1 "
@@ -592,8 +647,22 @@ class Launcher(Logger):
                         "fused forward (StandardWorkflow-family only)")
                 from veles_tpu.serving import InferenceServer
                 self.workflow.initialize(device=self.device, **kwargs)
+                srv_kwargs = {}
+                if self.serve_batch is not None:
+                    srv_kwargs["max_batch"] = self.serve_batch
                 srv = InferenceServer(self.workflow,
-                                      port=self.serve_port).start()
+                                      port=self.serve_port,
+                                      dispatch=self.serve_dispatch,
+                                      ring_slots=self.serve_ring,
+                                      quantize=self.serve_quantize,
+                                      mesh=self.serve_mesh,
+                                      **srv_kwargs).start()
+                info = srv.model_info()
+                self.info("serving: dispatch=%s ring=%s sharded=%s "
+                          "quantize=%s aot=%s",
+                          info["dispatch"], info["ring_slots"],
+                          info.get("sharded"), info["quantize"],
+                          info.get("aot"))
                 print(f"SERVING http://127.0.0.1:{srv.port}", flush=True)
                 try:
                     while True:

@@ -128,6 +128,31 @@ def make_batch_put(step) -> Optional[Callable]:
     return put
 
 
+def make_input_put(step) -> Optional[Callable]:
+    """The async transfer callable for a single INPUT batch under the
+    step's data-axis spec (``input_put_specs()[0]``) — the x-only twin
+    of `make_batch_put`, shared by the serving slot ring (ISSUE 15: the
+    ring batch lands on device in the SAME sharding training batches
+    do, and the put is async so the transfer rides under the executing
+    forward — the DeviceFeed double-buffer pattern pointed at
+    inference). None on multi-host meshes, same degrade rule as
+    make_batch_put."""
+    import jax
+
+    mesh = getattr(step, "mesh", None)
+    if mesh is None:
+        return lambda a: jax.device_put(a)
+    from veles_tpu.parallel.mesh import is_multihost
+    if is_multihost(mesh):
+        return None
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    specs_fn = getattr(step, "input_put_specs", None)
+    spec = (specs_fn() if callable(specs_fn) else (P(),))[0]
+    sharding = NamedSharding(mesh, spec)
+    return lambda a: jax.device_put(a, sharding)
+
+
 class DeviceFeed:
     """Async device-feed over a Loader — the double buffer as a
     reusable component. Driver contract:

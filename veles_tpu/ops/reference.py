@@ -509,6 +509,48 @@ def dequantize_blockwise(q: np.ndarray, scale: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# quantized serving forward (NO 2015 parity — the golden the
+# `serve_forward` registry variants are equivalence-gated against,
+# ISSUE 15: the low-byte serving path is only ever a ledger-gated point.
+# Weight-only quantization reuses the blockwise int8 golden above — one
+# quantization rule for collectives and serving, never two.)
+# ---------------------------------------------------------------------------
+
+def serve_forward_mlp(x: np.ndarray, layers) -> np.ndarray:
+    """Canonical tanh-MLP serving forward in numpy: `layers` is a list
+    of (w, b) pairs, tanh between layers, linear head. The serve_forward
+    equivalence contract runs every wire variant against THIS model with
+    the variant's own weight transform applied through the reference
+    quantizers, so the contract isolates the forward math from the
+    (separately bitwise-asserted) quantization."""
+    h = x.astype(np.float64)
+    for i, (w, b) in enumerate(layers):
+        h = h @ w.astype(np.float64) + b.astype(np.float64)
+        if i < len(layers) - 1:
+            h = np.tanh(h)
+    return h.astype(np.float32)
+
+
+def serve_quantize_weight(w: np.ndarray, block: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Weight-only int8 serving transform of one >=2-D param leaf:
+    reshape to (rows, cols) = (prod(leading), last), zero-pad cols to a
+    block multiple, per-block absmax int8 via `quantize_blockwise`.
+    Returns (codes int8 (rows, colsp), scales f32 (rows, colsp//block)).
+    The jax dequantize in ops.variants must reproduce
+    `dequantize_blockwise` of exactly these codes/scales — the
+    serve_forward contract asserts it."""
+    rows = int(np.prod(w.shape[:-1], dtype=np.int64))
+    cols = w.shape[-1]
+    pad = (-cols) % block
+    w2 = w.reshape(rows, cols).astype(np.float32)
+    if pad:
+        w2 = np.concatenate(
+            [w2, np.zeros((rows, pad), np.float32)], axis=1)
+    return quantize_blockwise(w2, block)
+
+
+# ---------------------------------------------------------------------------
 # multi-head attention (NO 2015 parity — the reference framework has no
 # attention anywhere, SURVEY.md §5.7; this numpy model is the golden the
 # `flash_attn` lowering variants are equivalence-gated against)

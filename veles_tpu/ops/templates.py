@@ -1220,3 +1220,82 @@ register_template(KernelTemplate(
         "on the composed golden"))
 CONTRACTS["lrn_maxpool"] = _lrn_pool_contract
 BENCHES["lrn_maxpool"] = _lrn_pool_bench
+
+
+# -- serve_forward: quantized serving wire (ISSUE 15) -----------------------
+#    No template (the wire formats are a closed named family, not a
+#    searched space) — but the variants ride the SAME equivalence ledger
+#    as every generated kernel: the serving tier refuses to serve a
+#    non-f32 wire without a passing record here (veles_tpu/serving.py),
+#    exactly as the search refuses to time an ungated candidate.
+
+def _serve_contract(apply):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veles_tpu.ops import reference as ref
+    from veles_tpu.ops import variants as va
+    cfg = apply.sv_config
+    rs = np.random.RandomState(7)
+    # hidden width >= the int8 block (64) so the quantized wire's
+    # eligibility rule actually quantizes w1 (w2's 4 columns stay f32
+    # by the same rule — both branches exercised)
+    w1 = (rs.randn(24, 96) * 0.2).astype(np.float32)
+    b1 = (rs.randn(96) * 0.1).astype(np.float32)
+    w2 = (rs.randn(96, 4) * 0.2).astype(np.float32)
+    b2 = (rs.randn(4) * 0.1).astype(np.float32)
+    params = ({"weights": w1, "bias": b1}, {"weights": w2, "bias": b2})
+    x = rs.randn(8, 24).astype(np.float32)
+
+    def forward(p, xb):
+        h = jnp.tanh(xb @ p[0]["weights"] + p[0]["bias"])
+        return h @ p[1]["weights"] + p[1]["bias"]
+
+    name = {v["wire"]: k for k, v in va._SERVE_NAMED.items()}[
+        cfg["wire"]]
+    prepared, shapes = va.serve_prepare_params(name, params)
+    if cfg["wire"] == "int8":
+        # the host transform must BE the reference quantizer, bitwise —
+        # one quantization rule for collectives and serving; a leaf
+        # below the block width must pass through UNtouched
+        for w, layer in ((w1, prepared[0]), (w2, prepared[1])):
+            if w.shape[-1] >= cfg["blk"]:
+                qg, sg = ref.serve_quantize_weight(w, cfg["blk"])
+                np.testing.assert_array_equal(layer["weights"]["q"], qg)
+                np.testing.assert_array_equal(layer["weights"]["s"], sg)
+            else:
+                np.testing.assert_array_equal(layer["weights"], w)
+    out = np.asarray(jax.jit(
+        lambda pr, xb: apply(pr, xb, forward, shapes))(prepared, x))
+    # golden 1: the SAME wire transform applied through the reference
+    # quantizers, forward in numpy — isolates the traced dequant+matmul
+    if cfg["wire"] == "int8":
+        deq = []
+        for (w, b) in ((w1, b1), (w2, b2)):
+            if w.shape[-1] >= cfg["blk"]:
+                q, s = ref.serve_quantize_weight(w, cfg["blk"])
+                w = ref.dequantize_blockwise(q, s, cfg["blk"])[
+                    :, :w.shape[-1]].reshape(w.shape)
+            deq.append((w, b))
+        golden = ref.serve_forward_mlp(x, deq)
+        np.testing.assert_allclose(out, golden, rtol=2e-5, atol=2e-5)
+    elif cfg["wire"] == "f32":
+        golden = ref.serve_forward_mlp(x, ((w1, b1), (w2, b2)))
+        np.testing.assert_allclose(out, golden, rtol=2e-5, atol=2e-5)
+    # golden 2 (every wire): stay within the serving tolerance of the
+    # UNQUANTIZED f32 forward — the bound the serving tier re-probes on
+    # the real model before a low-byte variant may serve
+    f32 = ref.serve_forward_mlp(x, ((w1, b1), (w2, b2)))
+    tol = {"f32": 1e-5, "bf16": 5e-2, "int8": 5e-2}[cfg["wire"]]
+    err = float(np.max(np.abs(out - f32)))
+    if err > tol:
+        raise AssertionError(
+            f"serve_forward/{name}: max |out - f32| = {err:.2e} "
+            f"exceeds the {tol} serving tolerance")
+    return {"checked": f"wire transform bitwise vs ops.reference + "
+                       f"forward vs serve_forward_mlp golden; "
+                       f"|out - f32| max {err:.2e} <= {tol}"}
+
+
+CONTRACTS["serve_forward"] = _serve_contract

@@ -44,7 +44,8 @@ __all__ = [
     "warn_deprecated_knob", "grad_reduce_apply", "grad_reduce_config",
     "grad_reduce_geometry", "grad_reduce_local_request",
     "grad_reduce_resid_len", "grad_reduce_bytes", "q8_encode",
-    "q8_decode", "GRAD_REDUCE_LOCAL_ENV",
+    "q8_decode", "GRAD_REDUCE_LOCAL_ENV", "serve_forward_apply",
+    "serve_forward_config", "serve_prepare_params", "serve_param_bytes",
 ]
 
 
@@ -732,6 +733,167 @@ register_op(
 register(Variant("sgd_update", "xla_tree", _sgd_xla_tree,
                  doc="per-leaf jnp rule (ops.optim.sgd_update); fuses "
                      "into the compiled step"))
+
+
+# -- quantized serving forward (ISSUE 15) -----------------------------------
+#    apply(prepared, x, forward, shapes=None) -> f32 output.
+#    `forward` is the caller's dense forward ((params, x) -> out — the
+#    serving tier passes FusedTrainStep._forward's local trace);
+#    `prepared` is the param pytree AFTER this variant's host-side wire
+#    transform (`serve_prepare_params`), `shapes` the matching pytree of
+#    original leaf shapes (static — needed to undo the int8 padding).
+#    The EQuARX-era registry discipline (arxiv 2506.17615) applied to
+#    serving: a low-byte serving path is only ever a ledger-gated CONFIG
+#    POINT behind the ONE `serve_forward_apply` builder — never a fork
+#    of the forward. Equivalence contract: templates._serve_contract
+#    runs every variant against ops.reference.serve_forward_mlp with the
+#    reference quantizers supplying the golden weight transform
+#    (ints BITWISE, forward within per-wire tolerance); the serving tier
+#    additionally refuses to SERVE a non-f32 variant without a passing
+#    ledger record AND probes it against the f32 forward of the REAL
+#    model at startup (veles_tpu/serving.py).
+#
+#    - f32:  identity wire — the reference point;
+#    - bf16: params stored and computed in bfloat16 (model bytes /2),
+#      activations cast at entry, output restored to f32;
+#    - int8: weight-only — >=2-D float leaves with a full block of
+#      columns stored as per-block absmax int8 codes + f32 scales
+#      (ops.reference.serve_quantize_weight; model bytes ~/4),
+#      dequantized to f32 in-trace so XLA fuses the dequant into the
+#      matmul's weight read; 1-D leaves (biases) and sub-block-width
+#      leaves stay f32 (negligible bytes / the pad would inflate them
+#      — see _serve_quantizable).
+
+_SERVE_NAMED: Dict[str, Dict[str, Any]] = {
+    "f32": {"wire": "f32", "blk": 0},
+    "bf16": {"wire": "bf16", "blk": 0},
+    "int8": {"wire": "int8", "blk": 64},
+}
+
+
+def serve_forward_config(name: Any) -> Optional[Dict[str, Any]]:
+    """Canonical config {wire, blk} for a serve_forward variant name
+    (None for foreign names)."""
+    cfg = _SERVE_NAMED.get(name)
+    return dict(cfg) if cfg is not None else None
+
+
+def _serve_quantizable(a, blk: int) -> bool:
+    """int8-wire eligibility: >=2-D float leaves whose last axis holds
+    at least one full block — a narrower leaf would zero-PAD up to the
+    block and come out LARGER on the wire than its f32 form (measured:
+    a (10, 16) weight ballooned 640 B of codes from 640 B of f32).
+    Ineligible leaves stay f32; on real layer widths (>= blk) the wire
+    is ~bytes/4."""
+    import numpy as np
+    arr = np.asarray(a)
+    return (arr.ndim >= 2 and arr.shape[-1] >= blk
+            and np.issubdtype(arr.dtype, np.floating))
+
+
+def serve_prepare_params(name: str, params):
+    """HOST-side wire transform of a (tuple-of-dicts) f32 param pytree
+    into `name`'s serving format. Returns (prepared, shapes): int8
+    leaves become {"q": codes, "s": scales} dicts built by the
+    ops.reference quantizer (the codes ARE the golden — one
+    quantization rule for collectives and serving), bf16 leaves are
+    cast, f32 passes through; `shapes` records each original leaf shape
+    (static metadata the traced dequantize needs to undo padding)."""
+    import numpy as np
+    cfg = _SERVE_NAMED[name]
+    prepared, shapes = [], []
+    for layer in params:
+        pl: Dict[str, Any] = {}
+        sl: Dict[str, tuple] = {}
+        for k, a in layer.items():
+            arr = np.asarray(a)
+            sl[k] = tuple(int(s) for s in arr.shape)
+            if cfg["wire"] == "int8" \
+                    and _serve_quantizable(arr, cfg["blk"]):
+                from veles_tpu.ops import reference
+                q, s = reference.serve_quantize_weight(
+                    arr.astype(np.float32), cfg["blk"])
+                pl[k] = {"q": q, "s": s}
+            elif cfg["wire"] == "bf16" \
+                    and np.issubdtype(arr.dtype, np.floating):
+                import ml_dtypes
+                pl[k] = arr.astype(ml_dtypes.bfloat16)
+            else:
+                pl[k] = arr
+        prepared.append(pl)
+        shapes.append(sl)
+    return tuple(prepared), tuple(shapes)
+
+
+def serve_param_bytes(prepared) -> int:
+    """Wire bytes of a prepared param pytree — the measured form of the
+    quantized-serving memory claim (model_info/bench surface it next to
+    the f32 model bytes)."""
+    import jax
+    import numpy as np
+    return sum(int(np.asarray(leaf).nbytes)
+               for leaf in jax.tree_util.tree_leaves(prepared))
+
+
+def _serve_restore(cfg, prepared, shapes):
+    """Traced inverse of serve_prepare_params: prepared tree -> the
+    param tree the dense forward consumes (f32 for int8 wire — the
+    dequantize fuses into the weight read; bf16 stays bf16 so the
+    forward computes in the wire dtype)."""
+    import jax.numpy as jnp
+    out = []
+    for li, layer in enumerate(prepared):
+        d = {}
+        for k, v in layer.items():
+            if isinstance(v, dict) and "q" in v:
+                shp = tuple(shapes[li][k])
+                deq = q8_decode(v["q"], v["s"], cfg["blk"])
+                d[k] = deq[:, :shp[-1]].reshape(shp)
+            else:
+                d[k] = v
+        out.append(d)
+    return tuple(out)
+
+
+def serve_forward_apply(cfg: Dict[str, Any]) -> Callable[..., Any]:
+    """Build the canonical serve_forward apply for one config point —
+    the ONE implementation behind every named wire variant. The closure
+    carries ``apply.sv_config`` so the equivalence contract can derive
+    the matching reference transform without a second naming scheme."""
+    cfg = dict(cfg)
+
+    def apply(prepared, x, forward, shapes=None):
+        import jax.numpy as jnp
+        params = _serve_restore(cfg, prepared, shapes)
+        if cfg["wire"] == "bf16":
+            x = x.astype(jnp.bfloat16)
+        out = forward(params, x)
+        return out.astype(jnp.float32)
+
+    apply.sv_config = cfg
+    return apply
+
+
+register_op(
+    "serve_forward", default="f32", fallback="f32",
+    doc="the serving tier's wire format for model params: f32 "
+        "reference, bf16 (bytes /2) and weight-only blockwise int8 "
+        "(bytes ~/4) — every low-byte point ledger-gated against the "
+        "f32 forward before it may serve (ISSUE 15; the EQuARX "
+        "registry discipline, arxiv 2506.17615)")
+register(Variant("serve_forward", "f32",
+                 serve_forward_apply(_SERVE_NAMED["f32"]),
+                 doc="identity wire: the trained f32 params as-is"))
+register(Variant("serve_forward", "bf16",
+                 serve_forward_apply(_SERVE_NAMED["bf16"]),
+                 doc="params stored + computed in bfloat16 (model "
+                     "bytes /2), output restored to f32"))
+register(Variant("serve_forward", "int8",
+                 serve_forward_apply(_SERVE_NAMED["int8"]),
+                 doc="weight-only per-block absmax int8 (blk=64, model "
+                     "bytes ~/4): codes quantized by the ops.reference "
+                     "golden on the host, dequantized in-trace so XLA "
+                     "fuses the dequant into the weight read"))
 
 
 # -- dropout mask RNG -------------------------------------------------------

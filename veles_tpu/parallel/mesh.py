@@ -163,6 +163,35 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
+def serve_plan(step) -> Dict[str, Any]:
+    """The NamedSharding plan of the SERVED forward — the data-parallel
+    plan the trainer uses (ISSUE 15, ROADMAP direction 2): params
+    REPLICATED (the dp step's layout — the serving tier serves the
+    dense dp forward; TP-sharded serving of a gspmd step is a
+    follow-on, not silently half-done here), the batch under the
+    step's data-axis input spec (``input_put_specs()[0]`` — the SAME
+    spec DeviceFeed puts training batches to), outputs replicated. ONE
+    rule shared by the serving jit's in/out shardings, the AOT cache
+    signature and the sharded-serve audit (analysis/trace.py
+    ``audit_serving``), so what serves == what persists == what the
+    auditor checks. ``None`` plan (no mesh) = plain single-device
+    jit."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    mesh = getattr(step, "mesh", None)
+    if mesh is None:
+        return {"mesh": None, "params": None, "x_spec": P(), "x": None,
+                "out": None, "geometry": None}
+    rep = NamedSharding(mesh, P())
+    x_spec = step.input_put_specs()[0]
+    return {"mesh": mesh,
+            "params": rep,
+            "x_spec": x_spec,
+            "x": NamedSharding(mesh, x_spec),
+            "out": rep,
+            "geometry": {k: int(v) for k, v in dict(mesh.shape).items()}}
+
+
 def is_multihost(mesh) -> bool:
     """True when `mesh` (or any Mesh-like with .devices) spans processes
     other than this one — the single shared predicate for 'collectives /

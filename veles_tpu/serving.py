@@ -4,7 +4,7 @@ Parity: the reference's Python serving story (SURVEY.md §3.4 "REST-ish
 serving inside Python: run forward sub-graph per request") — the C++
 engine (native/) and StableHLO export cover out-of-process serving; this
 covers the "stand up the model you just trained" path: a stdlib HTTP
-server exposing the workflow's jitted fused forward.
+server exposing the workflow's forward.
 
 Endpoints:
 - POST /predict    {"inputs": [[...], ...]}  ->  {"outputs": [[...]]}
@@ -14,34 +14,73 @@ Endpoints:
   serving, 503 while draining (load balancers stop routing before the
   listener actually closes)
 
-Robustness (resilience layer):
-- **Bounded admission**: at most `queue_limit` requests in flight; the
-  next one gets an immediate 503 `{"error": "overloaded"}` instead of
-  unbounded queuing (fail fast beats collapse under a traffic spike).
-- **Per-request timeout**: a queued request that misses
-  `request_timeout_s` is abandoned (the batcher skips it) and answered
-  503, so one stuck dispatch cannot pin client threads forever.
-- **Graceful drain**: `stop()` first refuses new work (503), lets
-  in-flight batches finish (bounded by `drain_s`), THEN closes.
+Execution core (ISSUE 15, ROADMAP direction 2) — two dispatch modes:
 
-Throughput design (static shapes — the jit contract — without paying
-max_batch compute per tiny request):
-- **Shape buckets**: requests are padded to the next power of two ≤
-  max_batch, one compiled program per bucket (jit's shape cache; only
-  the max_batch bucket is pre-warmed — a bucket's first request pays its
-  compile, subsequent ones hit the cache).
-- **Demand-driven micro-batching** (`batch_window_ms` > 0): requests
-  that arrive while a forward is in flight queue up and are concatenated
-  into ONE dispatch on the next round (natural batching — a solo
-  sequential client pays NO added latency); when several requests are
-  already queued, the batcher additionally waits up to the window for
-  stragglers before dispatching. Window 0 = strict per-request dispatch.
+- ``dispatch="ring"`` (default): a **continuous-batching slot ring**.
+  The server keeps ONE fixed-shape batch of `ring_slots` rows; a
+  dispatch loop runs it round after round, admitting whole requests
+  into free slots as they arrive and returning per-slot results as the
+  round completes — no stop-the-world "merge, forward, scatter". While
+  round *k* executes on the device, round *k+1* is admitted, staged and
+  its **async sharded device_put issued** (the DeviceFeed double-buffer
+  pattern pointed at inference, `loader.device_feed.make_input_put`),
+  so H2D rides under the executing forward and a straggler-heavy
+  open-loop arrival pattern keeps the device busy instead of
+  serializing behind the widest merge. Under the ring:
+
+  * the served forward is **GSPMD-sharded over the mesh** via the SAME
+    NamedSharding plan the trainer uses (`parallel.mesh.serve_plan`:
+    params under the step's layout, the ring batch under
+    `input_put_specs()[0]` — exactly where DeviceFeed puts training
+    batches);
+  * the serving step is **AOT-compiled per (model, mesh, ring shape,
+    quantize variant) and persisted** alongside the autotune cache
+    (`veles_tpu.serving_aot`) — a replica restart deserializes instead
+    of compiling (cold-start O(load), arxiv 2203.04015), with the
+    autotune cache's corrupt-degrades-to-rebuild discipline and a
+    mesh-geometry change refusing the stale artifact;
+  * the params may serve through a **quantized wire**
+    (`quantize="bf16"/"int8"`, the `serve_forward` registry op in
+    ops/variants.py): a low-byte variant is only ever a ledger-gated
+    config point — it is REFUSED unserved without a passing
+    ops.reference equivalence record, and additionally probed against
+    the f32 forward of the real model at startup.
+
+  `ring_slots` (and the mesh geometry) are FROZEN into the compiled
+  executable's shape — `ring_slots` is a read-only property, so a live
+  write fails loudly instead of silently diverging from the program
+  being dispatched. `max_batch` stays the live per-request row cap
+  (clamped to the ring).
+
+- ``dispatch="merge"``: the pre-ring core, kept bit-for-bit as the
+  measured baseline (`tools/loadtest.py` A/B) and the multi-host
+  degrade: demand-driven micro-batching into power-of-two buckets, one
+  jit program per bucket. Both `batch_window_ms` and `max_batch` are
+  read per round here — live-tunable on a running server.
+
+Robustness (resilience layer, both modes):
+- **Bounded admission**: at most `queue_limit` requests in flight; the
+  next one gets an immediate 503 ``{"error": "overloaded",
+  "retry_after_s": ...}`` **with a Retry-After header derived from the
+  measured per-round latency** (the PR-14 capacity-hint story wired
+  into admission: when the ring is full and the queue at bound, tell
+  the balancer when capacity frees instead of queueing into a timeout).
+- **Per-request timeout**: a queued request that misses
+  `request_timeout_s` is abandoned (the dispatcher skips it) and
+  answered 503, so one stuck dispatch cannot pin client threads forever.
+- **Graceful drain**: `stop()` first refuses new work (503), lets
+  in-flight rounds finish (bounded by `drain_s`), THEN closes; a
+  request RESIDENT IN A RING SLOT at stop() time completes (its round
+  is delivered before the loop exits) and queued-but-unadmitted
+  requests get a clean "server stopping" error — never a hung
+  ``done.wait()``.
 Localhost by default; same trust model as the manhole.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,7 +92,14 @@ from veles_tpu.logger import Logger
 
 
 class ServerOverloaded(RuntimeError):
-    """queue_limit requests already in flight — shed, don't queue."""
+    """queue_limit requests already in flight — shed, don't queue.
+    `retry_after` (seconds, may be None) is the measured-latency-derived
+    hint the handler surfaces as the Retry-After header."""
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None
+                 ) -> None:
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class ServerDraining(RuntimeError):
@@ -78,13 +124,74 @@ class InferenceServer(Logger):
                  queue_limit: int = 64,
                  request_timeout_s: float = 30.0,
                  token: Optional[str] = None,
-                 max_body: int = 32 << 20) -> None:
+                 max_body: int = 32 << 20,
+                 dispatch: str = "ring",
+                 ring_slots: Optional[int] = None,
+                 mesh: Any = "auto",
+                 quantize: str = "f32",
+                 aot_cache: Any = "auto") -> None:
         super().__init__()
         self.workflow = workflow
         self.host = host
         self.port = port
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
+        if dispatch not in ("ring", "merge"):
+            raise ValueError(f"dispatch must be 'ring' or 'merge' "
+                             f"(got {dispatch!r})")
+        #: execution core: "ring" = continuous-batching slot ring
+        #: (sharded, AOT-persisted); "merge" = the pre-ring bucketed
+        #: micro-batching core, kept as the measured baseline
+        self.dispatch = dispatch
+        #: serve_forward registry variant (ops/variants.py): the params'
+        #: wire format. Non-f32 variants are ledger-gated (refused
+        #: unserved without a passing ops.reference record) and ride the
+        #: ring dispatch path only.
+        from veles_tpu.ops.variants import serve_forward_config
+        if serve_forward_config(quantize) is None:
+            raise ValueError(
+                f"quantize must be one of f32/bf16/int8 "
+                f"(got {quantize!r})")
+        self.quantize = quantize
+        if quantize != "f32" and dispatch != "ring":
+            raise ValueError(
+                "quantized serving rides the ring dispatch path (the "
+                "merge core is the unquantized pre-ring baseline): use "
+                "dispatch='ring' or quantize='f32'")
+        # ring-only capability knobs must fail loud under merge, not
+        # sit silently inert (the --feed-ahead precedent): an explicit
+        # ring geometry or an INSISTED mesh would otherwise be
+        # accepted, stored and never consumed
+        if dispatch == "merge":
+            if ring_slots is not None:
+                raise ValueError(
+                    "ring_slots sizes the ring dispatch core: use "
+                    "dispatch='ring' (the merge core batches up to "
+                    "max_batch per round)")
+            if mesh not in ("auto", "off", None, False):
+                raise ValueError(
+                    "mesh='on'/an explicit Mesh requires the ring "
+                    "dispatch core: the merge baseline serves "
+                    "unsharded by design")
+        #: ring geometry request (resolved + frozen by _build; see the
+        #: ring_slots property). `is not None`, not truthiness: a
+        #: computed ring_slots=0 must hit the validation below, never
+        #: silently become max_batch.
+        self._ring_slots = (int(ring_slots) if ring_slots is not None
+                            else int(max_batch))
+        if self._ring_slots < 1:
+            raise ValueError(f"ring_slots must be >= 1 "
+                             f"(got {ring_slots})")
+        if dispatch == "ring" and self._ring_slots < max_batch:
+            raise ValueError(
+                f"ring_slots ({self._ring_slots}) must hold a whole "
+                f"max_batch request ({max_batch})")
+        #: mesh request: "auto" (shard over all local devices when >1,
+        #: ring mode only), "off"/None (unsharded), or an explicit Mesh
+        self._mesh_req = mesh
+        #: AOT persistence: "auto" (default cache path), a path, or
+        #: False/None to disable (compile every start)
+        self._aot_req = aot_cache
         #: optional shared token (X-Veles-Token, constant-time compare —
         #: the endpoint-contract convention every control plane wires;
         #: None keeps the localhost trust model wide open)
@@ -99,20 +206,28 @@ class InferenceServer(Logger):
         self.request_timeout_s = request_timeout_s
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()   # jit dispatch is thread-safe but
-        # serialized anyway: one device, no benefit to interleaving
+        self._lock = threading.Lock()   # merge mode: jit dispatch is
+        # thread-safe but serialized anyway (one device, no benefit to
+        # interleaving); the ring loop is single-threaded by design
         self._cv = threading.Condition()
-        self._pending: List[dict] = []      # micro-batch accumulation
+        self._pending: List[dict] = []      # queued request items
         self._batcher: Optional[threading.Thread] = None
         self._stopping = False
         self._draining = False
         self._inflight = 0
         self._started_at = time.time()
+        #: EWMA of the measured per-round dispatch latency (seconds) —
+        #: feeds the overload Retry-After hint; guarded by _cv
+        self._round_s = 0.0
         #: forward dispatches actually issued (tests assert coalescing)
         self.n_dispatches = 0
         #: requests shed with 503 (overload + drain) / timed out
         self.n_rejected = 0
         self.n_timeouts = 0
+        #: AOT provenance: compiles paid by THIS server object, and
+        #: where the executable came from ("compile"/"cache"/None)
+        self.aot_compiles = 0
+        self.aot_source: Optional[str] = None
         #: lazily computed /healthz capacity hint (analysis pass 6);
         #: _UNSET -> computed once on first health() call
         self._capacity: Any = _UNSET
@@ -134,17 +249,48 @@ class InferenceServer(Logger):
             "queued requests that missed request_timeout_s")
         self._m_dispatches = _reg.counter(
             "veles_serving_dispatches_total",
-            "forward dispatches issued (coalesced batches)")
+            "forward dispatches issued (coalesced batches / ring "
+            "rounds)")
         self._m_inflight = _reg.gauge(
             "veles_serving_inflight", "requests currently in flight")
         self._m_latency = _reg.histogram(
             "veles_serving_latency_seconds",
             "predict latency (admission to response)",
             buckets=_tmetrics.LATENCY_BUCKETS)
+        # ring-efficiency instruments (register_standard families):
+        # queue depth sampled at every enqueue/round, occupied rows
+        # observed per dispatched ring round — measured, not claimed
+        self._m_queue_depth = _reg.gauge("veles_serving_queue_depth")
+        self._m_occupancy = _reg.histogram(
+            "veles_serving_ring_occupancy")
         self._tr = _ttracer.active()
         self._build()
 
+    @property
+    def ring_slots(self) -> Optional[int]:
+        """Rows in the device-resident ring batch (None in merge mode).
+        READ-ONLY by design: the value is baked into the AOT-compiled
+        executable's input shape, so a live write could only diverge
+        the admission bound from the program being dispatched — rebuild
+        the server to resize the ring. (`batch_window_ms`/`max_batch`
+        stay live-tunable in merge mode, where every round re-reads
+        them; in ring mode `max_batch` remains live but is clamped to
+        the frozen ring.)"""
+        return self._ring_slots if self.dispatch == "ring" else None
+
+    def _request_cap(self) -> int:
+        """Largest admissible request (rows). Live `max_batch`, clamped
+        to the frozen ring shape in ring mode."""
+        if self.dispatch == "ring":
+            return min(self.max_batch, self._ring_slots)
+        return self.max_batch
+
+    # -- build ----------------------------------------------------------------
+
     def _build(self) -> None:
+        if self.dispatch == "ring":
+            self._build_ring()
+            return
         import jax
         import jax.numpy as jnp
 
@@ -176,18 +322,219 @@ class InferenceServer(Logger):
             probe = jnp.asarray(probe)
         self._fn(self._state["params"], probe).block_until_ready()
 
+    def _resolve_serve_mesh(self):
+        """The mesh the ring serves over: "auto" shards over all local
+        devices when the ring divides the data axis (degrading quietly
+        to unsharded otherwise), "on" insists (error when it cannot),
+        "off"/None pins unsharded, an explicit Mesh is validated.
+        Multi-host meshes degrade to unsharded — `jax.device_put`
+        cannot target non-addressable shards (the make_batch_put rule),
+        and one replica per host is the scale-out story anyway."""
+        req = self._mesh_req
+        if req in (None, False, "off"):
+            return None
+        from veles_tpu.parallel.mesh import (DATA_AXIS, is_multihost,
+                                             make_mesh)
+        if req in ("auto", "on", True):
+            import jax
+            devs = jax.devices()
+            if len(devs) < 2:
+                if req in ("on", True):
+                    raise ValueError(
+                        "mesh='on' but only one device is visible")
+                return None
+            mesh = make_mesh(devs)
+        else:
+            mesh = req      # an explicit Mesh object
+        if is_multihost(mesh):
+            msg = ("serving mesh spans processes: device_put cannot "
+                   "target non-addressable shards — run one replica "
+                   "per host instead")
+            if req == "auto":
+                self.debug("%s (serving unsharded)", msg)
+                return None
+            # 'on' / an explicit Mesh INSISTS on sharded serve: a
+            # silent unsharded degrade would falsify the capacity
+            # planning built on the sharded assumption
+            raise ValueError(msg)
+        n = mesh.shape.get(DATA_AXIS, 1)
+        if n > 1 and self._ring_slots % n:
+            msg = (f"ring_slots ({self._ring_slots}) not divisible by "
+                   f"the mesh data axis ({n} shards)")
+            if req == "auto":
+                self.warning("%s: serving unsharded", msg)
+                return None
+            raise ValueError(msg)
+        return mesh
+
+    def _build_ring(self) -> None:
+        """Build the continuous-batching core: the sharded dense
+        forward under the trainer's plan, the (possibly quantized)
+        wire params, and the AOT-compiled — persisted — ring
+        executable."""
+        import jax
+
+        from veles_tpu.loader.device_feed import make_input_put
+        from veles_tpu.ops import templates, variants
+        from veles_tpu.parallel.mesh import serve_plan
+        from veles_tpu.serving_aot import (ServingAotCache, call_trees,
+                                           serve_signature)
+        wf = self.workflow
+        mesh = self._resolve_serve_mesh()
+        # zero_sharding off: serving needs no optimizer state, and the
+        # dp step's forward is what we trace (dense, local_trace)
+        step = wf.build_fused_step(mesh=mesh, zero_sharding="off")
+        self._step = step
+        self._sample_shape = tuple(wf.loader.minibatch_data.shape[1:])
+        self._softmax = getattr(wf, "loss", None) == "softmax"
+        plan = serve_plan(step)
+        self._plan = plan
+
+        # -- quantized wire: ledger-gated registry variant -------------------
+        v = variants.get("serve_forward", self.quantize)
+        if self.quantize != "f32":
+            rec = templates.check_equivalence("serve_forward",
+                                              self.quantize)
+            if rec.get("status") != "pass":
+                raise ValueError(
+                    f"serve_forward/{self.quantize} refused unserved: "
+                    f"no passing equivalence record "
+                    f"({rec.get('error', 'contract failed')}) — the "
+                    f"ledger gates every low-byte serving wire")
+        params_host = tuple(
+            {k: np.asarray(a.mem) for k, a in u.param_arrays().items()}
+            for u in step.forwards)
+        prepared, shapes = variants.serve_prepare_params(
+            self.quantize, params_host)
+        self._wire_bytes = variants.serve_param_bytes(prepared)
+        self._f32_bytes = variants.serve_param_bytes(params_host)
+
+        def dense(p, x):
+            return step._forward(p, x, jax.random.PRNGKey(0), False,
+                                 local_trace=True)
+
+        sv_apply = v.apply
+
+        def fwd(p, x):
+            out = sv_apply(p, x, dense, shapes)
+            if self._softmax:
+                out = jax.nn.softmax(out, axis=-1)
+            return out
+
+        # -- AOT compile-or-load ---------------------------------------------
+        sig = serve_signature(wf, mesh, self._ring_slots, self.quantize,
+                              self._softmax, self._sample_shape,
+                              variants=step.variant_table())
+        self._aot_signature = sig
+        probe = np.zeros((self._ring_slots,) + self._sample_shape,
+                         np.float32)
+        cache = None
+        if self._aot_req not in (None, False):
+            cache = ServingAotCache(
+                None if self._aot_req == "auto" else self._aot_req)
+        self._aot_cache = cache
+        in_tree, out_tree = call_trees((prepared, probe))
+        fn = cache.load(sig, in_tree, out_tree) if cache else None
+        if fn is None:
+            if mesh is not None:
+                jfn = jax.jit(fwd,
+                              in_shardings=(plan["params"], plan["x"]),
+                              out_shardings=plan["out"])
+            else:
+                jfn = jax.jit(fwd)
+            absargs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a),
+                                               np.asarray(a).dtype),
+                (prepared, probe))
+            fn = jfn.lower(*absargs).compile()
+            self.aot_compiles += 1
+            self.aot_source = "compile"
+            if cache is not None:
+                cache.store(sig, fn)
+        else:
+            self.aot_source = "cache"
+        self._fn = fn
+        # params live device-resident under the plan for the server's
+        # lifetime; the ring batch is the only per-round transfer
+        self._params_dev = (jax.device_put(prepared, plan["params"])
+                            if mesh is not None
+                            else jax.device_put(prepared))
+        self._ring_put = make_input_put(step) or jax.device_put
+        # warm + validate the executable NOW (a corrupt-but-loadable
+        # artifact must fail the start, not the first request), and
+        # probe a quantized wire against the f32 forward of the REAL
+        # model — the ledger checked the canonical MLP; this checks the
+        # model actually being served
+        out = np.asarray(self._fn(self._params_dev,
+                                  self._ring_put(probe)))
+        if out.shape[0] != self._ring_slots:
+            raise RuntimeError(
+                f"serving executable returned {out.shape[0]} rows for "
+                f"a {self._ring_slots}-slot ring")
+        if self.quantize != "f32":
+            rows = min(self._ring_slots, 8)
+            rng = np.random.RandomState(11)
+            px = np.zeros_like(probe)
+            px[:rows] = rng.randn(rows, *self._sample_shape) \
+                .astype(np.float32)
+            got = np.asarray(self._fn(self._params_dev,
+                                      self._ring_put(px)))[:rows]
+            want = self._f32_reference(dense, params_host, px)[:rows]
+            err = float(np.max(np.abs(got - want)))
+            tol = 0.05
+            if err > tol:
+                raise ValueError(
+                    f"serve_forward/{self.quantize} refused: max "
+                    f"|quantized - f32| = {err:.3e} on the served "
+                    f"model's probe exceeds {tol}")
+            self.info("quantized serving wire %s: probe max err %.2e "
+                      "vs f32 (params %d -> %d bytes)", self.quantize,
+                      err, self._f32_bytes, self._wire_bytes)
+
+    def _f32_reference(self, dense, params_host, px) -> np.ndarray:
+        """The f32 forward of the served model on probe rows — the
+        reference a quantized wire is contracted against at startup."""
+        import jax
+        out = dense(jax.tree_util.tree_map(np.asarray, params_host), px)
+        if self._softmax:
+            out = jax.nn.softmax(out, axis=-1)
+        return np.asarray(out)
+
     # -- request handling -----------------------------------------------------
 
     def _bucket(self, n: int) -> int:
-        """Smallest power of two ≥ n, capped at max_batch — one compiled
-        program per bucket instead of max_batch compute per request."""
+        """Merge mode: smallest power of two ≥ n, capped at max_batch —
+        one compiled program per bucket instead of max_batch compute
+        per request."""
         b = 1
         while b < n:
             b *= 2
         return min(b, self.max_batch)
 
+    def _note_round(self, seconds: float) -> None:
+        """Fold one measured dispatch round into the EWMA behind the
+        overload Retry-After hint (callers hold no lock)."""
+        with self._cv:
+            self._round_s = (seconds if self._round_s <= 0
+                             else 0.8 * self._round_s + 0.2 * seconds)
+
+    def _retry_after_locked(self) -> Optional[float]:
+        """Seconds until admission capacity likely frees, derived from
+        the measured per-round latency and the queued backlog — the
+        PR-14 capacity-hint story applied to admission control. Called
+        under _cv; None before any round has been measured."""
+        if self._round_s <= 0:
+            return None
+        rows = sum(len(it["x"]) for it in self._pending)
+        per_round = max(1, (self._ring_slots
+                            if self.dispatch == "ring"
+                            else self.max_batch))
+        rounds = 1 + rows // per_round
+        return rounds * self._round_s
+
     def _forward_rows(self, x: np.ndarray) -> np.ndarray:
-        """Pad rows to their bucket, run ONE dispatch, unpad."""
+        """Merge mode: pad rows to their bucket, run ONE dispatch,
+        unpad."""
         n = len(x)
         pad = self._bucket(n) - n
         if pad:
@@ -202,11 +549,43 @@ class InferenceServer(Logger):
             # code path (the shared-write-no-lock contract)
             self.n_dispatches += 1
             self._m_dispatches.inc()
+        t0 = time.perf_counter()
         with self._lock:
             out = np.asarray(self._fn(self._state["params"], x))[:n]
+        self._note_round(time.perf_counter() - t0)
         if tok is not None:
             tr.end(tok)
         return out
+
+    def _shed_locked(self) -> None:
+        """The ONE rejection rule (called under _cv): raise the
+        admission error when the request must be shed — bounded
+        admission with a measured-latency Retry-After on overload. One
+        implementation for the handler's pre-parse fast path
+        (shed_check) and predict()'s admission, so the two 503 paths
+        can never diverge."""
+        if self._draining or self._stopping:
+            self.n_rejected += 1
+            self._m_rejected.inc()
+            raise ServerDraining("server draining")
+        if self._inflight >= self.queue_limit:
+            self.n_rejected += 1
+            self._m_rejected.inc()
+            raise ServerOverloaded(
+                f"overloaded: {self._inflight} requests in flight "
+                f"(queue_limit {self.queue_limit})",
+                retry_after=self._retry_after_locked())
+
+    def shed_check(self) -> None:
+        """Raise the admission error NOW if a request would be shed —
+        the handler calls this BEFORE parsing the JSON body, so a
+        server at its admission bound sheds at header cost instead of
+        spending GIL decoding a payload it is about to refuse (under
+        overload the shed path is the HOT path). predict() re-checks
+        under the same lock; the counters increment exactly once per
+        shed whichever check fires."""
+        with self._cv:
+            self._shed_locked()
 
     def predict(self, inputs: np.ndarray) -> Dict[str, Any]:
         x = np.asarray(inputs, np.float32)
@@ -214,31 +593,25 @@ class InferenceServer(Logger):
             raise ValueError(
                 f"expected per-sample shape {self._sample_shape}, got "
                 f"{x.shape[1:]}")
-        if len(x) > self.max_batch:
-            raise ValueError(f"batch {len(x)} exceeds max_batch "
-                             f"{self.max_batch}")
+        cap = self._request_cap()
+        if len(x) > cap:
+            raise ValueError(f"batch {len(x)} exceeds max_batch {cap}")
         n = len(x)
         t_admit = time.perf_counter()
         # bounded admission: reject at the door — a server melting down
-        # under a spike must shed load, not grow an unbounded queue
+        # under a spike must shed load, not grow an unbounded queue.
+        # The 503 carries a Retry-After derived from the measured
+        # per-round latency (one rule: _shed_locked).
         with self._cv:
-            if self._draining or self._stopping:
-                self.n_rejected += 1
-                self._m_rejected.inc()
-                raise ServerDraining("server draining")
-            if self._inflight >= self.queue_limit:
-                self.n_rejected += 1
-                self._m_rejected.inc()
-                raise ServerOverloaded(
-                    f"overloaded: {self._inflight} requests in flight "
-                    f"(queue_limit {self.queue_limit})")
+            self._shed_locked()
             self._inflight += 1
             self._m_requests.inc()
             self._m_inflight.set(self._inflight)
         try:
-            # _predict_batched re-checks the batcher under _cv — reading
-            # self._batcher unlocked here raced stop()'s teardown write
-            if self.batch_window_ms > 0:
+            # _predict_batched re-checks the dispatcher under _cv —
+            # reading self._batcher unlocked here raced stop()'s
+            # teardown write
+            if self.dispatch == "ring" or self.batch_window_ms > 0:
                 out = self._predict_batched(x)
             else:
                 out = self._forward_rows(x)
@@ -254,14 +627,22 @@ class InferenceServer(Logger):
             resp["classes"] = out.argmax(axis=-1).tolist()
         return resp
 
-    # -- micro-batching --------------------------------------------------------
+    # -- queued dispatch (ring rounds / merge micro-batching) ------------------
+
+    def _dispatch_direct(self, x: np.ndarray) -> np.ndarray:
+        """Synchronous dispatch for a server whose loop thread is not
+        running (never start()ed, or cleanly stopped): nothing to
+        coalesce with."""
+        if self.dispatch == "ring":
+            return self._ring_forward(x)
+        return self._forward_rows(x)
 
     def _predict_batched(self, x: np.ndarray) -> np.ndarray:
         item = {"x": x, "out": None, "err": None, "abandoned": False,
                 "done": threading.Event()}
         with self._cv:
-            # re-check under the lock: a batcher that already drained and
-            # exited would leave this item waiting forever
+            # re-check under the lock: a dispatcher that already drained
+            # and exited would leave this item waiting forever
             if self._stopping:
                 raise RuntimeError("server stopping")
             if self._batcher is None:
@@ -270,24 +651,26 @@ class InferenceServer(Logger):
             else:
                 direct = False
                 self._pending.append(item)
+                self._m_queue_depth.set(len(self._pending))
                 self._cv.notify()
         if direct:
-            return self._forward_rows(x)
+            return self._dispatch_direct(x)
         timeout = self.request_timeout_s or None
         if not item["done"].wait(timeout):
-            # deadline missed: mark abandoned so the batcher drops it if
-            # still queued (already-dispatched rows compute but nobody
-            # reads them), and answer the client NOW. Re-check done
-            # under the lock first: a dispatch completing in the gap
-            # between the wait timing out and the lock acquisition has
-            # a full result — return it rather than 503 finished work.
+            # deadline missed: mark abandoned so the dispatcher drops it
+            # if still queued (already-dispatched rows compute but
+            # nobody reads them), and answer the client NOW. Re-check
+            # done under the lock first: a dispatch completing in the
+            # gap between the wait timing out and the lock acquisition
+            # has a full result — return it rather than 503 finished
+            # work.
             with self._cv:
                 if not item["done"].is_set():
                     item["abandoned"] = True
                     try:
                         self._pending.remove(item)
                     except ValueError:
-                        pass    # already taken by the batcher
+                        pass    # already taken by the dispatcher
                     self.n_timeouts += 1
                     self._m_timeouts.inc()
                     raise RequestTimeout(
@@ -297,15 +680,147 @@ class InferenceServer(Logger):
             raise item["err"]
         return item["out"]
 
+    # -- the continuous-batching slot ring -------------------------------------
+
+    def _stage_ring(self, take: List[dict]) -> np.ndarray:
+        """Pack the admitted requests' rows into a fresh fixed-shape
+        host buffer (free slots stay zero — the jit contract is the
+        shape, and zero rows cost the same flops either way). A fresh
+        buffer per round keeps the async device_put safe: nothing ever
+        overwrites memory a transfer may still be reading."""
+        x = np.zeros((self._ring_slots,) + self._sample_shape,
+                     np.float32)
+        lo = 0
+        for it in take:
+            n = len(it["x"])
+            x[lo:lo + n] = it["x"]
+            lo += n
+        return x
+
+    def _ring_dispatch(self, take: List[dict], rows: int):
+        """Issue one ring round: stage, async sharded put, async
+        dispatch of the AOT executable. Returns the in-flight round
+        handle `_ring_deliver` completes."""
+        tr = self._tr
+        tok = tr.begin("serving.dispatch", "serving") \
+            if tr is not None else None
+        x = self._stage_ring(take)
+        with self._cv:
+            # counted at issue time, like _forward_rows — a stalled
+            # round is still a dispatched round
+            self.n_dispatches += 1
+            self._m_dispatches.inc()
+        self._m_occupancy.observe(rows)
+        t0 = time.perf_counter()
+        xd = self._ring_put(x)
+        out = self._fn(self._params_dev, xd)
+        return (take, out, t0, tok)
+
+    def _ring_deliver(self, round_) -> None:
+        """Complete one round: block on the device result, scatter
+        per-slot rows back to their requests, fold the measured round
+        latency into the Retry-After EWMA."""
+        take, out, t0, tok = round_
+        try:
+            host = np.asarray(out)      # device sync: round complete
+        except Exception as e:          # noqa: BLE001 — surface to
+            # every waiter instead of wedging their done events
+            for it in take:
+                it["err"] = e
+                it["done"].set()
+            if tok is not None:
+                self._tr.end(tok)
+            return
+        self._note_round(time.perf_counter() - t0)
+        lo = 0
+        for it in take:
+            n = len(it["x"])
+            it["out"] = host[lo:lo + n]
+            lo += n
+            it["done"].set()
+        if tok is not None:
+            self._tr.end(tok)
+
+    def _ring_forward(self, x: np.ndarray) -> np.ndarray:
+        """One synchronous ring round for a single request (the direct
+        path — loop thread not running)."""
+        item = {"x": x, "out": None, "err": None,
+                "done": threading.Event()}
+        round_ = self._ring_dispatch([item], len(x))
+        self._ring_deliver(round_)
+        if item["err"] is not None:
+            raise item["err"]
+        return item["out"]
+
+    def _ring_loop(self) -> None:
+        """The continuous-batching dispatch loop: every iteration
+        admits whole queued requests into the ring's free slots (up to
+        `ring_slots` rows, skipping abandoned ones) and dispatches the
+        round, THEN blocks on the PREVIOUS round's result — so while
+        round k executes on the device, round k+1 is already admitted,
+        staged, and its async sharded H2D put issued (the DeviceFeed
+        double-buffer pattern pointed at inference). A request that
+        would overflow this round's free rows waits exactly one round;
+        an empty queue with nothing in flight parks on the condvar.
+        On stop: the in-flight round is DELIVERED (requests resident in
+        ring slots complete) and only never-admitted queue items get
+        the clean "server stopping" error."""
+        inflight = None
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopping \
+                        and inflight is None:
+                    self._cv.wait()
+                stopping = self._stopping
+                if stopping:
+                    leftover, self._pending = self._pending, []
+                    self._m_queue_depth.set(0)
+                else:
+                    take, rows, rest = [], 0, []
+                    for it in self._pending:
+                        if it.get("abandoned"):
+                            continue    # timed out while queued: drop
+                        if rows + len(it["x"]) <= self._ring_slots:
+                            take.append(it)
+                            rows += len(it["x"])
+                        else:
+                            rest.append(it)
+                    self._pending = rest
+                    self._m_queue_depth.set(len(rest))
+            if stopping:
+                if inflight is not None:
+                    self._ring_deliver(inflight)
+                for it in leftover:
+                    it["err"] = RuntimeError("server stopping")
+                    it["done"].set()
+                return
+            nxt = None
+            if take:
+                try:
+                    nxt = self._ring_dispatch(take, rows)
+                except Exception as e:  # noqa: BLE001 — surface to
+                    # every waiter in the round
+                    for it in take:
+                        it["err"] = e
+                        it["done"].set()
+            if inflight is not None:
+                self._ring_deliver(inflight)
+            inflight = nxt
+
+    # -- merge-mode micro-batching ---------------------------------------------
+
     def _batch_loop(self) -> None:
-        """Coalesce queued requests into one forward per round. Demand-
-        driven: requests piling up while the previous forward runs are
-        taken together on the next round; a lone request dispatches
-        immediately (no idle window — the pre-batching latency). Only
-        when SEVERAL requests are already queued does the loop wait up
-        to batch_window_ms for stragglers. Takes whole requests only
-        (each ≤ max_batch by validation); one that would overflow the
-        merged batch waits for the next round."""
+        """Merge mode: coalesce queued requests into one forward per
+        round. Demand-driven: requests piling up while the previous
+        forward runs are taken together on the next round; a lone
+        request dispatches immediately (no idle window — the
+        pre-batching latency). Only when SEVERAL requests are already
+        queued does the loop wait up to batch_window_ms for stragglers.
+        Takes whole requests only (each ≤ max_batch by validation); one
+        that would overflow the merged batch waits for the next round.
+        Both `batch_window_ms` and `max_batch` are re-read per round —
+        live-tunable on a running server (the ring's geometry is NOT:
+        see the ring_slots property)."""
         while True:
             with self._cv:
                 while not self._pending and not self._stopping:
@@ -318,6 +833,7 @@ class InferenceServer(Logger):
                         it["err"] = RuntimeError("server stopping")
                         it["done"].set()
                     self._pending = []
+                    self._m_queue_depth.set(0)
                     return
                 if len(self._pending) > 1 and self.batch_window_ms > 0:
                     # concurrent writers active: brief straggler window
@@ -334,6 +850,7 @@ class InferenceServer(Logger):
                     else:
                         rest.append(it)
                 self._pending = rest
+                self._m_queue_depth.set(len(rest))
             if not take:
                 continue
             try:
@@ -371,9 +888,10 @@ class InferenceServer(Logger):
     def health(self) -> Dict[str, Any]:
         """/healthz payload: liveness + the dispatch counters an
         operator needs to see a batching/overload problem at a glance,
-        plus the static capacity hint (predicted model/batch bytes and
-        how many batch rings fit the device — the load balancer's
-        replica-sizing input)."""
+        the static capacity hint (predicted model/batch bytes and how
+        many batch rings fit the device — the load balancer's
+        replica-sizing input), and the measured per-round latency the
+        overload Retry-After is derived from."""
         with self._cv:
             status = "draining" if (self._draining or self._stopping) \
                 else "ok"
@@ -386,18 +904,34 @@ class InferenceServer(Logger):
                     "n_timeouts": self.n_timeouts,
                     "queue_limit": self.queue_limit,
                     "max_batch": self.max_batch,
+                    "dispatch": self.dispatch,
+                    "ring_slots": self.ring_slots,
+                    "round_latency_s": round(self._round_s, 6),
+                    "retry_after_s": self._retry_after_locked(),
                     "capacity": self._capacity_hint()}
 
     def model_info(self) -> Dict[str, Any]:
         wf = self.workflow
-        return {
+        info = {
             "workflow": getattr(wf, "name", type(wf).__name__),
             "input_shape": list(self._sample_shape),
             "max_batch": self.max_batch,
             "batch_window_ms": self.batch_window_ms,
             "n_classes": getattr(wf, "n_classes", None),
             "layers": [type(u).__name__ for u in wf.forwards],
+            "dispatch": self.dispatch,
+            "ring_slots": self.ring_slots,
+            "quantize": self.quantize,
         }
+        if self.dispatch == "ring":
+            plan = self._plan
+            info["sharded"] = plan["mesh"] is not None
+            info["mesh_axes"] = plan["geometry"]
+            info["aot"] = {"source": self.aot_source,
+                           "compiles": self.aot_compiles}
+            info["param_bytes"] = {"f32": self._f32_bytes,
+                                   "wire": self._wire_bytes}
+        return info
 
     # -- http lifecycle --------------------------------------------------------
 
@@ -407,11 +941,23 @@ class InferenceServer(Logger):
         from veles_tpu.http_util import check_shared_token
 
         class Handler(BaseHTTPRequestHandler):
-            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+            # keep-alive: one connection (and one server thread) per
+            # CLIENT instead of per request — at loadtest rates the
+            # per-request TCP connect + thread spawn of HTTP/1.0 was
+            # the measured bottleneck, not the model. Every response
+            # path below sends Content-Length (check_shared_token's
+            # 403 included), which HTTP/1.1 requires to keep the
+            # connection readable.
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code: int, payload: Dict[str, Any],
+                      headers: Optional[Dict[str, str]] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -446,6 +992,15 @@ class InferenceServer(Logger):
                     self._send(404, {"error": "unknown endpoint"})
 
             def do_POST(self) -> None:  # noqa: N802
+                # keep-alive discipline: any response sent while the
+                # request body is still unread in the socket would
+                # desync the NEXT request on the connection (its bytes
+                # parse as a bogus request line) — every reject path
+                # below therefore closes the connection; only the
+                # normal path (body fully consumed) restores what the
+                # request's own version/headers negotiated
+                negotiated = self.close_connection
+                self.close_connection = True
                 if not self.path.startswith("/predict"):
                     self._send(404, {"error": "unknown endpoint"})
                     return
@@ -465,17 +1020,31 @@ class InferenceServer(Logger):
                                {"error": f"body must be 0..{srv.max_body}"
                                          " bytes"})
                     return
+                self.close_connection = negotiated  # body consumed below
                 try:
-                    req = json.loads(self.rfile.read(n))
+                    body = self.rfile.read(n)   # keep-alive: always
+                    # consume the body, even on the shed path
+                    srv.shed_check()
+                    req = json.loads(body)
                     resp = srv.predict(req["inputs"])
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(400, {"error": str(e)[:300]})
                     return
                 except RuntimeError as e:
-                    # overload / drain / timeout / batcher stop: a clean
-                    # 503 the client can retry against another replica,
-                    # not a dropped connection or an unbounded wait
-                    self._send(503, {"error": str(e)[:300]})
+                    # overload / drain / timeout / dispatcher stop: a
+                    # clean 503 the client can retry against another
+                    # replica, not a dropped connection or an unbounded
+                    # wait. An overload 503 carries Retry-After derived
+                    # from the measured per-round latency — the
+                    # capacity hint applied to admission.
+                    payload: Dict[str, Any] = {"error": str(e)[:300]}
+                    headers = None
+                    ra = getattr(e, "retry_after", None)
+                    if ra:
+                        payload["retry_after_s"] = round(ra, 3)
+                        headers = {"Retry-After":
+                                   str(max(1, int(math.ceil(ra))))}
+                    self._send(503, payload, headers)
                     return
                 self._send(200, resp)
 
@@ -486,27 +1055,33 @@ class InferenceServer(Logger):
         self.port = self._httpd.server_address[1]
         self._draining = False      # restart after a drained stop()
         self._started_at = time.time()
-        if self.batch_window_ms > 0:
+        if self.dispatch == "ring" or self.batch_window_ms > 0:
             if self._batcher is not None and not self._batcher.is_alive():
                 # a previous stop() timed out its join but the thread has
                 # since exited: clear the tombstone so restart works
                 self._batcher = None
                 self._stopping = False
             if self._batcher is None:
+                target = (self._ring_loop if self.dispatch == "ring"
+                          else self._batch_loop)
                 self._batcher = threading.Thread(
-                    target=self._batch_loop, daemon=True, name="batcher")
+                    target=target, daemon=True, name="batcher")
                 self._batcher.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="inference")
         self._thread.start()
         self.info_log = f"serving on http://{self.host}:{self.port}"
-        self.info("inference %s (POST /predict, GET /info)", self.info_log)
+        self.info("inference %s (POST /predict, GET /info; %s dispatch)",
+                  self.info_log, self.dispatch)
         return self
 
     def stop(self, drain_s: float = 5.0) -> None:
         """Graceful shutdown: refuse new requests (503), let in-flight
-        batches finish (bounded by `drain_s`), then close the listener
-        and stop the batcher. `drain_s=0` is the old hard stop."""
+        rounds finish (bounded by `drain_s`), then close the listener
+        and stop the dispatcher. `drain_s=0` is the old hard stop. In
+        ring mode the loop delivers the round still resident in the
+        ring before exiting — admitted requests complete, only
+        never-admitted queue items get the clean error."""
         with self._cv:
             self._draining = True
             deadline = time.time() + drain_s
@@ -531,7 +1106,7 @@ class InferenceServer(Logger):
                 # sleep): leave _stopping set so the thread exits at its
                 # next wake and keep the reference so a later start()
                 # cannot spawn a racing duplicate
-                self.warning("batcher still draining at stop()")
+                self.warning("dispatcher still draining at stop()")
             else:
                 # teardown writes under _cv: handler threads re-check
                 # both fields under the same lock in _predict_batched

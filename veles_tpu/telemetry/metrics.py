@@ -51,6 +51,9 @@ STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 #: serving latency buckets (seconds)
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0)
+#: serving ring occupancy buckets (rows per dispatched round)
+RING_OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                          256.0, 512.0)
 
 #: bound on distinct label-value children per family — a scrape target
 #: must stay O(1) even if a caller labels by something unbounded
@@ -312,6 +315,43 @@ class MetricsRegistry:
         return out
 
 
+def histogram_quantile(family: Family, q: float,
+                       **labelvalues: str) -> Optional[float]:
+    """Prometheus-style quantile estimate from a histogram family's
+    cumulative buckets (linear interpolation inside the bucket, the
+    ``histogram_quantile()`` PromQL rule) — the READ-BACK path
+    tools/loadtest.py reports p50/p99 through, so a latency number in a
+    record is always derivable from the scraped registry, never a
+    side-channel list. None when the (labeled) child has no
+    observations. The estimate's resolution is the bucket grid; the
+    last bucket clamps to its upper bound (+Inf falls back to the
+    highest finite bound)."""
+    if family.kind != "histogram":
+        raise TypeError(f"{family.name} is a {family.kind}")
+    if labelvalues:
+        key = tuple(str(labelvalues[ln])[:128]
+                    for ln in family.labelnames)
+    else:
+        key = ()
+    with family._lock:
+        ch = family._children.get(key)
+        if ch is None or ch.count == 0:
+            return None
+        counts = list(ch.bucket_counts)
+        total = ch.count
+    rank = max(0.0, min(1.0, float(q))) * total
+    cum = 0
+    lo = 0.0
+    for ub, n in zip(family.buckets, counts):
+        if cum + n >= rank and n > 0:
+            frac = (rank - cum) / n
+            return lo + (ub - lo) * frac
+        cum += n
+        lo = ub
+    # rank lands in the +Inf bucket: clamp to the highest finite bound
+    return family.buckets[-1] if family.buckets else None
+
+
 #: exposition content type (scrape endpoints set it verbatim)
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -362,6 +402,16 @@ def register_standard(reg: MetricsRegistry) -> None:
                 "(tools/ablate.py --collectives harness; the driver "
                 "models bytes, never syncs for time)",
                 labelnames=("op",))
+    reg.gauge("veles_serving_queue_depth",
+              "predict requests queued for the serving dispatch loop "
+              "(ring admission / merge batcher), sampled at every "
+              "enqueue and round")
+    reg.histogram("veles_serving_ring_occupancy",
+                  "occupied rows per dispatched serving ring round — "
+                  "ring efficiency measured, not claimed (a low "
+                  "occupancy under load means admission, not the "
+                  "device, is the bottleneck)",
+                  buckets=RING_OCCUPANCY_BUCKETS)
 
 
 _DEFAULT: Optional[MetricsRegistry] = None
