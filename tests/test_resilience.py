@@ -237,6 +237,192 @@ def test_import_still_reads_hardened_snapshot(tmp_path):
     assert wf.name == "SnapWF"
 
 
+def test_latest_skips_snapshot_with_garbage_sidecar(tmp_path):
+    """A sidecar whose digest text is garbage (bitrot, hand-edit) fails
+    verification even though the snapshot bytes themselves are intact —
+    the sidecar is the trust anchor, so latest(verify=True) must fall
+    back to the previous snapshot."""
+    old = _snapshot(tmp_path, "old", mtime=1_000_000)
+    new = _snapshot(tmp_path, "new", mtime=2_000_000)
+    with open(new + ".sha256", "w") as f:
+        f.write("deadbeef" * 8 + "  " + os.path.basename(new) + "\n")
+    assert not Snapshotter.verify(new)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard",
+                              verify=True) == old
+
+
+def test_latest_skips_snapshot_with_truncated_sidecar(tmp_path):
+    """A sidecar truncated to zero bytes (torn sidecar write) must fail
+    verification — NOT fall through to the legacy no-sidecar stream
+    check, which the intact gz body would pass."""
+    old = _snapshot(tmp_path, "old", mtime=1_000_000)
+    new = _snapshot(tmp_path, "new", mtime=2_000_000)
+    with open(new + ".sha256", "w"):
+        pass
+    assert not Snapshotter.verify(new)
+    assert Snapshotter.latest(str(tmp_path), prefix="hard",
+                              verify=True) == old
+
+
+def test_import_restore_prng_false_preserves_process_streams(tmp_path):
+    """Serving-side imports (the weight watcher) must not clobber the
+    process-wide RNG registry the training loop owns."""
+    from veles_tpu import prng
+    path = _snapshot(tmp_path, "prng")
+    prng.seed_all(777)
+    marker = prng.get().randint(0, 10 ** 6, size=8)
+    prng.seed_all(777)
+    Snapshotter.import_(path, restore_prng=False)
+    np.testing.assert_array_equal(
+        prng.get().randint(0, 10 ** 6, size=8), marker)
+
+
+# -- mirror-bus hardening + bounded backoff ------------------------------------
+
+
+def test_put_meta_atomic_under_mid_write_reader(tmp_path, monkeypatch):
+    """Regression (ISSUE 16 satellite): a reader injected MID-WRITE —
+    after half the new record's bytes are down, before the atomic
+    rename — must still see the complete PREVIOUS record, never a torn
+    one. (A naive write-in-place implementation fails this probe.)"""
+    from veles_tpu.resilience import mirror as mirror_mod
+    m = mirror_mod.DirMirror(str(tmp_path / "mir"))
+    first = {"gen": 1, "blob": "x" * 4096}
+    second = {"gen": 2, "blob": "y" * 4096}
+    assert m.put_meta("coord.json", first)
+    observed = []
+    real_dumps = json.dumps
+
+    def half_then_probe_dump(obj, f, **kw):
+        s = real_dumps(obj, **kw)
+        f.write(s[:len(s) // 2])
+        f.flush()
+        os.fsync(f.fileno())
+        observed.append(m.get_meta("coord.json"))   # the injected reader
+        f.write(s[len(s) // 2:])
+
+    monkeypatch.setattr(mirror_mod.json, "dump", half_then_probe_dump)
+    assert m.put_meta("coord.json", second)
+    monkeypatch.undo()
+    assert observed == [first]
+    assert m.get_meta("coord.json") == second
+
+
+def test_put_meta_fsyncs_before_publish(tmp_path, monkeypatch):
+    """The meta record must be durable BEFORE the rename publishes it
+    (power loss between rename and writeback must not surface an empty
+    coordinator record)."""
+    from veles_tpu.resilience import mirror as mirror_mod
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        mirror_mod.os, "fsync",
+        lambda fd: (synced.append(fd), real_fsync(fd))[1])
+    m = mirror_mod.DirMirror(str(tmp_path / "mir"))
+    assert m.put_meta("coord.json", {"gen": 1})
+    assert synced
+
+
+def test_call_with_backoff_retries_then_succeeds():
+    from veles_tpu.resilience.backoff import call_with_backoff
+    sleeps, attempts = [], []
+
+    def fn():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = call_with_backoff(fn, attempts=5, base=0.1, cap=1.0,
+                            retry_on=(OSError,), jitter=0.0,
+                            sleep=sleeps.append, clock=lambda: 0.0)
+    assert out == "ok"
+    assert len(attempts) == 3
+    assert sleeps == [0.1, 0.2]     # the shared exponential policy
+
+
+def test_call_with_backoff_total_budget_caps_wall_clock():
+    """`total` is a HARD budget including sleeps: when the next backoff
+    would cross it, the last failure re-raises instead of sleeping —
+    a retrying fetch inside a poll loop can never stall the poll."""
+    from veles_tpu.resilience.backoff import call_with_backoff
+    t = [0.0]
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        call_with_backoff(fn, attempts=50, base=1.0, cap=8.0,
+                          total=5.0, retry_on=(OSError,), jitter=0.0,
+                          sleep=lambda d: t.__setitem__(0, t[0] + d),
+                          clock=lambda: t[0])
+    assert t[0] < 5.0               # never slept past the budget
+    assert 2 <= len(calls) < 50     # gave up early, not at attempts
+
+
+def test_call_with_backoff_non_matching_exception_propagates():
+    from veles_tpu.resilience.backoff import call_with_backoff
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        call_with_backoff(fn, attempts=5, base=0.01, cap=0.1,
+                          retry_on=(OSError,), jitter=0.0,
+                          sleep=lambda d: None, clock=lambda: 0.0)
+    assert len(calls) == 1          # not a retry_on match: no retries
+
+
+def test_http_mirror_retries_5xx_but_not_4xx():
+    """Transient server errors burn the bounded retry budget; a 404 is
+    a PERMANENT answer (the entry is not there) — retrying it would
+    stall every not-yet-pushed-sidecar probe."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from veles_tpu.resilience.mirror import HttpMirror
+    hits = {"index": 0, "side": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if "index=1" in self.path:
+                hits["index"] += 1
+                self.send_response(500)
+            else:
+                hits["side"] += 1
+                self.send_response(404)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        m = HttpMirror(f"http://127.0.0.1:{httpd.server_port}",
+                       retries=3, retry_base=0.01, retry_cap=0.02,
+                       retry_total=5.0)
+        assert m.entries() == []            # degraded, not raised
+        assert hits["index"] == 3           # 5xx: retried to budget
+        assert not m.has("snap.pickle.gz", "d" * 64)
+        assert hits["side"] == 1            # 4xx: answered, no retry
+    finally:
+        httpd.shutdown()
+
+
+def test_http_mirror_retry_budget_sits_below_watcher_poll():
+    """The default total retry budget must stay strictly below the
+    weight watcher's default poll interval, so one poll's fetch can
+    never stall into the next."""
+    from veles_tpu.resilience.mirror import HttpMirror
+    m = HttpMirror("http://127.0.0.1:9")
+    assert m.retry_total < 10.0             # WeightWatcher default poll_s
+
+
 # -- non-finite loss guard -----------------------------------------------------
 
 def _tiny_workflow(max_epochs=5):

@@ -1,0 +1,400 @@
+"""Train-to-serve hot-swap (ISSUE 16): blue/green weight generations
+swapped into the running slot ring between rounds, the WeightWatcher
+closing the mirror-bus loop, /rollback, and the refusal ladder — every
+failure degrades to "keep serving the current generation"."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _make_workflow(width=24, sample=10, n_classes=4, name="SwapWF",
+                   seed=41):
+    from veles_tpu import prng
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.seed_all(seed)
+    loader = SyntheticClassifierLoader(
+        n_classes=n_classes, sample_shape=(sample,), n_validation=40,
+        n_train=160, minibatch_size=40, noise=0.3)
+    wf = StandardWorkflow(
+        layers=[{"type": "all2all_tanh", "output_sample_shape": width,
+                 "weights_stddev": 0.1},
+                {"type": "softmax", "output_sample_shape": n_classes,
+                 "weights_stddev": 0.05}],
+        loader=loader, loss="softmax", n_classes=n_classes,
+        decision_config={"max_epochs": 2, "fail_iterations": 50},
+        gd_config={"learning_rate": 0.1, "gradient_moment": 0.9},
+        name=name)
+    wf.initialize(device=None)
+    return wf
+
+
+@pytest.fixture(scope="module")
+def swap_wf():
+    return _make_workflow()
+
+
+def _server(wf, **kw):
+    from veles_tpu.serving import InferenceServer
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("aot_cache", False)
+    return InferenceServer(wf, **kw)
+
+
+def _perturbed(wf, factor=1.01):
+    """Same-geometry candidate: every param nudged by `factor` (finite,
+    self-consistent — the probe compares against ITS OWN f32 forward)."""
+    for u in wf.forwards:
+        for a in u.param_arrays().values():
+            a.mem = np.asarray(a.mem) * np.float32(factor)
+    return wf
+
+
+def _post(url, path="/predict", rows=None, timeout=30):
+    body = json.dumps({"inputs": rows}).encode() if rows is not None \
+        else b""
+    req = urllib.request.Request(
+        url + path, data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- swap_params: the between-rounds generation swap ------------------------
+
+
+def test_swap_changes_outputs_without_recompile(swap_wf):
+    srv = _server(swap_wf)
+    x = np.asarray(swap_wf.loader.data.mem[:6])
+    before = np.asarray(srv.predict(x)["outputs"])
+    boot = srv.generation()
+    assert boot["source"] == "boot"
+    aot_before = srv.model_info()["aot"]
+    gen = srv.swap_params(_perturbed(_make_workflow(seed=41)),
+                          source="test")
+    after = np.asarray(srv.predict(x)["outputs"])
+    assert not np.allclose(before, after)
+    assert srv.generation()["digest"] == gen["digest"]
+    assert gen["digest"] != boot["digest"]
+    assert gen["source"] == "test"
+    assert srv.n_swaps == 1
+    # no recompile: the AOT executable is untouched by the swap
+    assert srv.model_info()["aot"] == aot_before
+
+
+def test_swap_default_digest_is_params_content_hash(swap_wf):
+    from veles_tpu.serving import params_digest
+    srv = _server(swap_wf)
+    cand = _perturbed(_make_workflow(seed=41))
+    params_host = [{k: np.asarray(a.mem)
+                    for k, a in u.param_arrays().items()}
+                   for u in cand.forwards]
+    gen = srv.swap_params(cand)
+    assert gen["digest"] == params_digest(params_host)
+
+
+def test_swap_geometry_refused_keeps_serving(swap_wf):
+    from veles_tpu.serving import SwapRefused
+    srv = _server(swap_wf)
+    x = np.asarray(swap_wf.loader.data.mem[:6])
+    before = np.asarray(srv.predict(x)["outputs"])
+    live = srv.generation()["digest"]
+    with pytest.raises(SwapRefused) as exc:
+        srv.swap_params(_make_workflow(width=32, seed=43))
+    assert exc.value.reason == "geometry"
+    # the contract: current generation keeps serving, refusal recorded
+    assert srv.generation()["digest"] == live
+    np.testing.assert_allclose(
+        np.asarray(srv.predict(x)["outputs"]), before)
+    assert srv.n_swap_refusals == 1
+    h = srv.health()
+    assert h["swaps"]["refused"] == 1
+    assert h["swaps"]["last_refusal"]["reason"] == "geometry"
+
+
+def test_swap_nonfinite_candidate_refused(swap_wf):
+    from veles_tpu.serving import SwapRefused
+    srv = _server(swap_wf)
+    bad = _make_workflow(seed=41)
+    first = next(iter(bad.forwards[0].param_arrays().values()))
+    first.mem = np.full_like(np.asarray(first.mem), np.nan)
+    with pytest.raises(SwapRefused) as exc:
+        srv.swap_params(bad)
+    assert exc.value.reason == "nonfinite"
+    assert srv.generation()["source"] == "boot"
+
+
+def test_swap_metrics_reach_the_registry(swap_wf):
+    from veles_tpu.serving import SwapRefused
+    from veles_tpu.telemetry import metrics as tm
+    reg = tm.default_registry()
+    applied0 = reg.counter(
+        "veles_serving_swap_applied_total").value
+    srv = _server(swap_wf)
+    srv.swap_params(_perturbed(_make_workflow(seed=41)))
+    with pytest.raises(SwapRefused):
+        srv.swap_params(_make_workflow(width=32, seed=43))
+    assert reg.counter(
+        "veles_serving_swap_applied_total").value == applied0 + 1
+    refused = reg.counter("veles_serving_swap_refused_total")
+    assert refused.labels(reason="geometry").value >= 1
+    # and exposition carries the labeled child
+    expo = reg.exposition()
+    assert 'veles_serving_swap_refused_total{reason="geometry"}' in expo
+
+
+# -- rollback: blue/green, the outgoing generation stays device-resident ----
+
+
+def test_rollback_restores_previous_generation(swap_wf):
+    from veles_tpu.serving import SwapRefused
+    srv = _server(swap_wf)
+    x = np.asarray(swap_wf.loader.data.mem[:6])
+    before = np.asarray(srv.predict(x)["outputs"])
+    boot = srv.generation()["digest"]
+    with pytest.raises(SwapRefused) as exc:
+        srv.rollback()          # nothing to roll back to yet
+    assert exc.value.reason == "no_previous"
+    gen = srv.swap_params(_perturbed(_make_workflow(seed=41)))
+    rb = srv.rollback()
+    assert rb["digest"] == boot
+    assert rb["source"] == "rollback"
+    # bit-exact: the previous generation never left the device
+    np.testing.assert_array_equal(
+        np.asarray(srv.predict(x)["outputs"]), before)
+    # the rolled-back digest is PINNED against watcher re-application
+    assert gen["digest"] in srv.rolled_back
+
+
+def test_rollback_http_endpoint(swap_wf):
+    srv = _server(swap_wf).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        status, resp = _post(url, "/rollback")
+        assert status == 409
+        assert resp["reason"] == "no_previous"
+        srv.swap_params(_perturbed(_make_workflow(seed=41)))
+        status, resp = _post(url, "/rollback")
+        assert status == 200
+        assert resp["generation"]["source"] == "rollback"
+        assert srv.generation()["digest"] == \
+            resp["generation"]["digest"]
+    finally:
+        srv.stop(drain_s=0)
+
+
+def test_healthz_exposes_generations(swap_wf):
+    srv = _server(swap_wf).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        gen = h["generation"]
+        assert gen["source"] == "boot"
+        assert gen["serving_for_s"] >= 0
+        assert h["previous_generation"] is None
+        assert h["swaps"] == {"applied": 0, "refused": 0,
+                              "last_refusal": None}
+        old = gen["digest"]
+        srv.swap_params(_perturbed(_make_workflow(seed=41)))
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["generation"]["digest"] != old
+        assert h["previous_generation"] == old
+        assert h["swaps"]["applied"] == 1
+    finally:
+        srv.stop(drain_s=0)
+
+
+# -- WeightWatcher: the mirror-bus loop -------------------------------------
+
+
+def _push_snapshot(wf, tmp_path, tag):
+    from veles_tpu.snapshotter import Snapshotter
+    snap = Snapshotter(workflow=wf, prefix="swapwf",
+                       directory=str(tmp_path))
+    snap.suffix = tag
+    path = snap.export()
+    with open(path + ".sha256") as f:
+        return path, f.read().split()[0]
+
+
+def test_watcher_applies_mirror_push(swap_wf, tmp_path):
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving_watch import WeightWatcher
+    srv = _server(swap_wf)
+    mirror = DirMirror(str(tmp_path / "mirror"))
+    w = WeightWatcher(srv, mirror, prefix="swapwf", poll_s=60,
+                      tmp_dir=str(tmp_path / "scratch"))
+    assert w.poll_once() is None        # empty mirror: normal, no error
+    assert w.status()["streak"] == 0
+    path, digest = _push_snapshot(
+        _perturbed(_make_workflow(seed=41)), tmp_path, "gen1")
+    mirror.push(path)
+    gen = w.poll_once()
+    # the generation label IS the mirror sidecar digest
+    assert gen["digest"] == digest
+    assert gen["source"] == "watcher"
+    assert srv.generation()["digest"] == digest
+    assert w.poll_once() is None        # already live: no-op
+    assert w.status()["n_applied"] == 1
+
+
+def test_watcher_refuses_corrupt_push_and_keeps_serving(
+        swap_wf, tmp_path):
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving_watch import WeightWatcher
+    srv = _server(swap_wf)
+    live = srv.generation()["digest"]
+    mirror = DirMirror(str(tmp_path / "mirror"))
+    w = WeightWatcher(srv, mirror, prefix="swapwf", poll_s=60,
+                      tmp_dir=str(tmp_path / "scratch"))
+    path, _ = _push_snapshot(
+        _perturbed(_make_workflow(seed=41)), tmp_path, "torn")
+    mirror.push(path)
+    import os
+    mirror._corrupt(os.path.basename(path))
+    assert w.poll_once() is None
+    st = w.status()
+    assert st["n_refused"] == 1
+    assert "fetch_failed" in st["last_error"]
+    # fetch failures stay RETRYABLE (the trainer may be mid-push)
+    assert st["refused_digests"] == []
+    assert srv.generation()["digest"] == live
+
+
+def test_watcher_remembers_poisoned_digest(swap_wf, tmp_path):
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving_watch import WeightWatcher
+    srv = _server(swap_wf)
+    mirror = DirMirror(str(tmp_path / "mirror"))
+    w = WeightWatcher(srv, mirror, prefix="swapwf", poll_s=60,
+                      tmp_dir=str(tmp_path / "scratch"))
+    path, digest = _push_snapshot(
+        _make_workflow(width=32, seed=43), tmp_path, "wide")
+    mirror.push(path)
+    assert w.poll_once() is None
+    st = w.status()
+    assert st["n_refused"] == 1
+    assert "geometry" in st["last_error"]
+    assert st["refused_digests"] == [digest[:12]]
+    assert w.poll_once() is None        # remembered: no refusal churn
+    assert w.status()["n_refused"] == 1
+    assert srv.generation()["source"] == "boot"
+    assert srv.health()["swaps"]["refused"] == 1
+
+
+def test_watcher_skips_rolled_back_digest(swap_wf, tmp_path):
+    """A rollback PINS serving: the watcher must not immediately
+    re-apply the digest that was just rolled back from."""
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving_watch import WeightWatcher
+    srv = _server(swap_wf)
+    mirror = DirMirror(str(tmp_path / "mirror"))
+    w = WeightWatcher(srv, mirror, prefix="swapwf", poll_s=60,
+                      tmp_dir=str(tmp_path / "scratch"))
+    cand = _perturbed(_make_workflow(seed=41))
+    path, digest = _push_snapshot(cand, tmp_path, "gen1")
+    mirror.push(path)
+    assert w.poll_once()["digest"] == digest
+    rb = srv.rollback()
+    assert rb["source"] == "rollback"
+    assert w.poll_once() is None        # still newest on the mirror —
+    assert srv.generation()["digest"] == rb["digest"]   # but pinned
+    # a NEW digest clears the pin: push gen2, the watcher applies it
+    path2, digest2 = _push_snapshot(_perturbed(cand), tmp_path, "gen2")
+    mirror.push(path2)
+    assert w.poll_once()["digest"] == digest2
+
+
+def test_watcher_import_does_not_clobber_process_prng(
+        swap_wf, tmp_path):
+    from veles_tpu import prng
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving_watch import WeightWatcher
+    srv = _server(swap_wf)
+    mirror = DirMirror(str(tmp_path / "mirror"))
+    w = WeightWatcher(srv, mirror, prefix="swapwf", poll_s=60,
+                      tmp_dir=str(tmp_path / "scratch"))
+    path, _ = _push_snapshot(
+        _perturbed(_make_workflow(seed=41)), tmp_path, "gen1")
+    mirror.push(path)
+    prng.seed_all(12345)
+    marker = prng.get().randint(0, 10 ** 6, size=8)
+    prng.seed_all(12345)
+    assert w.poll_once() is not None
+    # restore_prng=False: the stream continues exactly as seeded
+    np.testing.assert_array_equal(
+        prng.get().randint(0, 10 ** 6, size=8), marker)
+
+
+def test_web_status_shows_swap_block(swap_wf):
+    from veles_tpu.serving import SwapRefused
+    from veles_tpu.web_status import workflow_status
+    srv = _server(swap_wf)
+    srv.swap_params(_perturbed(_make_workflow(seed=41)))
+    with pytest.raises(SwapRefused):
+        srv.swap_params(_make_workflow(width=32, seed=43))
+    st = workflow_status(swap_wf)
+    assert st["serving"]["swaps_applied"] >= 1
+    assert "geometry" in st["serving"]["swaps_refused"]
+
+
+# -- the chaos matrix + loadtest twins (slow) -------------------------------
+
+
+def _load_tool(name):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        f"veles_{name}", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_chaos_swap_matrix_all_pass():
+    """The committed proof's twin: every hot-swap chaos scenario —
+    swap under load, corrupt mid-push, truncated sidecar, wrong
+    geometry, rollback under load, mirror unreachable — keeps serving
+    the correct generation."""
+    chaos = _load_tool("chaos")
+    results = {name: chaos.run_swap_scenario(name, verbose=True)
+               for name in chaos.SWAP_SCENARIOS}
+    problems = {n: r["problems"] for n, r in results.items()
+                if not r["ok"]}
+    assert problems == {}
+
+
+@pytest.mark.slow
+def test_loadtest_swap_smoke_zero_failed_requests(tmp_path):
+    """`tools/loadtest.py --swap --smoke`: two watcher-applied pushes
+    + one rollback inside one open-loop window, zero failed requests,
+    record schema as committed in SWAP_RECORD.json."""
+    lt = _load_tool("loadtest")
+    record_path = str(tmp_path / "SWAP_RECORD.json")
+    rc = lt.main(["--swap", "--smoke", "--record", record_path])
+    assert rc == 0
+    rec = json.load(open(record_path))
+    assert rec["mode"] == "swap"
+    assert rec["status"] == "ok"
+    s = rec["swap"]
+    assert s["pass"] is True
+    assert s["zero_failed_requests"] is True
+    assert s["swaps_applied"] >= 3      # 2 pushes + 1 rollback
+    assert s["final_generation"]["digest"] == \
+        s["expected_final_digest"]
+    leg = rec["legs"]["swap"]
+    assert leg["errors"] == 0 and leg["shed"] == 0
+    assert any(ln.startswith("veles_serving_swap_applied_total")
+               for ln in rec["registry"])

@@ -5,6 +5,7 @@ recovery matrix.
 
     python tools/chaos.py [--keep] [--only kill,stall,...]
     python tools/chaos.py --cluster [--only kill_h0,coord_loss,...]
+    python tools/chaos.py --swap [--only corrupt_mid_push,...]
 
 Each single-host scenario runs `python -m veles_tpu --supervise` on a
 tiny synthetic-classifier workflow (6 epochs, snapshots on improvement)
@@ -28,10 +29,20 @@ bump), a dead host shrinking the membership (run continues), and a
 shrink below the --cluster-hosts floor (clean fail-stop, exit 84 with
 machine-readable dead_hosts).
 
+`--swap` runs the HOT-SWAP matrix (ISSUE 16) instead: an in-process
+ring `InferenceServer` + DirMirror + `WeightWatcher` per scenario,
+proving that live weight pushes apply between rounds under traffic
+with zero failed requests, that corrupt/truncated/wrong-geometry
+snapshots are REFUSED while the prior generation keeps serving, that
+POST /rollback flips to the previous device-resident generation (and
+pins it against re-application), and that a dead mirror endpoint costs
+bounded per-poll retries and nothing else.
+
 This is the operational twin of tests/test_supervisor.py +
-tests/test_cluster.py: CI asserts a fast subset; this prints the whole
-matrix for a human (and is the thing to run after touching supervisor/
-cluster/mirror/snapshotter/fault code).
+tests/test_cluster.py (+ tests/test_serving_swap.py for --swap): CI
+asserts a fast subset; this prints the whole matrix for a human (and
+is the thing to run after touching supervisor/cluster/mirror/
+snapshotter/fault/serving-swap code).
 """
 
 from __future__ import annotations
@@ -42,7 +53,9 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -323,6 +336,332 @@ def run_cluster_scenario(name: str, spec: dict, verbose: bool) -> dict:
             "elapsed": elapsed}
 
 
+# -- the hot-swap matrix (ISSUE 16) ------------------------------------------
+#
+# In-process (no subprocesses): a ring `InferenceServer` + DirMirror +
+# `WeightWatcher` per scenario, each proving one leg of the robustness
+# contract — ANY swap failure degrades to "keep serving the current
+# generation, record the refusal"; serving never restarts, drains or
+# recompiles to recover. Timing-sensitive scenarios drive the
+# synchronous `watcher.poll_once()` unit; the under-load pair runs the
+# real poll thread with a live request lane.
+
+def _swap_build_wf(width: int = 16, sample: int = 8):
+    """The loadtest synthetic-MLP builder (same workload family the
+    committed SWAP_RECORD.json was measured on)."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    for p in (REPO, tools_dir):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import loadtest
+    return loadtest._build_workflow(width, sample, 4, depth=1)
+
+
+class _SwapHarness:
+    """One scenario's serving stack: ring server + mirror + watcher +
+    an optional background request lane counting outcomes."""
+
+    def __init__(self, poll_s: float = 0.2) -> None:
+        if REPO not in sys.path:    # run as `python tools/chaos.py`
+            sys.path.insert(0, REPO)
+        from veles_tpu.resilience.mirror import DirMirror
+        from veles_tpu.serving import InferenceServer
+        from veles_tpu.serving_watch import WeightWatcher
+        self.tmp = tempfile.mkdtemp(prefix="chaos_swap_")
+        self.wf = _swap_build_wf()
+        self.sample = 8
+        self.srv = InferenceServer(
+            self.wf, max_batch=16, queue_limit=128, dispatch="ring",
+            ring_slots=16).start()
+        self.mirror = DirMirror(os.path.join(self.tmp, "mirror"))
+        self.watcher = WeightWatcher(self.srv, self.mirror,
+                                     prefix="swapwf", poll_s=poll_s)
+        self.url = f"http://127.0.0.1:{self.srv.port}"
+        self.counts = {"ok": 0, "shed": 0, "error": 0}
+        self._load_stop = threading.Event()
+        self._load_thread = None
+
+    # -- snapshot pushes ------------------------------------------------------
+
+    def push(self, tag: str, wf=None):
+        """Perturb + export + mirror-push one snapshot generation;
+        returns (mirror entry name, sidecar digest)."""
+        import numpy as np
+        from veles_tpu.snapshotter import Snapshotter
+        src = wf if wf is not None else self.wf
+        for u in src.forwards:
+            for a in u.param_arrays().values():
+                a.mem = np.asarray(a.mem) * np.float32(1.01)
+        snap = Snapshotter(workflow=src, prefix="swapwf",
+                           directory=self.tmp)
+        snap.suffix = tag
+        path = snap.export()
+        self.mirror.push(path)
+        with open(path + ".sha256") as f:
+            return os.path.basename(path), f.read().split()[0]
+
+    # -- request lane ---------------------------------------------------------
+
+    def predict_ok(self) -> bool:
+        body = json.dumps({"inputs": [[0.0] * self.sample] * 2}).encode()
+        try:
+            req = urllib.request.Request(
+                self.url + "/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    def load_start(self, interval_s: float = 0.01) -> None:
+        body = json.dumps({"inputs": [[0.0] * self.sample] * 2}).encode()
+
+        def lane() -> None:
+            while not self._load_stop.wait(interval_s):
+                try:
+                    req = urllib.request.Request(
+                        self.url + "/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()
+                        self.counts["ok" if r.status == 200
+                                    else "error"] += 1
+                except urllib.error.HTTPError as e:
+                    self.counts["shed" if e.code == 503
+                                else "error"] += 1
+                except OSError:
+                    self.counts["error"] += 1
+
+        self._load_stop.clear()
+        self._load_thread = threading.Thread(target=lane, daemon=True,
+                                             name="chaos-swap-load")
+        self._load_thread.start()
+
+    def load_stop(self) -> None:
+        self._load_stop.set()
+        if self._load_thread is not None:
+            self._load_thread.join(timeout=15)
+
+    # -- waits ----------------------------------------------------------------
+
+    def await_digest(self, digest: str, timeout: float = 10.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self.srv.generation()["digest"] == digest:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def await_refused(self, n: int, timeout: float = 10.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self.watcher.status()["n_refused"] >= n:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def stop(self) -> None:
+        self.load_stop()
+        self.watcher.stop()
+        self.srv.stop(drain_s=1)
+
+
+def _swap_under_load(h: "_SwapHarness") -> list:
+    problems = []
+    h.watcher.start()
+    h.load_start()
+    _, digest = h.push("gen1")
+    if not h.await_digest(digest):
+        problems.append("push never applied")
+    time.sleep(0.3)             # a few rounds ON the new generation
+    h.load_stop()
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"request failures under swap: {h.counts}")
+    if h.srv.health()["swaps"]["applied"] < 1:
+        problems.append("swap_applied counter did not move")
+    return problems
+
+
+def _swap_corrupt_mid_push(h: "_SwapHarness") -> list:
+    problems = []
+    _, d1 = h.push("gen1")
+    if h.watcher.poll_once() is None or not h.await_digest(d1, 1.0):
+        problems.append("gen1 not applied")
+    name2, _ = h.push("gen2")
+    h.mirror._corrupt(name2)    # mid-push torn copy: bytes != sidecar
+    if h.watcher.poll_once() is not None:
+        problems.append("corrupt snapshot was APPLIED")
+    last = h.srv.health()["swaps"]["last_refusal"] or {}
+    if last.get("reason") != "fetch_failed":
+        problems.append(f"refusal reason {last.get('reason')!r} != "
+                        "fetch_failed")
+    if h.srv.generation()["digest"] != d1:
+        problems.append("generation moved off gen1")
+    if not h.predict_ok():
+        problems.append("serving broken after refusal")
+    return problems
+
+
+def _swap_truncated_sidecar(h: "_SwapHarness") -> list:
+    problems = []
+    _, d1 = h.push("gen1")
+    h.watcher.poll_once()
+    if h.srv.generation()["digest"] != d1:
+        problems.append("gen1 not applied")
+    name2, _ = h.push("gen2")
+    side = os.path.join(h.mirror.root, name2 + ".sha256")
+    with open(side, "w") as f:          # garbage digest text
+        f.write("deadbeef  " + name2 + "\n")
+    if h.watcher.poll_once() is not None:
+        problems.append("garbage-sidecar snapshot was APPLIED")
+    if (h.srv.health()["swaps"]["last_refusal"] or {}).get("reason") \
+            != "fetch_failed":
+        problems.append("garbage sidecar not refused as fetch_failed")
+    with open(side, "w") as f:          # truncated-to-empty sidecar:
+        pass                            # the entry becomes invisible
+    refused_before = h.watcher.status()["n_refused"]
+    if h.watcher.poll_once() is not None:
+        problems.append("sidecar-less snapshot was APPLIED")
+    if h.watcher.status()["n_refused"] != refused_before:
+        problems.append("invisible entry was counted as a refusal")
+    if h.srv.generation()["digest"] != d1:
+        problems.append("generation moved off gen1")
+    if not h.predict_ok():
+        problems.append("serving broken after sidecar damage")
+    return problems
+
+
+def _swap_wrong_geometry(h: "_SwapHarness") -> list:
+    problems = []
+    boot = h.srv.generation()["digest"]
+    wide = _swap_build_wf(width=24)     # same family, WRONG geometry
+    _, d_bad = h.push("wide", wf=wide)
+    if h.watcher.poll_once() is not None:
+        problems.append("wrong-geometry snapshot was APPLIED")
+    if (h.srv.health()["swaps"]["last_refusal"] or {}).get("reason") \
+            != "geometry":
+        problems.append("not refused as geometry")
+    if d_bad[:12] not in "".join(
+            h.watcher.status()["refused_digests"]):
+        problems.append("poisoned digest not remembered")
+    n = h.watcher.status()["n_refused"]
+    h.watcher.poll_once()               # remembered: no refusal churn
+    if h.watcher.status()["n_refused"] != n:
+        problems.append("remembered digest re-refused on next poll")
+    if h.srv.generation()["digest"] != boot:
+        problems.append("generation moved")
+    if not h.predict_ok():
+        problems.append("serving broken after geometry refusal")
+    return problems
+
+
+def _swap_rollback_under_load(h: "_SwapHarness") -> list:
+    problems = []
+    h.watcher.start()
+    h.load_start()
+    _, d1 = h.push("gen1")
+    if not h.await_digest(d1):
+        problems.append("gen1 not applied")
+    _, d2 = h.push("gen2")
+    if not h.await_digest(d2):
+        problems.append("gen2 not applied")
+    req = urllib.request.Request(h.url + "/rollback", data=b"",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        resp = json.loads(r.read())
+    gen = resp.get("generation", {})
+    if gen.get("digest") != d1 or gen.get("source") != "rollback":
+        problems.append(f"rollback landed on {gen}")
+    time.sleep(1.0)     # several poll intervals: the rolled-back
+    if h.srv.generation()["digest"] != d1:   # digest must stay PINNED
+        problems.append("watcher re-applied the rolled-back digest")
+    h.load_stop()
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"request failures under rollback: {h.counts}")
+    return problems
+
+
+def _swap_mirror_unreachable(h: "_SwapHarness") -> list:
+    from veles_tpu.resilience.mirror import HttpMirror
+    problems = []
+    boot = h.srv.generation()["digest"]
+    # swap the watcher's bus for a dead endpoint with a retry budget
+    # scaled to the chaos poll interval (production: 8s under 10s)
+    h.watcher._mirror = HttpMirror(
+        f"http://127.0.0.1:{_free_port()}", retries=2,
+        retry_base=0.02, retry_cap=0.05, retry_total=0.15)
+    h.watcher.start()
+    time.sleep(1.2)
+    st = h.watcher.status()
+    if st["n_polls"] < 3:
+        problems.append(f"polls stalled past the retry budget: {st}")
+    if st["n_applied"] or st["n_refused"]:
+        problems.append(f"phantom swap activity: {st}")
+    if h.srv.generation()["digest"] != boot:
+        problems.append("generation moved with the mirror down")
+    if not h.predict_ok():
+        problems.append("serving broken while the mirror is down")
+    return problems
+
+
+#: the hot-swap matrix: name -> (scenario fn, blurb)
+SWAP_SCENARIOS = {
+    "swap_under_load": (
+        _swap_under_load,
+        "weight push applied between rounds under live traffic, zero "
+        "failed requests"),
+    "corrupt_mid_push": (
+        _swap_corrupt_mid_push,
+        "mirror copy corrupted mid-push -> fetch refused by digest, "
+        "prior generation keeps serving"),
+    "truncated_sidecar": (
+        _swap_truncated_sidecar,
+        "garbage sidecar -> fetch refusal; truncated-empty sidecar -> "
+        "entry invisible, no churn"),
+    "wrong_geometry": (
+        _swap_wrong_geometry,
+        "snapshot with mismatched layer shapes -> geometry refusal, "
+        "poisoned digest remembered (no hot-loop)"),
+    "rollback_under_load": (
+        _swap_rollback_under_load,
+        "POST /rollback flips to the previous device-resident "
+        "generation under load; watcher honours the pin"),
+    "mirror_unreachable": (
+        _swap_mirror_unreachable,
+        "mirror endpoint dead -> bounded per-poll retries, serving "
+        "untouched, no phantom swaps"),
+}
+
+
+def run_swap_scenario(name: str, verbose: bool) -> dict:
+    fn, _blurb = SWAP_SCENARIOS[name]
+    t0 = time.time()
+    h = None
+    try:
+        h = _SwapHarness()
+        problems = fn(h)
+    except Exception as e:  # noqa: BLE001 — a crashed scenario is a
+        # FAIL row, not a crashed matrix
+        problems = [f"{type(e).__name__}: {e!s:.200}"]
+    finally:
+        tmp = h.tmp if h is not None else None
+        swaps = {}
+        try:
+            if h is not None:
+                swaps = h.srv.health().get("swaps", {})
+                h.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    ok = not problems
+    if verbose and not ok:
+        sys.stderr.write(f"--- {name} problems: {problems} ---\n")
+    return {"tmp": tmp or tempfile.mkdtemp(prefix="chaos_swap_empty_"),
+            "ok": ok, "problems": problems,
+            "applied": swaps.get("applied"),
+            "refused": swaps.get("refused"),
+            "elapsed": time.time() - t0}
+
+
 #: the matrix: name -> (fault plan, extra CLI flags, expectation)
 SCENARIOS = {
     "baseline": ("", (), "completes uninterrupted"),
@@ -379,7 +718,7 @@ def run_scenario(name: str, plan: str, extra, verbose: bool) -> dict:
             "elapsed": elapsed}
 
 
-def _route_telemetry(rows, cluster: bool) -> None:
+def _route_telemetry(rows, cluster: bool, matrix: str = "") -> None:
     """Route the matrix outcome through the ONE telemetry registry
     (telemetry/metrics.py): scenario pass/fail counts and the restarts
     the scenarios actually consumed land in the same
@@ -408,7 +747,8 @@ def _route_telemetry(rows, cluster: bool) -> None:
         reg.counter("veles_restart_total").inc(restarts)
         tmetrics.flush_installed(extra={
             "source": "chaos",
-            "matrix": "cluster" if cluster else "single-host"})
+            "matrix": matrix or ("cluster" if cluster
+                                 else "single-host")})
     except Exception:  # noqa: BLE001
         pass
 
@@ -423,16 +763,56 @@ def main() -> int:
                     help="run the CROSS-HOST fault matrix (2 loopback "
                          "member processes + shared mirror) instead of "
                          "the single-host one")
+    ap.add_argument("--swap", action="store_true",
+                    help="run the HOT-SWAP fault matrix (in-process "
+                         "ring server + mirror + weight watcher, "
+                         "ISSUE 16) instead of the single-host one")
     ap.add_argument("--keep", action="store_true",
                     help="keep the per-scenario temp dirs for debugging")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="dump child stderr on failure")
     args = ap.parse_args()
-    catalogue = CLUSTER_SCENARIOS if args.cluster else SCENARIOS
+    if args.cluster and args.swap:
+        ap.error("--cluster and --swap are separate matrices: pick one")
+    catalogue = (CLUSTER_SCENARIOS if args.cluster else
+                 SWAP_SCENARIOS if args.swap else SCENARIOS)
     only = {s.strip() for s in args.only.split(",") if s.strip()}
     unknown = only - set(catalogue)
     if unknown:
         ap.error(f"unknown scenarios: {sorted(unknown)}")
+
+    if args.swap:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        rows = []
+        for name, (_fn, blurb) in SWAP_SCENARIOS.items():
+            if only and name not in only:
+                continue
+            print(f"chaos[swap]: {name}: {blurb} …", flush=True)
+            r = run_swap_scenario(name, args.verbose)
+            rows.append((name, blurb, r))
+            if not args.keep:
+                import shutil
+                shutil.rmtree(r["tmp"], ignore_errors=True)
+        print()
+        print(f"{'scenario':<19} {'ok':<5} {'applied':<8} "
+              f"{'refused':<8} {'secs':<6} problems")
+        failed = 0
+        for name, _blurb, r in rows:
+            verdict = "PASS" if r["ok"] else "FAIL"
+            failed += not r["ok"]
+            print(f"{name:<19} {verdict:<5} "
+                  f"{str(r['applied'] if r['applied'] is not None else '-'):<8} "
+                  f"{str(r['refused'] if r['refused'] is not None else '-'):<8} "
+                  f"{r['elapsed']:<6.1f} "
+                  f"{'; '.join(r['problems']) or '—'}")
+        print()
+        _route_telemetry(rows, cluster=False, matrix="swap")
+        if failed:
+            print(f"{failed} swap scenario(s) did NOT keep serving",
+                  file=sys.stderr)
+            return 1
+        print("all swap scenarios kept serving")
+        return 0
 
     if args.cluster:
         rows = []

@@ -23,12 +23,19 @@ Modes:
 - ``--ramp "R1:S1,R2:S2,..."``: staircase the arrival rate (each phase
   reported separately); ``--duration`` alone is the soak knob;
 - ``--url``: drive an EXTERNAL server instead of self-hosting;
+- ``--swap``: the hot-swap proof (ISSUE 16) — ONE open-loop window
+  across two watcher-applied weight pushes over the mirror bus and one
+  HTTP rollback, asserting **zero failed requests** (no errors, no
+  sheds) while the serving generation changes live; the record lands
+  in SWAP_RECORD.json with every swap event timed and the final
+  generation asserted;
 - ``--smoke``: tiny-budget tier-1 mode (seconds, loopback) asserting
   the record schema and that p50/p99/throughput reached the registry.
 
-The record lands in LOADTEST_RECORD.json (env
-``VELES_LOADTEST_RECORD_PATH``) and the LAST stdout line is the
-compact ``LOADTEST {...}`` JSON (the bench.py driver-parse contract).
+The record lands in LOADTEST_RECORD.json (``--swap``:
+SWAP_RECORD.json; env ``VELES_LOADTEST_RECORD_PATH``) and the LAST
+stdout line is the compact ``LOADTEST {...}`` JSON (the bench.py
+driver-parse contract).
 """
 
 from __future__ import annotations
@@ -249,6 +256,141 @@ def _serve(wf, dispatch: str, batch: int, ring: Optional[int],
         dispatch=dispatch, ring_slots=ring, quantize=quantize).start()
 
 
+def _run_swap(args, record: Dict[str, Any]) -> bool:
+    """The hot-swap proof (ISSUE 16): self-host the ring server, point
+    a WeightWatcher at a DirMirror, and drive ONE open-loop poisson
+    window while a "trainer" thread pushes two perturbed same-geometry
+    snapshots over the mirror bus and then POSTs /rollback — asserting
+    ZERO failed requests (no errors, no sheds) across all three
+    generation changes, >= 2 watcher-applied swaps + 1 rollback, and
+    that the final live generation is the rolled-back-to digest. Every
+    event is timed into the record; p50/p99 come from the registry like
+    every other leg."""
+    import tempfile
+
+    import numpy as np
+
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving_watch import WeightWatcher
+    from veles_tpu.snapshotter import Snapshotter
+
+    wf = _build_workflow(args.width, args.sample, 4, depth=args.depth)
+    srv = _serve(wf, "ring", args.batch, args.ring, args.quantize,
+                 args.queue_limit)
+    mirror = DirMirror(tempfile.mkdtemp(prefix="veles_swap_mirror_"))
+    watcher = WeightWatcher(srv, mirror, prefix="swap",
+                            poll_s=args.swap_poll)
+    snap_dir = tempfile.mkdtemp(prefix="veles_swap_snaps_")
+    url = f"http://127.0.0.1:{srv.port}"
+    events: List[Dict[str, Any]] = []
+
+    def _push(tag: str) -> str:
+        # the "trainer": nudge every parameter (same geometry, finite,
+        # self-consistent — the server's equivalence probe compares the
+        # candidate against ITS OWN f32 forward) and publish a
+        # digest-addressed snapshot to the mirror bus
+        for u in wf.forwards:
+            for a in u.param_arrays().values():
+                a.mem = np.asarray(a.mem) * np.float32(1.01)
+        snap = Snapshotter(workflow=wf, prefix="swap",
+                           directory=snap_dir)
+        snap.suffix = tag           # distinct, digest-addressed names
+        path = snap.export()
+        mirror.push(path)
+        with open(path + ".sha256") as f:
+            return f.read().split()[0]
+
+    def _await_digest(digest: str, timeout: float) -> Optional[float]:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout:
+            if srv.generation()["digest"] == digest:
+                return round(time.perf_counter() - t0, 3)
+            time.sleep(0.02)
+        return None
+
+    def _orchestrate(t_start: float, duration: float) -> None:
+        # sequential by construction: each push WAITS for its watcher
+        # application before the next event fires, so the generation
+        # sequence under load is deterministic: boot -> gen1 -> gen2
+        # -> rollback(gen1)
+        apply_wait = max(5.0, 10.0 * args.swap_poll)
+        plan = [(0.20, "push", "gen1"), (0.45, "push", "gen2"),
+                (0.70, "rollback", "")]
+        for frac, kind, tag in plan:
+            delay = t_start + frac * duration - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            ev: Dict[str, Any] = {
+                "kind": kind, "tag": tag or None,
+                "at_s": round(time.perf_counter() - t_start, 3)}
+            try:
+                if kind == "push":
+                    digest = _push(tag)
+                    ev["digest"] = digest
+                    ev["applied_after_s"] = _await_digest(
+                        digest, apply_wait)
+                else:
+                    req = urllib.request.Request(
+                        url + "/rollback", data=b"", method="POST")
+                    with urllib.request.urlopen(req, timeout=15) as r:
+                        ev["response"] = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001 — a failed event is
+                # recorded and judged by the final assertions, never
+                # allowed to kill the drive window
+                ev["error"] = f"{type(e).__name__}: {e!s:.200}"
+            events.append(ev)
+
+    try:
+        watcher.start()
+        boot = srv.generation()["digest"]
+        t_start = time.perf_counter()
+        orch = threading.Thread(target=_orchestrate, daemon=True,
+                                args=(t_start, args.duration),
+                                name="swap-orchestrator")
+        orch.start()
+        leg = drive_leg(url, "swap", args.rate, args.duration,
+                        args.rows, (args.sample,), seed=args.seed,
+                        workers=args.workers)
+        orch.join(timeout=60)
+        final_gen = srv.generation()
+        health = srv.health()
+        mi = srv.model_info()
+        leg["server"] = {k: mi.get(k)
+                        for k in ("dispatch", "ring_slots", "sharded",
+                                  "quantize", "aot")}
+        leg["health"] = {k: health.get(k)
+                         for k in ("n_dispatches", "n_rejected",
+                                   "round_latency_s")}
+        record["legs"]["swap"] = leg
+        watcher_status = watcher.status()
+    finally:
+        watcher.stop()
+        srv.stop(drain_s=2)
+
+    pushes = [e for e in events if e["kind"] == "push"]
+    applied = [e for e in pushes
+               if e.get("applied_after_s") is not None]
+    rollbacks = [e for e in events
+                 if e["kind"] == "rollback" and "response" in e]
+    expected_final = pushes[0].get("digest") if pushes else None
+    zero_failed = leg["errors"] == 0 and leg["shed"] == 0
+    ok = (zero_failed and len(applied) >= 2 and len(rollbacks) >= 1
+          and expected_final is not None
+          and final_gen["digest"] == expected_final)
+    record["swap"] = {
+        "events": events,
+        "boot_digest": boot,
+        "final_generation": final_gen,
+        "expected_final_digest": expected_final,
+        "swaps_applied": health["swaps"]["applied"],
+        "swaps_refused": health["swaps"]["refused"],
+        "watcher": watcher_status,
+        "zero_failed_requests": zero_failed,
+        "pass": ok,
+    }
+    return ok
+
+
 def _phases(args) -> List[Dict[str, float]]:
     if args.ramp:
         out = []
@@ -266,6 +408,15 @@ def main(argv=None) -> int:
     ap.add_argument("--ab", action="store_true",
                     help="A/B the ring vs the pre-ring merge core on "
                          "the same poisson schedule")
+    ap.add_argument("--swap", action="store_true",
+                    help="hot-swap proof: drive one window across two "
+                         "watcher-applied weight pushes + one rollback "
+                         "and assert zero failed requests (record "
+                         "defaults to SWAP_RECORD.json)")
+    ap.add_argument("--swap-poll", type=float, default=0.3,
+                    help="--swap: watcher poll interval, seconds "
+                         "(tight so the proof fits one short window; "
+                         "production default is 10s)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-budget tier-1 mode (loopback, seconds)")
     ap.add_argument("--rate", type=float, default=400.0,
@@ -323,6 +474,12 @@ def main(argv=None) -> int:
         # pass VACUOUSLY — reject instead (the latency-gate rule)
         ap.error("--ab drives the merge/ring pair on one fixed "
                  "schedule: it conflicts with --ramp and --url")
+    if args.swap and (args.ab or args.ramp or args.url):
+        # --swap self-hosts its own watcher + mirror + rollback plan;
+        # mixing schedules would make the zero-failed assertion cover
+        # some other leg's traffic
+        ap.error("--swap drives its own single-window swap plan: it "
+                 "conflicts with --ab, --ramp and --url")
     if args.smoke:
         # tiny budget: the tier-1 assertion is the record schema + the
         # registry read-back, not a measured claim
@@ -333,10 +490,15 @@ def main(argv=None) -> int:
         args.rows = min(args.rows, 4)
         args.batch = min(args.batch, 16)
         args.workers = min(args.workers, 16)
+        args.swap_poll = min(args.swap_poll, 0.15)
+        if args.swap:
+            # the three swap events need room inside the window
+            args.duration = max(args.duration, 4.0)
 
     record: Dict[str, Any] = {
         "schema": SCHEMA, "version": VERSION,
         "mode": ("ab" if args.ab else
+                 "swap" if args.swap else
                  "smoke" if args.smoke else
                  "ramp" if args.ramp else "single"),
         "workload": {"rows": args.rows, "batch": args.batch,
@@ -349,7 +511,10 @@ def main(argv=None) -> int:
     }
     status = "ok"
     try:
-        if args.url:
+        if args.swap:
+            if not _run_swap(args, record):
+                status = "swap_failed"
+        elif args.url:
             shape = None  # external server: /info tells us the shape
             with urllib.request.urlopen(args.url + "/info",
                                         timeout=10) as r:
@@ -450,7 +615,7 @@ def main(argv=None) -> int:
     except Exception:  # noqa: BLE001
         pass
     path = args.record or os.environ.get(RECORD_ENV) \
-        or "LOADTEST_RECORD.json"
+        or ("SWAP_RECORD.json" if args.swap else "LOADTEST_RECORD.json")
     try:
         with open(path, "w") as f:
             json.dump(record, f, indent=1, sort_keys=True)
@@ -460,6 +625,10 @@ def main(argv=None) -> int:
                "record": path,
                "speedup": record.get("speedup"),
                "p99_ratio": record.get("p99_ratio"),
+               "swap": ({"pass": record["swap"]["pass"],
+                         "applied": record["swap"]["swaps_applied"],
+                         "refused": record["swap"]["swaps_refused"]}
+                        if "swap" in record else None),
                "legs": {k: {"rps": v.get("throughput_rps"),
                             "p50_s": v.get("p50_s"),
                             "p99_s": v.get("p99_s"),

@@ -40,6 +40,11 @@
 #      AOT-persisted) vs the pre-ring merge core under open-loop
 #      poisson arrivals — on chips the shards are real devices, so
 #      the committed CPU-mesh speedup is the floor, not the claim
+#  10. tools/loadtest.py --swap          -> ISSUE 16 on-chip twin of
+#      the train-to-serve hot-swap proof: two watcher-applied weight
+#      pushes over the mirror bus + one /rollback inside one open-loop
+#      window with ZERO failed requests — on chips the incoming
+#      generation's device_put is a real HBM transfer
 # Probe the flaky axon tunnel in a loop; the moment it answers, run the
 # queue in priority order, each timeout-bounded so one hang cannot eat
 # the warm window. Everything lands in tpu_watch/ + ONCHIP_LATE.md.
@@ -115,6 +120,17 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       --width 512 --sample 8 --queue-limit 24 --workers 64 \
       > tpu_watch/r8_loadtest_ab.txt 2>&1
     log "9 loadtest --ab rc=$? last: $(tail -1 tpu_watch/r8_loadtest_ab.txt | head -c 200)"
+    # 10. ISSUE 16: hot-swap loadtest twin — two watcher-applied
+    # weight pushes over the mirror bus + one /rollback inside one
+    # open-loop window, ZERO failed requests required; on chips the
+    # device_put of the incoming generation and the between-rounds
+    # pointer swap are the real transfer + real HBM residency the
+    # committed CPU-mesh SWAP_RECORD.json can only approximate
+    timeout 900 python tools/loadtest.py --swap --rate 400 \
+      --duration 10 --rows 16 --batch 64 --width 128 --sample 64 \
+      --workers 64 --record tpu_watch/r8_swap_record.json \
+      > tpu_watch/r8_swap.txt 2>&1
+    log "10 loadtest --swap rc=$? last: $(tail -1 tpu_watch/r8_swap.txt | head -c 200)"
     {
       echo "# ONCHIP_LATE — r8 watcher capture ($(date -u +%FT%TZ))"
       echo
@@ -137,6 +153,8 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       echo '```'; tail -4 tpu_watch/r8_fusion_ab.txt; echo '```'
       echo "## 9. tools/loadtest.py --ab (serving ring vs merge, ISSUE 15 on-chip twin)"
       echo '```'; grep ^LOADTEST tpu_watch/r8_loadtest_ab.txt | tail -1; echo '```'
+      echo "## 10. tools/loadtest.py --swap (hot-swap under load, ISSUE 16 on-chip twin)"
+      echo '```'; grep ^LOADTEST tpu_watch/r8_swap.txt | tail -1; echo '```'
     } > ONCHIP_LATE.md
     log "capture done -> ONCHIP_LATE.md"
     exit 0

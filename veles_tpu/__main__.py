@@ -42,7 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
         # tokens; an abbreviated "--daemo" would survive that filter and
         # respawn forever, so abbreviations are off
         allow_abbrev=False)
-    p.add_argument("workflow", help="workflow module (.py) with run(load, main)")
+    # nargs="?": the --serve-rollback CLIENT mode needs no workflow to
+    # import; every other mode validates its presence in main()
+    p.add_argument("workflow", nargs="?", default="",
+                   help="workflow module (.py) with run(load, main)")
     p.add_argument("config", nargs="?", default="",
                    help="config module (.py) mutating the global root")
     p.add_argument("overrides", nargs="*", default=[],
@@ -161,6 +164,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-batch", type=int, default=None, metavar="N",
                    help="per-request row cap for --serve (default 64); "
                         "the ring size defaults to it")
+    p.add_argument("--serve-watch-mirror", default=None, metavar="SPEC",
+                   help="hot-swap deployment (train→serve): poll this "
+                        "snapshot mirror (a directory or http(s) URL, "
+                        "the --mirror grammar) for new digest-addressed "
+                        "snapshots, verify + validate each candidate, "
+                        "and swap it into the running slot ring between "
+                        "rounds — no recompile, no drain; any failure "
+                        "keeps the current generation serving "
+                        "(docs/SERVING.md 'Continuous deployment'). "
+                        "Poll cadence via VELES_WATCH_POLL_S (10 s). "
+                        "Combine with --serve")
+    p.add_argument("--serve-rollback", default=None, metavar="URL",
+                   help="client mode: POST /rollback to the running "
+                        "server at URL — re-point its ring at the "
+                        "PREVIOUS weight generation — print the "
+                        "response and exit (no workflow argument; "
+                        "token from VELES_WEB_TOKEN)")
     p.add_argument("--pp", type=int, default=None, metavar="MICROBATCHES",
                    help="train as a GPipe pipeline over the local devices "
                         "(one stage per device) with this many microbatches")
@@ -464,6 +484,37 @@ def _supervise(args, argv) -> int:
     return sup.run()
 
 
+def _serve_rollback(url: str) -> int:
+    """POST /rollback to a running InferenceServer and print the JSON
+    response. Exit 0 on an applied rollback, 1 on refusal (409 — no
+    previous generation resident) or transport failure."""
+    import urllib.error
+    import urllib.request
+    url = url.rstrip("/")
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    req = urllib.request.Request(url + "/rollback", data=b"",
+                                 method="POST")
+    token = os.environ.get("VELES_WEB_TOKEN")
+    if token:
+        req.add_header("X-Veles-Token", token)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except ValueError:
+            payload = {"error": str(e)}
+        print(json.dumps(payload), flush=True)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(json.dumps({"error": str(e)}), flush=True)
+        return 1
+    print(json.dumps(payload), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     # intermixed parsing: this environment's argparse otherwise refuses
     # trailing `root.a.b=value` overrides once any optional flag
@@ -475,6 +526,17 @@ def main(argv=None) -> int:
         # the first override to the config positional — reroute it
         args.overrides.insert(0, args.config)
         args.config = ""
+    if args.serve_rollback:
+        # client mode: one control-plane POST against a RUNNING server,
+        # before any workflow import or backend touch — a rollback must
+        # work from a box that can't even build the model
+        if args.workflow:
+            raise SystemExit("--serve-rollback is a client mode: it "
+                             "takes no workflow argument")
+        return _serve_rollback(args.serve_rollback)
+    if not args.workflow:
+        raise SystemExit("workflow module required "
+                         "(or --serve-rollback URL for client mode)")
     if args.daemon:
         daemon_pid = _daemonize(
             args.daemon, argv if argv is not None else sys.argv[1:])
@@ -544,6 +606,7 @@ def main(argv=None) -> int:
         serve_dispatch=args.serve_dispatch,
         serve_quantize=args.serve_quantize,
         serve_mesh=args.serve_mesh, serve_batch=args.serve_batch,
+        serve_watch_mirror=args.serve_watch_mirror,
         accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,
         compile_cache=not args.no_compile_cache,

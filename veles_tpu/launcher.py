@@ -45,6 +45,7 @@ class Launcher(Logger):
                  serve_quantize: Optional[str] = None,
                  serve_mesh: Optional[str] = None,
                  serve_batch: Optional[int] = None,
+                 serve_watch_mirror: Optional[str] = None,
                  accum: Optional[int] = None, report: str = "",
                  tp: Optional[int] = None, sp: Optional[int] = None,
                  ep: bool = False, compile_cache: bool = True,
@@ -112,11 +113,12 @@ class Launcher(Logger):
         if serve is None and any(
                 v is not None for v in (serve_ring, serve_dispatch,
                                         serve_quantize, serve_mesh,
-                                        serve_batch)):
+                                        serve_batch,
+                                        serve_watch_mirror)):
             raise SystemExit(
                 "--serve-ring/--serve-dispatch/--serve-quantize/"
-                "--serve-mesh/--serve-batch configure the serving "
-                "tier: combine with --serve")
+                "--serve-mesh/--serve-batch/--serve-watch-mirror "
+                "configure the serving tier: combine with --serve")
         if serve_ring is not None and serve_ring < 1:
             raise SystemExit(f"--serve-ring needs N >= 1 "
                              f"(got {serve_ring})")
@@ -140,6 +142,11 @@ class Launcher(Logger):
             if serve_ring is not None:
                 raise SystemExit("--serve-ring sizes the ring core: it "
                                  "conflicts with --serve-dispatch merge")
+            if serve_watch_mirror is not None:
+                raise SystemExit(
+                    "--serve-watch-mirror hot-swaps into the ring core "
+                    "(the merge baseline binds params at build time): "
+                    "drop --serve-dispatch merge")
             if serve_quantize not in (None, "f32"):
                 raise SystemExit(
                     "--serve-quantize rides the ring core (the merge "
@@ -155,6 +162,9 @@ class Launcher(Logger):
         self.serve_quantize = serve_quantize or "f32"
         self.serve_mesh = serve_mesh or "auto"
         self.serve_batch = serve_batch
+        #: mirror spec (dir or http(s) URL) the serving tier polls for
+        #: new digest-addressed snapshots to hot-swap (ISSUE 16)
+        self.serve_watch_mirror = serve_watch_mirror
         #: GPipe pipeline mode: microbatch count (stages = local devices)
         if pp is not None and pp < 1:
             raise SystemExit(f"--pp needs a microbatch count >= 1 "
@@ -663,12 +673,35 @@ class Launcher(Logger):
                           info["dispatch"], info["ring_slots"],
                           info.get("sharded"), info["quantize"],
                           info.get("aot"))
+                watcher = None
+                if self.serve_watch_mirror:
+                    # train→serve hot-swap loop (ISSUE 16): poll the
+                    # mirror for new digest-addressed snapshots and
+                    # swap them in between ring rounds. Poll cadence
+                    # via VELES_WATCH_POLL_S (default 10 s — the
+                    # HttpMirror retry budget stays below it).
+                    import os as _os
+
+                    from veles_tpu.resilience.mirror import get_mirror
+                    from veles_tpu.serving_watch import WeightWatcher
+                    try:
+                        poll_s = float(_os.environ.get(
+                            "VELES_WATCH_POLL_S", "10") or 10)
+                    except ValueError:
+                        poll_s = 10.0
+                    watcher = WeightWatcher(
+                        srv,
+                        get_mirror(self.serve_watch_mirror,
+                                   token=srv.token),
+                        poll_s=poll_s).start()
                 print(f"SERVING http://127.0.0.1:{srv.port}", flush=True)
                 try:
                     while True:
                         import time
                         time.sleep(3600)
                 except KeyboardInterrupt:
+                    if watcher is not None:
+                        watcher.stop()
                     srv.stop()
                 return 0
             if self.autotune:

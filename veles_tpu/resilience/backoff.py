@@ -20,7 +20,8 @@ use this and must never initialize jax.
 from __future__ import annotations
 
 import random
-from typing import Callable
+import time
+from typing import Callable, Optional, Tuple, Type
 
 #: clamp for the exponent: far past any real cap crossing, far below
 #: float overflow (2**30 * any sane base saturates every cap)
@@ -39,3 +40,39 @@ def backoff_delay(streak: int, *, base: float, cap: float,
     delay = min(base * (2 ** min(max(int(streak), 0), MAX_EXPONENT)),
                 cap)
     return delay * (1.0 + jitter * rand())
+
+
+def call_with_backoff(fn: Callable, *, attempts: int, base: float,
+                      cap: float, total: Optional[float] = None,
+                      retry_on: Tuple[Type[BaseException], ...]
+                      = (Exception,),
+                      jitter: float = 0.25,
+                      rand: Callable[[], float] = random.random,
+                      sleep: Callable[[float], None] = time.sleep,
+                      clock: Callable[[], float] = time.monotonic):
+    """Call ``fn()`` up to ``attempts`` times, sleeping a
+    ``backoff_delay`` between failures. Retries only on ``retry_on``
+    exceptions; the LAST failure re-raises — a caller that wants
+    soft-fail wraps this, the policy itself never swallows.
+
+    ``total`` is a hard wall-clock budget (seconds) across all
+    attempts INCLUDING sleeps: when the next backoff would cross it,
+    the last exception re-raises immediately instead of sleeping — so
+    a retrying fetch inside a poll loop can be capped strictly below
+    the poll interval and never stall it. ``sleep``/``clock``/``rand``
+    are injectable for deterministic tests."""
+    deadline = None if total is None else clock() + float(total)
+    last: Optional[BaseException] = None
+    for streak in range(max(int(attempts), 1)):
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if streak + 1 >= max(int(attempts), 1):
+                raise
+            delay = backoff_delay(streak, base=base, cap=cap,
+                                  jitter=jitter, rand=rand)
+            if deadline is not None and clock() + delay >= deadline:
+                raise
+            sleep(delay)
+    raise last if last is not None else RuntimeError("unreachable")

@@ -245,6 +245,8 @@ class DirMirror(Mirror):
         try:
             with open(tmp, "w") as f:
                 json.dump(record, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, dst)
         except OSError:
             try:
@@ -275,11 +277,23 @@ class HttpMirror(Mirror):
     uploads, it just cannot serve restores)."""
 
     def __init__(self, base_url: str, token: Optional[str] = None,
-                 timeout: float = 60.0) -> None:
+                 timeout: float = 60.0, retries: int = 3,
+                 retry_base: float = 0.2, retry_cap: float = 2.0,
+                 retry_total: float = 8.0) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token if token is not None \
             else os.environ.get("VELES_WEB_TOKEN") or None
         self.timeout = timeout
+        # bounded jittered-exponential retries on TRANSIENT failures
+        # (connection refused/reset, 5xx, torn response) — a mirror that
+        # blips for a second must not fail a push or a watcher poll. The
+        # `retry_total` wall-clock budget is deliberately BELOW the
+        # default WeightWatcher poll interval (10 s): a down mirror
+        # costs at most one bounded stall per poll, never a pile-up.
+        self.retries = max(int(retries), 1)
+        self.retry_base = float(retry_base)
+        self.retry_cap = float(retry_cap)
+        self.retry_total = float(retry_total)
         self.spec = self.base_url
 
     # -- plumbing -------------------------------------------------------------
@@ -294,11 +308,34 @@ class HttpMirror(Mirror):
             req.add_header("Content-Type", "application/octet-stream")
         return urllib.request.urlopen(req, timeout=self.timeout)
 
+    def _retry(self, fn):
+        """Run `fn` under the shared bounded-backoff policy
+        (resilience/backoff.py). Transient = connection-level errors +
+        torn responses + HTTP 5xx; a 4xx is PERMANENT (retrying a 404
+        three times would stall every `has()` probe of a not-yet-pushed
+        name) and must be handled inside `fn`. Exhaustion re-raises the
+        last transient error — soft-fail callers catch it."""
+        import http.client
+        from veles_tpu.resilience.backoff import call_with_backoff
+        return call_with_backoff(
+            fn, attempts=self.retries, base=self.retry_base,
+            cap=self.retry_cap, total=self.retry_total,
+            retry_on=(urllib.error.URLError, OSError, ValueError,
+                      http.client.HTTPException))
+
     def _get_bytes(self, name_or_query: str) -> Optional[bytes]:
         import http.client
+
+        def attempt() -> Optional[bytes]:
+            try:
+                with self._request("GET", name_or_query) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    return None   # permanent (404 et al.): no retry
+                raise
         try:
-            with self._request("GET", name_or_query) as resp:
-                return resp.read()
+            return self._retry(attempt)
         except (urllib.error.URLError, OSError, ValueError,
                 http.client.HTTPException):
             # HTTPException covers a TORN response (IncompleteRead from
@@ -309,23 +346,36 @@ class HttpMirror(Mirror):
     def _get_to_file(self, name: str, dst: str) -> Optional[str]:
         """Stream a GET into `dst`, returning the sha256 hex digest."""
         import http.client
-        h = hashlib.sha256()
+
+        def attempt() -> Optional[str]:
+            h = hashlib.sha256()
+            try:
+                # "wb" truncates: a retried attempt restarts the stream
+                # from byte 0, never appends to a torn prior try
+                with self._request("GET", name) as resp, \
+                        open(dst, "wb") as f:
+                    while True:
+                        block = resp.read(1 << 20)
+                        if not block:
+                            break
+                        h.update(block)
+                        f.write(block)
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    return None
+                raise
+            return h.hexdigest()
         try:
-            with self._request("GET", name) as resp, open(dst, "wb") as f:
-                while True:
-                    block = resp.read(1 << 20)
-                    if not block:
-                        break
-                    h.update(block)
-                    f.write(block)
+            got = self._retry(attempt)
         except (urllib.error.URLError, OSError, ValueError,
                 http.client.HTTPException):
+            got = None
+        if got is None:
             try:
                 os.remove(dst)
             except OSError:
                 pass
-            return None
-        return h.hexdigest()
+        return got
 
     # -- Mirror API -----------------------------------------------------------
 
@@ -360,8 +410,9 @@ class HttpMirror(Mirror):
                        name)
             return True
         headers = {"X-Veles-Token": self.token} if self.token else None
-        http_put_file(f"{self.base_url}/{name}", path,
-                      timeout=self.timeout, headers=headers)
+        self._retry(lambda: http_put_file(
+            f"{self.base_url}/{name}", path,
+            timeout=self.timeout, headers=headers))
         # verify-on-upload BEFORE publishing the sidecar: the sidecar
         # is what `has()`/`entries()` trust, so it must only ever sit
         # next to bytes that verified — publishing it first would turn
@@ -382,13 +433,16 @@ class HttpMirror(Mirror):
             return False
         sidecar = path + ".sha256"
         if os.path.exists(sidecar):
-            http_put_file(f"{self.base_url}/{name}.sha256", sidecar,
-                          timeout=self.timeout, headers=headers)
+            self._retry(lambda: http_put_file(
+                f"{self.base_url}/{name}.sha256", sidecar,
+                timeout=self.timeout, headers=headers))
         else:
-            with self._request(
-                    "PUT", name + ".sha256",
-                    data=f"{digest}  {name}\n".encode()) as resp:
-                resp.read()
+            def _put_sidecar() -> None:
+                with self._request(
+                        "PUT", name + ".sha256",
+                        data=f"{digest}  {name}\n".encode()) as resp:
+                    resp.read()
+            self._retry(_put_sidecar)
         if got is None:
             _log.warning("mirror %s does not serve GET: upload of %s "
                          "is unverified", self.base_url, name)

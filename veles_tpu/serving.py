@@ -12,7 +12,23 @@ Endpoints:
 - GET  /info       model metadata (model_info()) (input shape, layer types, n_classes)
 - GET  /healthz    liveness/readiness: 200 + uptime/dispatch stats while
   serving, 503 while draining (load balancers stop routing before the
-  listener actually closes)
+  listener actually closes); includes the blue/green weight
+  **generation labels** (live digest + serving-since, previous digest,
+  swap ledger)
+- POST /rollback   re-point the ring at the PREVIOUS weight generation
+  (token-guarded; 200 + the restored generation, 409 when none is
+  resident) — the rollback half of the hot-swap story below
+
+Hot swap (ISSUE 16, train→serve): `swap_params(workflow, digest=...)`
+validates a candidate OFF the serving path (geometry vs the AOT
+signature, ledger-gated wire transform, device placement, equivalence +
+finiteness probe through the live executable) and commits it as ONE
+pointer swap between ring rounds — no recompile, no drain. The outgoing
+params stay device-resident as the rollback target. Every failure mode
+raises `SwapRefused` after incrementing
+`veles_serving_swap_refused_total{reason}` — the current generation
+keeps serving. `serving_watch.WeightWatcher` drives this from mirror
+polls.
 
 Execution core (ISSUE 15, ROADMAP direction 2) — two dispatch modes:
 
@@ -108,6 +124,41 @@ class ServerDraining(RuntimeError):
 
 class RequestTimeout(RuntimeError):
     """A queued request missed request_timeout_s."""
+
+
+class SwapRefused(RuntimeError):
+    """A hot weight swap was refused at some stage — the ring keeps
+    serving the CURRENT generation (the one invariant every refusal
+    path preserves). `reason` is the `swap_refused_total` label:
+    merge_core / geometry / wire_transform / device_put / equivalence /
+    nonfinite / no_previous (plus the watcher-side fetch_failed /
+    verify_failed / import_failed)."""
+
+    def __init__(self, reason: str, msg: str) -> None:
+        super().__init__(msg)
+        self.reason = reason
+
+
+#: max |candidate - f32 reference| a swap candidate may show on the
+#: probe rows — the same bound the startup quantized-wire probe uses
+SWAP_PROBE_TOL = 0.05
+
+
+def params_digest(params_host) -> str:
+    """Content hash of a host param tree (tuple of {name: ndarray} per
+    layer) — the digest a BOOT generation serves under when no
+    snapshot digest names it (a snapshot-sourced swap uses the
+    mirror's sidecar digest verbatim, so trainer and server agree on
+    the generation's name)."""
+    import hashlib
+    h = hashlib.sha256()
+    for layer in params_host:
+        for k in sorted(layer):
+            a = np.ascontiguousarray(layer[k])
+            h.update(k.encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    return h.hexdigest()
 
 
 #: sentinel for the lazily-computed capacity hint (None is a valid
@@ -228,6 +279,24 @@ class InferenceServer(Logger):
         #: where the executable came from ("compile"/"cache"/None)
         self.aot_compiles = 0
         self.aot_source: Optional[str] = None
+        #: blue/green weight generations (ISSUE 16 hot-swap): the LIVE
+        #: generation label (/healthz exposes it), the one PREVIOUS
+        #: generation kept device-resident for instant rollback, and
+        #: the swap ledger. _build overwrites the boot digest with the
+        #: content hash of the served params. All guarded by _cv.
+        self._generation: Dict[str, Any] = {
+            "digest": "boot", "since": self._started_at,
+            "source": "boot"}
+        self._prev_gen: Optional[Dict[str, Any]] = None
+        self._params_prev: Any = None
+        self.n_swaps = 0
+        self.n_swap_refusals = 0
+        self._last_swap_refusal: Optional[Dict[str, Any]] = None
+        #: digests explicitly rolled back FROM: the WeightWatcher skips
+        #: these, so a rollback pins serving until a NEW digest is
+        #: pushed (without this the watcher would re-apply the bad
+        #: generation one poll after the operator rolled it back)
+        self.rolled_back: set = set()
         #: lazily computed /healthz capacity hint (analysis pass 6);
         #: _UNSET -> computed once on first health() call
         self._capacity: Any = _UNSET
@@ -263,6 +332,15 @@ class InferenceServer(Logger):
         self._m_queue_depth = _reg.gauge("veles_serving_queue_depth")
         self._m_occupancy = _reg.histogram(
             "veles_serving_ring_occupancy")
+        # hot-swap instruments (register_standard families): every
+        # applied swap/rollback, every refusal by stage, and the age of
+        # the live generation (refreshed on health/metrics reads)
+        self._m_swap_applied = _reg.counter(
+            "veles_serving_swap_applied_total")
+        self._m_swap_refused = _reg.counter(
+            "veles_serving_swap_refused_total")
+        self._m_gen_age = _reg.gauge(
+            "veles_serving_generation_age_seconds")
         self._tr = _ttracer.active()
         self._build()
 
@@ -454,12 +532,22 @@ class InferenceServer(Logger):
         else:
             self.aot_source = "cache"
         self._fn = fn
+        # the dense (f32, unsharded-trace) forward closure — kept so a
+        # hot-swap candidate can be probed against ITS OWN f32 forward
+        # exactly the way the startup quantized-wire probe works
+        self._dense = dense
         # params live device-resident under the plan for the server's
         # lifetime; the ring batch is the only per-round transfer
         self._params_dev = (jax.device_put(prepared, plan["params"])
                             if mesh is not None
                             else jax.device_put(prepared))
         self._ring_put = make_input_put(step) or jax.device_put
+        # the boot generation serves under the content hash of its own
+        # params (a watcher-applied snapshot serves under the mirror's
+        # sidecar digest — one namespace, two sources)
+        with self._cv:
+            self._generation = {"digest": params_digest(params_host),
+                                "since": time.time(), "source": "boot"}
         # warm + validate the executable NOW (a corrupt-but-loadable
         # artifact must fail the start, not the first request), and
         # probe a quantized wire against the f32 forward of the REAL
@@ -499,6 +587,181 @@ class InferenceServer(Logger):
         if self._softmax:
             out = jax.nn.softmax(out, axis=-1)
         return np.asarray(out)
+
+    # -- hot swap: blue/green weight generations (ISSUE 16) -------------------
+
+    def _refuse_swap(self, reason: str, msg: str) -> None:
+        """Record one refused swap and raise. EVERY refusal path ends
+        here, so the invariant — the ring keeps serving the current
+        generation, the refusal lands in the metrics registry — holds
+        by construction."""
+        with self._cv:
+            self.n_swap_refusals += 1
+            self._last_swap_refusal = {"reason": reason,
+                                       "error": msg[:300],
+                                       "at": time.time()}
+            live = self._generation["digest"]
+        self._m_swap_refused.labels(reason=reason).inc()
+        self.warning("hot swap refused (%s): %s — still serving "
+                     "generation %s", reason, msg, live[:12])
+        raise SwapRefused(reason, msg)
+
+    def note_swap_refused(self, reason: str, msg: str = "") -> None:
+        """Watcher-side refusals (fetch/verify/import failed before a
+        candidate workflow even existed) land in the SAME counter
+        family and /healthz ledger as in-server refusals — one place
+        to alert on, regardless of which stage degraded."""
+        try:
+            self._refuse_swap(reason, msg)
+        except SwapRefused:
+            pass
+
+    def swap_params(self, workflow, *, digest: Optional[str] = None,
+                    source: str = "watcher") -> Dict[str, Any]:
+        """Hot-swap the served params to `workflow`'s — between rounds,
+        no recompile, no drain. The candidate is pre-flighted OFF the
+        serving path (geometry vs the AOT signature, the ledger-gated
+        wire transform, device placement, an equivalence + finiteness
+        probe through the LIVE executable), and only a fully validated
+        generation is committed: one attribute swap under `_cv`, which
+        the dispatch loop observes at its next round (`_ring_dispatch`
+        reads `self._params_dev` exactly once per round, so any round
+        runs entirely under one generation). The outgoing params stay
+        device-resident as the rollback target (blue/green). Any
+        failure raises SwapRefused after recording it — the current
+        generation keeps serving."""
+        if self.dispatch != "ring":
+            self._refuse_swap(
+                "merge_core",
+                "hot swap rides the ring dispatch core (the merge "
+                "baseline binds params at build time)")
+        import jax
+
+        from veles_tpu.ops import variants
+        from veles_tpu.serving_aot import model_signature
+
+        # 1. geometry: the candidate must match the layer/param
+        # shapes+dtypes the AOT executable was compiled for, verbatim
+        cand = model_signature(workflow)
+        if cand != self._aot_signature["model"]:
+            self._refuse_swap(
+                "geometry",
+                "candidate layer/param geometry does not match the "
+                "AOT executable signature (a resized model needs a "
+                "rebuild, not a swap)")
+        params_host = tuple(
+            {k: np.asarray(a.mem) for k, a in u.param_arrays().items()}
+            for u in getattr(workflow, "forwards", ()))
+        # 2. the ledger-gated serve wire transform, host-side
+        try:
+            prepared, _shapes = variants.serve_prepare_params(
+                self.quantize, params_host)
+        except Exception as e:  # noqa: BLE001 — any transform failure
+            # is a refusal, never a crash of the serving process
+            self._refuse_swap("wire_transform",
+                              f"serve wire transform failed: {e}")
+        # 3. device placement under the live plan
+        try:
+            new_dev = (jax.device_put(prepared, self._plan["params"])
+                       if self._plan["mesh"] is not None
+                       else jax.device_put(prepared))
+        except Exception as e:  # noqa: BLE001
+            self._refuse_swap("device_put",
+                              f"device placement failed: {e}")
+        # 4. probe the candidate THROUGH THE LIVE EXECUTABLE against
+        # its own f32 forward (compiled executables are thread-safe;
+        # this round shares the device with serving traffic but never
+        # touches the serving pointer). The finiteness check runs
+        # FIRST: NaN params agree with their own NaN reference, so the
+        # equivalence bound alone would wave them through.
+        rows = min(self._ring_slots, 8)
+        rng = np.random.RandomState(11)
+        px = np.zeros((self._ring_slots,) + self._sample_shape,
+                      np.float32)
+        px[:rows] = rng.randn(rows, *self._sample_shape) \
+            .astype(np.float32)
+        try:
+            got = np.asarray(self._fn(new_dev,
+                                      self._ring_put(px)))[:rows]
+            want = self._f32_reference(self._dense, params_host,
+                                       px)[:rows]
+        except Exception as e:  # noqa: BLE001
+            self._refuse_swap("equivalence",
+                              f"candidate probe failed: {e}")
+        if not np.all(np.isfinite(got)):
+            self._refuse_swap(
+                "nonfinite",
+                "candidate forward produced non-finite values on the "
+                "probe rows")
+        err = float(np.max(np.abs(got - want)))
+        if err > SWAP_PROBE_TOL:
+            self._refuse_swap(
+                "equivalence",
+                f"max |wire - f32| = {err:.3e} on the candidate's "
+                f"probe exceeds {SWAP_PROBE_TOL}")
+        # 5. commit: one pointer swap under _cv — the next dispatched
+        # round serves the new generation, the outgoing one becomes
+        # the rollback target
+        if digest is None:
+            digest = params_digest(params_host)
+        with self._cv:
+            self._params_prev = self._params_dev
+            self._prev_gen = dict(self._generation)
+            # _ring_dispatch reads this pointer once per round WITHOUT
+            # _cv (an atomic attribute load under the GIL; either side
+            # of the swap is a fully valid generation, and taking the
+            # lock there would serialize admission against dispatch) —
+            # a deliberate lock-free publish the static pass can't see.
+            # velint: disable=shared-write-no-lock
+            self._params_dev = new_dev
+            self._generation = {"digest": digest,
+                                "since": time.time(),
+                                "source": source}
+            self.n_swaps += 1
+            gen = dict(self._generation)
+        self._m_swap_applied.inc()
+        self._m_gen_age.set(0.0)
+        self.info("hot swap applied: serving generation %s (from %s, "
+                  "probe err %.2e)", digest[:12], source, err)
+        return gen
+
+    def generation(self) -> Dict[str, Any]:
+        """The live generation label (digest / since / source) — the
+        cheap accessor the WeightWatcher polls (health() also computes
+        capacity hints; a poll loop needs none of that)."""
+        with self._cv:
+            return dict(self._generation)
+
+    def rollback(self) -> Dict[str, Any]:
+        """Re-point the ring at the PREVIOUS generation — its params
+        never left the device, so rollback is the same between-rounds
+        pointer swap as an applied push, with zero host work. A second
+        rollback rolls forward again (the pair just swaps). Refused
+        (`no_previous`) when no prior generation exists."""
+        with self._cv:
+            have_prev = self._params_prev is not None
+        if not have_prev:
+            self._refuse_swap(
+                "no_previous",
+                "no previous generation is resident (nothing was ever "
+                "swapped in)")
+        with self._cv:
+            self._params_dev, self._params_prev = \
+                self._params_prev, self._params_dev
+            outgoing = dict(self._generation)
+            restored = dict(self._prev_gen or {})
+            self._generation = {"digest": restored.get("digest", "boot"),
+                                "since": time.time(),
+                                "source": "rollback"}
+            self._prev_gen = outgoing
+            self.rolled_back.add(outgoing["digest"])
+            self.n_swaps += 1
+            gen = dict(self._generation)
+        self._m_swap_applied.inc()
+        self._m_gen_age.set(0.0)
+        self.info("rollback applied: serving generation %s (was %s)",
+                  gen["digest"][:12], outgoing["digest"][:12])
+        return gen
 
     # -- request handling -----------------------------------------------------
 
@@ -713,6 +976,11 @@ class InferenceServer(Logger):
         self._m_occupancy.observe(rows)
         t0 = time.perf_counter()
         xd = self._ring_put(x)
+        # The one intentionally lock-free read: swap_params/rollback
+        # commit the pointer atomically under _cv, this round reads it
+        # exactly once (either generation is fully valid), and the GIL
+        # makes the attribute load itself atomic — taking _cv here
+        # would serialize health/predict against device dispatch.
         out = self._fn(self._params_dev, xd)
         return (take, out, t0, tok)
 
@@ -895,8 +1163,12 @@ class InferenceServer(Logger):
         with self._cv:
             status = "draining" if (self._draining or self._stopping) \
                 else "ok"
+            now = time.time()
+            gen = dict(self._generation)
+            gen["serving_for_s"] = round(now - gen["since"], 3)
+            self._m_gen_age.set(now - gen["since"])
             return {"status": status,
-                    "uptime_s": round(time.time() - self._started_at, 3),
+                    "uptime_s": round(now - self._started_at, 3),
                     "inflight": self._inflight,
                     "pending": len(self._pending),
                     "n_dispatches": self.n_dispatches,
@@ -908,7 +1180,17 @@ class InferenceServer(Logger):
                     "ring_slots": self.ring_slots,
                     "round_latency_s": round(self._round_s, 6),
                     "retry_after_s": self._retry_after_locked(),
-                    "capacity": self._capacity_hint()}
+                    "capacity": self._capacity_hint(),
+                    # blue/green generation labels: the live digest +
+                    # serving-since, the resident rollback target, and
+                    # the swap ledger (counts + last refusal) — what a
+                    # deploy pipeline polls to confirm a push landed
+                    "generation": gen,
+                    "previous_generation":
+                        (self._prev_gen or {}).get("digest"),
+                    "swaps": {"applied": self.n_swaps,
+                              "refused": self.n_swap_refusals,
+                              "last_refusal": self._last_swap_refusal}}
 
     def model_info(self) -> Dict[str, Any]:
         wf = self.workflow
@@ -1001,6 +1283,29 @@ class InferenceServer(Logger):
                 # request's own version/headers negotiated
                 negotiated = self.close_connection
                 self.close_connection = True
+                if self.path.startswith("/rollback"):
+                    # control-plane verb: re-point the ring at the
+                    # previous weight generation (token-guarded — a
+                    # rollback changes what every client is served)
+                    if not check_shared_token(self, token):
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                        if not 0 <= n <= srv.max_body:
+                            raise ValueError("bad Content-Length")
+                        self.rfile.read(n)   # consume (empty) body
+                    except ValueError:
+                        self._send(400, {"error": "bad Content-Length"})
+                        return
+                    self.close_connection = negotiated
+                    try:
+                        gen = srv.rollback()
+                    except SwapRefused as e:
+                        self._send(409, {"error": str(e)[:300],
+                                         "reason": e.reason})
+                        return
+                    self._send(200, {"generation": gen})
+                    return
                 if not self.path.startswith("/predict"):
                     self._send(404, {"error": "unknown endpoint"})
                     return

@@ -39,7 +39,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from veles_tpu.logger import Logger
 
-__all__ = ["ServingAotCache", "default_aot_path", "serve_signature"]
+__all__ = ["ServingAotCache", "default_aot_path", "model_signature",
+           "serve_signature"]
 
 #: env override for the cache location (the autotune-cache convention)
 AOT_CACHE_ENV = "VELES_SERVING_AOT_CACHE"
@@ -51,6 +52,25 @@ def default_aot_path() -> str:
     return (os.environ.get(AOT_CACHE_ENV)
             or os.path.join(os.path.expanduser("~"), ".cache",
                             "veles_tpu", "serving_aot.json"))
+
+
+def model_signature(workflow) -> list:
+    """The model-geometry block of the serving signature: per-layer
+    param shapes + dtypes, exactly as the AOT executable was compiled
+    for. A hot-swap candidate must produce THIS list verbatim — it is
+    the one geometry contract shared by the AOT cache key and the
+    `InferenceServer.swap_params` pre-flight (a swap that changed it
+    would feed the compiled program arrays it was not traced for)."""
+    layers = []
+    for u in getattr(workflow, "forwards", ()):
+        layers.append({
+            "type": type(u).__name__,
+            "params": {k: [list(getattr(a, "shape", ()) or ()),
+                           str(getattr(getattr(a, "mem", None), "dtype",
+                                       "f32"))]
+                       for k, a in u.param_arrays().items()},
+        })
+    return layers
 
 
 def serve_signature(workflow, mesh, ring_slots: int, quantize: str,
@@ -66,15 +86,7 @@ def serve_signature(workflow, mesh, ring_slots: int, quantize: str,
     load-time verification, so a stale artifact can never be keyed
     back in under a changed geometry."""
     import jax
-    layers = []
-    for u in getattr(workflow, "forwards", ()):
-        layers.append({
-            "type": type(u).__name__,
-            "params": {k: [list(getattr(a, "shape", ()) or ()),
-                           str(getattr(getattr(a, "mem", None), "dtype",
-                                       "f32"))]
-                       for k, a in u.param_arrays().items()},
-        })
+    layers = model_signature(workflow)
     if mesh is not None:
         mesh_sig: Optional[Dict[str, Any]] = {
             "axes": {k: int(v) for k, v in dict(mesh.shape).items()},

@@ -400,16 +400,21 @@ class Snapshotter(SnapshotterBase):
         return valid[skip]
 
     @staticmethod
-    def import_(path: str):
+    def import_(path: str, restore_prng: bool = True):
         """Restore a workflow from a snapshot file (any supported codec,
-        sniffed by magic bytes, so renamed files still load)."""
+        sniffed by magic bytes, so renamed files still load).
+
+        `restore_prng=False` skips restoring the global prng registry:
+        a SERVING-side import (the hot-swap WeightWatcher) only wants
+        the candidate's params and must not clobber the process-wide
+        RNG streams of whatever else runs in this process."""
         with open(path, "rb") as f:
             head = f.read(6)
         opener = _opener_for_magic(head)
         with opener(path, "rb") as f:
             obj = pickle.load(f)
         if isinstance(obj, dict) and "__veles_snapshot__" in obj:
-            if obj.get("prng") is not None:
+            if restore_prng and obj.get("prng") is not None:
                 from veles_tpu import prng
                 prng.restore_registry(obj["prng"])
             return obj["workflow"]

@@ -412,6 +412,20 @@ def register_standard(reg: MetricsRegistry) -> None:
                   "occupancy under load means admission, not the "
                   "device, is the bottleneck)",
                   buckets=RING_OCCUPANCY_BUCKETS)
+    reg.counter("veles_serving_swap_applied_total",
+                "hot weight swaps applied to the serving ring "
+                "(watcher pushes + explicit rollbacks; the blue/green "
+                "pointer moved, no recompile, no drain)")
+    reg.counter("veles_serving_swap_refused_total",
+                "hot swaps refused by stage — the ring kept serving "
+                "the current generation (reasons: fetch_failed, "
+                "verify_failed, import_failed, geometry, "
+                "wire_transform, device_put, equivalence, nonfinite, "
+                "merge_core, no_previous)",
+                labelnames=("reason",))
+    reg.gauge("veles_serving_generation_age_seconds",
+              "seconds the live weight generation has been serving "
+              "(resets to 0 at every applied swap/rollback)")
 
 
 _DEFAULT: Optional[MetricsRegistry] = None

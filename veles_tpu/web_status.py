@@ -145,6 +145,27 @@ def workflow_status(workflow) -> Dict[str, Any]:
             }
     except Exception:       # backend not initialized yet: no cluster row
         pass
+    # hot-swap deploy state (ISSUE 16), read from the one process
+    # registry: swaps applied/refused and the live generation's age.
+    # Guarded + only shown once serving activity exists — a pure
+    # training run keeps its status payload unchanged.
+    try:
+        from veles_tpu.telemetry import metrics as _m
+        reg = _m.default_registry()
+        flat = reg.snapshot_flat()
+        applied = flat.get("veles_serving_swap_applied_total", 0.0)
+        age = flat.get("veles_serving_generation_age_seconds")
+        fam = reg.counter("veles_serving_swap_refused_total")
+        refused = {(k[0] if k else "total"): ch.value
+                   for k, ch in getattr(fam, "_children", {}).items()}
+        if applied or refused or age:
+            status["serving"] = {
+                "swaps_applied": applied,
+                "swaps_refused": refused,
+                "generation_age_s": age,
+            }
+    except Exception:       # metrics plane optional for the dashboard
+        pass
     return status
 
 
