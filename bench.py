@@ -393,6 +393,33 @@ def child_main() -> None:
         }
     except Exception as e:  # noqa: BLE001
         scaling_rec = {"error": str(e)[:200]}
+    # the planner's predicted block (analysis pass 7) next to the
+    # measured number: every bench run doubles as a calibration point
+    # for the whole-system model. pred_err = predicted/measured - 1
+    # per-chip rate, surfaced on the compact line; None when the
+    # device kind has no committed MFU sweep (docs/PLANNER.md).
+    predicted_rec, pred_err = None, None
+    try:
+        from veles_tpu.analysis import planner as _planner
+        _n_params = sum(int(v.size) for layer in state["params"]
+                        for v in layer.values())
+        _prof = step.resource_profile() \
+            if hasattr(step, "resource_profile") else {}
+        _vt = step.variant_table()
+        predicted_rec = _planner.predict_for_bench(
+            n_params=_n_params,
+            train_flops_per_sample=train_flops,
+            device_kind=kind, n_chips=n_chips, batch_per_chip=BATCH,
+            zero_active=bool(_prof.get("zero_active")),
+            wire=_vt.get("grad_reduce") or "f32",
+            fused=bool(getattr(step, "fusion_pairs", lambda: ())()),
+            input_hw=int(x.shape[1]))
+        if predicted_rec.get("calibrated"):
+            pred_err = round(
+                predicted_rec["samples_per_sec_per_chip"] / per_chip
+                - 1.0, 4)
+    except Exception as e:  # noqa: BLE001 - must never cost the number
+        predicted_rec = {"error": str(e)[:200]}
     print(json.dumps({
         "metric": METRIC,
         "value": round(per_chip, 2),
@@ -428,6 +455,11 @@ def child_main() -> None:
         "train_gflops_per_sample": round(train_flops / 1e9, 3),
         "fwd_layer_gflops_per_sample": layer_gflops,
         "scaling_prediction_v5e64": scaling_rec,
+        # analysis pass 7: the whole-system model's prediction for
+        # THIS measured config (step time, comms bytes, HBM
+        # high-water) — the planner's standing calibration loop
+        "predicted": predicted_rec,
+        "pred_err": pred_err,
     }))
 
 
@@ -670,7 +702,8 @@ RECORD_PATH = os.environ.get("BENCH_RECORD_PATH") or os.path.join(
 #: full-record keys the compact stdout line keeps verbatim
 _COMPACT_KEYS = ("metric", "value", "unit", "vs_baseline", "mfu",
                  "device_kind", "n_chips", "batch_per_chip", "variants",
-                 "telemetry", "degraded", "provisional", "attempts")
+                 "telemetry", "pred_err", "degraded", "provisional",
+                 "attempts")
 
 
 def _compact(rec, record_path) -> dict:

@@ -6,6 +6,9 @@ Usage (on a machine with the TPU visible):
     python tools/ablate.py --collectives   # grad_reduce variant A/B (ISSUE 12)
     python tools/ablate.py --fusion        # fused vs composed lrn+maxpool A/B
                                            # (ISSUE 13; CPU mesh via interpret)
+    python tools/ablate.py --plan          # planner top-1 vs hand-set defaults
+                                           # (ISSUE 17; measured A/B of the
+                                           # analysis-pass-7 config search)
 
 Each variant builds the AlexNet fused train step with a layer family
 removed and reports samples/s via train_repeat — the deltas attribute
@@ -580,6 +583,180 @@ def measure_fusion_ab() -> dict:
     return record
 
 
+def measure_plan_ab() -> dict:
+    """Measured A/B of the whole-system planner (ISSUE 17): let
+    `analysis/planner.plan_search` price + gate the config space with
+    the hand-set defaults as the incumbent, then TIME the model's
+    top-k through the same train_repeat protocol as every other A/B
+    here — the incumbent is always in the timed set, so the measured
+    winner can never silently lose to the defaults. The measured
+    protocol fixes batch and mesh (they are the A/B's controlled
+    variables) and searches the system knobs the planner exists for:
+    grad_reduce wire, ZeRO on/off, the fusion claim. On the CPU mesh
+    the model's absolute seconds are uncalibrated (the MFU curve is
+    fit to the v5e sweep) — the record carries predicted numbers for
+    rank comparison only; the on-chip twin is tpu_watch_r8.sh step 11.
+    Record lands in PLAN_AB_RECORD.json (env VELES_PLAN_AB_PATH);
+    CPU smoke knobs PLAN_AB_BATCH/WIDTH/STEPS/BUDGET."""
+    import contextlib
+    import json
+
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.analysis import planner
+    from veles_tpu.loader.synthetic import SyntheticClassifierLoader
+    from veles_tpu.ops import variants
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.samples.alexnet import alexnet_layers
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit("--plan needs a >=2-device mesh (the planner "
+                         "ranks data-parallel configs); this host "
+                         f"exposes {len(devs)} device(s)")
+    mesh = make_mesh(devs)
+    n_data = len(devs)
+    batch = int(os.environ.get("PLAN_AB_BATCH", str(BATCH)))
+    width = float(os.environ.get("PLAN_AB_WIDTH", "1.0"))
+    steps = int(os.environ.get("PLAN_AB_STEPS", str(K)))
+    budget = int(os.environ.get("PLAN_AB_BUDGET", "16"))
+    if batch % n_data:
+        raise SystemExit(f"--plan: batch {batch} not divisible by the "
+                         f"{n_data}-device data axis")
+    on_cpu = jax.default_backend() == "cpu"
+    kind = devs[0].device_kind
+    layers = list(alexnet_layers(64, width, int(4096 * width) or 64))
+    geom = planner.model_geometry(layers, name="alexnet-ab")
+
+    # the hand-set defaults every earlier A/B ran at: full-mesh dp,
+    # ZeRO on, registry-default f32 wire, composed kernels
+    incumbent = planner.PlanConfig(
+        mesh_shape=(n_data,), batch_per_chip=batch // n_data,
+        zero="on", wire=variants.selected("grad_reduce") or "f32",
+        fusion="composed")
+    space = {
+        "batch_per_chip": [batch // n_data],
+        "mesh_shape": [(n_data,)],
+        "wire": ["f32", "bf16", "int8_block", "int8_ef"],
+        "zero": ["on", "off"],
+        "fusion": ["composed", "fused"],
+    }
+
+    prev_wire = variants.selected("grad_reduce")
+    prev_fuse = variants.selected("lrn_maxpool")
+    fused_point = os.environ.get("FUSION_AB_POINT",
+                                 "fused[rt=2,io=native,fuse=1]")
+    timed_log = []
+
+    def timer(cfg) -> float:
+        """Seconds per step of `cfg` under the train_repeat 3-window
+        protocol (the measure() discipline)."""
+        prng.seed_all(1)
+        variants.select("grad_reduce", cfg.wire)
+        if cfg.fusion == "composed":
+            variants.select("lrn_maxpool", "composed")
+        else:
+            variants.select("lrn_maxpool", fused_point)
+        loader = SyntheticClassifierLoader(
+            n_classes=64, sample_shape=(227, 227, 3), n_validation=64,
+            n_train=128, minibatch_size=batch, noise=0.5)
+        wf = StandardWorkflow(
+            layers=[dict(l) for l in layers], loader=loader,
+            loss="softmax", n_classes=64,
+            decision_config={"max_epochs": 1, "fail_iterations": 9},
+            gd_config={"learning_rate": 0.01, "gradient_moment": 0.9},
+            name="PlanAB")
+        wf.initialize(device=None)
+        ctx = variants.pallas_interpret() if on_cpu \
+            else contextlib.nullcontext()
+        with ctx:
+            step = wf.build_fused_step(
+                mesh=mesh, mode="dp", compute_dtype="bfloat16",
+                zero_sharding=cfg.zero)
+            state = step.init_state()
+            rng = np.random.RandomState(0)
+            x = rng.randn(batch, 227, 227, 3).astype(np.float32)
+            y = rng.randint(0, 64, batch)
+            xs, ys_, _ = step.input_put_specs()
+            import jax.sharding as jsh
+            x = jax.device_put(x, jsh.NamedSharding(mesh, xs))
+            y = jax.device_put(y, jsh.NamedSharding(mesh, ys_))
+            state, _ = step.train_repeat(state, x, y, steps)
+            # post-warm sync barrier BY DESIGN (cf. measure())
+            # velint: disable=sync-feed
+            np.asarray(state["params"][-1]["bias"][:1])
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                state, _ = step.train_repeat(state, x, y, steps)
+                # measurement barrier BY DESIGN (cf. measure())
+                # velint: disable=sync-feed
+                np.asarray(state["params"][-1]["bias"][:1])
+                best = min(best, time.perf_counter() - t0)
+        per_step = best / steps
+        timed_log.append((cfg, per_step))
+        print(f"ABLATE plan[timed]: wire={cfg.wire} zero={cfg.zero} "
+              f"fusion={cfg.fusion} -> "
+              f"{batch / per_step:.0f} samples/s", flush=True)
+        del state
+        return per_step
+
+    try:
+        plan = planner.plan_search(
+            geom, device_kind=kind, n_chips=n_data, budget=budget,
+            incumbent=incumbent, space=space, timer=timer, top_k=2)
+    finally:
+        for op, prev in (("grad_reduce", prev_wire),
+                         ("lrn_maxpool", prev_fuse)):
+            if prev is None:
+                variants.clear_selection(op)
+            else:
+                variants.select(op, prev)
+
+    def arm(entry):
+        return {"config": entry["config"],
+                "measured_step_s": entry.get("measured_step_s"),
+                "samples_per_sec": (
+                    round(batch / entry["measured_step_s"], 1)
+                    if entry.get("measured_step_s") else None),
+                "predicted_samples_per_sec": round(
+                    entry["predicted"]["samples_per_sec"], 1),
+                "memory_verdict": entry["memory"]["verdict"]}
+
+    inc_entry = plan["incumbent"]
+    top = plan["measured_top1"]
+    top_entry = next(e for e in plan["ranked"]
+                     if e["config"] == top["config"])
+    record = {
+        "metric": "plan_ab", "n_devices": n_data, "device_kind": kind,
+        "batch": batch, "width": width, "steps_per_window": steps,
+        "budget": budget, "evaluated": plan["budget"]["evaluated"],
+        "pallas": "interpret" if on_cpu else "compiled",
+        "calibrated": plan["calibrated"],
+        "arms": {"defaults": arm(inc_entry),
+                 "planner_top1": arm(top_entry)},
+    }
+    inc_s = inc_entry["measured_step_s"]
+    top_s = top["measured_step_s"]
+    record["deltas"] = {
+        "speedup": round(inc_s / max(top_s, 1e-12), 4),
+        "meets_or_beats": top_s <= inc_s,
+    }
+    path = os.environ.get("VELES_PLAN_AB_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PLAN_AB_RECORD.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2, default=str)
+    print(f"ABLATE plan: top-1/defaults measured speedup "
+          f"{record['deltas']['speedup']:.3f} "
+          f"(meets_or_beats={record['deltas']['meets_or_beats']}, "
+          f"{record['evaluated']} configs priced, "
+          f"{len(timed_log)} timed) -> {path}", flush=True)
+    return record
+
+
 def _time_isolated_reduce(step, mesh, repeats: int = 3) -> float:
     """Seconds per call of JUST the selected grad_reduce exchange over
     the step's total flat gradient size (one concatenated vector) —
@@ -622,6 +799,11 @@ def _time_isolated_reduce(step, mesh, repeats: int = 3) -> float:
 
 if __name__ == "__main__":
     args = sys.argv[1:]
+    if "--plan" in args:
+        measure_plan_ab()
+        args = [a for a in args if a != "--plan"]
+        if not args:
+            raise SystemExit(0)
     if "--fusion" in args:
         measure_fusion_ab()
         args = [a for a in args if a != "--fusion"]

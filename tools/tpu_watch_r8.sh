@@ -45,6 +45,12 @@
 #      pushes over the mirror bus + one /rollback inside one open-loop
 #      window with ZERO failed requests — on chips the incoming
 #      generation's device_put is a real HBM transfer
+#  11. tools/ablate.py --plan            -> ISSUE 17 on-chip twin of
+#      the planner A/B: the static model's top-1 config vs the
+#      hand-set defaults, both timed through the standard
+#      train_repeat protocol — on chips the prediction is calibrated
+#      (the MFU curve was fit to this device kind), so the record
+#      also scores predicted-vs-measured error where CPU cannot
 # Probe the flaky axon tunnel in a loop; the moment it answers, run the
 # queue in priority order, each timeout-bounded so one hang cannot eat
 # the warm window. Everything lands in tpu_watch/ + ONCHIP_LATE.md.
@@ -131,6 +137,13 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       --workers 64 --record tpu_watch/r8_swap_record.json \
       > tpu_watch/r8_swap.txt 2>&1
     log "10 loadtest --swap rc=$? last: $(tail -1 tpu_watch/r8_swap.txt | head -c 200)"
+    # 11. ISSUE 17: planner A/B — static top-1 vs hand-set defaults,
+    # measured on chip; the same record also checks predicted-vs-
+    # measured error on the CALIBRATED device kind
+    VELES_PLAN_AB_PATH=tpu_watch/r8_plan_ab.json \
+      timeout 1200 python tools/ablate.py --plan \
+      > tpu_watch/r8_plan_ab.txt 2>&1
+    log "11 ablate --plan rc=$? last: $(tail -1 tpu_watch/r8_plan_ab.txt | head -c 200)"
     {
       echo "# ONCHIP_LATE — r8 watcher capture ($(date -u +%FT%TZ))"
       echo
@@ -155,6 +168,8 @@ print(jax.jit(lambda a: (a @ a).sum())(x))
       echo '```'; grep ^LOADTEST tpu_watch/r8_loadtest_ab.txt | tail -1; echo '```'
       echo "## 10. tools/loadtest.py --swap (hot-swap under load, ISSUE 16 on-chip twin)"
       echo '```'; grep ^LOADTEST tpu_watch/r8_swap.txt | tail -1; echo '```'
+      echo "## 11. tools/ablate.py --plan (planner top-1 vs defaults, ISSUE 17 on-chip twin)"
+      echo '```'; grep ^ABLATE tpu_watch/r8_plan_ab.txt | tail -2; echo '```'
     } > ONCHIP_LATE.md
     log "capture done -> ONCHIP_LATE.md"
     exit 0

@@ -1,7 +1,7 @@
 """Static analysis for veles_tpu: make wiring, tracing and hot-path
 mistakes checkable BEFORE anything runs — on CPU, in CI.
 
-Six passes (docs/ANALYSIS.md has the full rule catalogue):
+Seven passes (docs/ANALYSIS.md has the full rule catalogue):
 
 - `graph`  — workflow-graph verifier over a constructed `Workflow`
   (dangling/shadowed aliases, AND-gate cycles, unreachable units,
@@ -21,6 +21,12 @@ Six passes (docs/ANALYSIS.md has the full rule catalogue):
   that PRUNE the budgeted search (`--verify-workflow=resources`), and
   the per-device workflow HBM model behind the launcher pre-flight,
   bench "memory" records and the serving capacity hint.
+- `planner` — the whole-system performance model + budgeted config
+  search (docs/PLANNER.md): predicted step time (compute roofline +
+  wire-aware comms + feed) over (mesh, batch, ZeRO, wire, fusion),
+  gated by the `resources` ledgers, behind `tools/plan.py`,
+  `tools/ablate.py --plan` and bench's `predicted`/`pred_err`
+  calibration block.
 
 `findings.Finding` is the shared record the workflow-facing passes
 emit; `concurrency`/`protocol` emit `lint.LintFinding` so they share
@@ -50,4 +56,9 @@ def __getattr__(name: str):
         if name == "trace":
             return trace
         return getattr(trace, name)
+    if name == "planner":
+        # planner imports the ops registry (a jax MODULE import, no
+        # backend); lazy for the same import-light consumers as trace
+        import importlib
+        return importlib.import_module("veles_tpu.analysis.planner")
     raise AttributeError(name)

@@ -126,6 +126,36 @@ def predict_tp_layer(*, batch_tokens: int, width: int, hidden: int,
     }
 
 
+#: default cross-host (DCN) bandwidth, bytes/s per host: ~100 Gb/s NIC
+#: (public v5e pod specs). The planner's hierarchical-collective leg
+#: divides by this; override per deployment via the planner's dcn_bw
+#: argument (env VELES_PLAN_DCN_BW in tools/plan.py).
+DCN_BW_DEFAULT = 12.5e9
+
+
+def wire_collective_time_s(*, dcn_bytes: float, ici_bytes: float,
+                           ici_bw_axis_bidir: float = V5E_ICI_BW_AXIS_BIDIR,
+                           dcn_bw: float = DCN_BW_DEFAULT
+                           ) -> Dict[str, Any]:
+    """Seconds for one collective whose PER-DEVICE egress is already
+    split by link leg — the PR-11 `wire[dt,blk,ef,hier]` byte model
+    (`ops.variants.grad_reduce_bytes`) extended into a time model. The
+    byte model already carries the ring (x-1)/x factors and the
+    quantized/hierarchical payload shrinkage, so the legs just ride
+    their respective bandwidths; the slower leg does NOT hide the
+    faster one (the hierarchical exchange runs ICI phase then DCN
+    phase sequentially — conservative for the flat legs, exact for
+    hier)."""
+    t_ici = float(ici_bytes) / ici_bw_axis_bidir
+    t_dcn = float(dcn_bytes) / dcn_bw
+    return {"ici_s": t_ici, "dcn_s": t_dcn, "total_s": t_ici + t_dcn,
+            "inputs": {"dcn_bytes": float(dcn_bytes),
+                       "ici_bytes": float(ici_bytes),
+                       "ici_bw_axis_bidir_bytes_per_s":
+                           float(ici_bw_axis_bidir),
+                       "dcn_bw_bytes_per_s": float(dcn_bw)}}
+
+
 #: one direction of one v5e ICI link — the ring's K/V hop
 #: (lax.ppermute i -> i+1, ops/attention.py) travels ONE way, so it
 #: rides a single link, not the per-axis bidirectional aggregate the
