@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""modelcheck — the protocol model checker gate (analysis pass 8;
+docs/ANALYSIS.md, docs/RESILIENCE.md).
+
+Explores bounded interleavings of the REAL election / membership /
+hot-swap protocol logic (resilience/cluster.py, serving_watch.py,
+serving_gen.py) inside a simulated world — every agent-scheduling
+choice and every injected infrastructure fault (dropped beat, stale
+route, torn meta read, lost beacon, crash around the coordinator
+announcement) is a branch — and checks the 8-invariant ledger after
+every action. Any violation comes with a REPLAYABLE counterexample
+schedule (JSON).
+
+    tools/modelcheck.py --ci              # CI gate: fixed budget,
+                                          # every scenario, fail on any
+                                          # violation
+    tools/modelcheck.py --scenario election --budget 2000
+    tools/modelcheck.py --mutant no_floor_stop
+                                          # seeded-bug run: succeeds
+                                          # when the checker CATCHES it
+    tools/modelcheck.py --replay tests/data/modelcheck_floor_counterexample.json
+    tools/modelcheck.py --list            # scenarios + mutants
+
+Exit codes: 0 clean (or mutant caught / replay reproduced), 1 a
+violation on the shipped tree (or a mutant escaped / replay diverged).
+
+Pure stdlib + veles_tpu (no jax import on the cluster planes; the
+hotswap plane lazily imports veles_tpu.serving for SwapRefused): a
+full `--ci` sweep is a few seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from veles_tpu.analysis import modelcheck as mc  # noqa: E402
+
+#: the CI gate's fixed exploration shape: every scenario at this budget
+#: (4 x 300 = 1200 schedules >= the acceptance floor of 1000), seed 0,
+#: at most 2 concurrent infrastructure faults per schedule — the
+#: documented exhaustiveness bound (docs/ANALYSIS.md pass 8)
+CI_BUDGET = 300
+CI_SEED = 0
+CI_MAX_FAULTS = 2
+
+
+def _route_telemetry(results, outcome: str) -> None:
+    """Count explored schedules into the shared registry
+    (`veles_modelcheck_traces_total{outcome}`), mirroring to
+    VELES_METRICS_JSONL when set. Guarded: telemetry must never flip
+    the gate's verdict."""
+    try:
+        from veles_tpu.telemetry import metrics as tmetrics
+        jsonl = os.environ.get("VELES_METRICS_JSONL")
+        if jsonl:
+            tmetrics.install_jsonl(jsonl)
+        reg = tmetrics.default_registry()
+        traces = reg.counter(
+            "veles_modelcheck_traces_total",
+            "model-checker schedules explored, by run outcome",
+            labelnames=("outcome",))
+        traces.labels(outcome=outcome).inc(
+            sum(r.schedules for r in results))
+        tmetrics.flush_installed(extra={"source": "modelcheck"})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _print_violation(cx, args) -> None:
+    print(f"VIOLATION invariant {cx['invariant']} ({cx['rule']}) in "
+          f"scenario {cx['scenario']}"
+          + (f" mutant {cx['mutant']}" if cx.get("mutant") else ""))
+    print(f"  {cx['message']}")
+    print(f"  schedule: {len(cx['schedule'])} choices, seed "
+          f"{cx['seed']}, max_faults {cx['max_faults']}")
+    if args.dump_dir:
+        os.makedirs(args.dump_dir, exist_ok=True)
+        path = os.path.join(
+            args.dump_dir,
+            f"counterexample_{cx['scenario']}_{cx['rule']}.json")
+        with open(path, "w") as f:
+            json.dump(cx, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"  counterexample written to {path}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="modelcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--scenario", action="append", default=None,
+                   choices=sorted(mc.SCENARIOS),
+                   help="scenario(s) to explore (default: all)")
+    p.add_argument("--budget", type=int, default=CI_BUDGET,
+                   help="schedules to explore per scenario "
+                        f"(default {CI_BUDGET})")
+    p.add_argument("--seed", type=int, default=CI_SEED,
+                   help="jitter seed pinned per run "
+                        f"(default {CI_SEED})")
+    p.add_argument("--max-faults", type=int, default=CI_MAX_FAULTS,
+                   help="fault budget per schedule "
+                        f"(default {CI_MAX_FAULTS})")
+    p.add_argument("--depth", type=int, default=None,
+                   help="override the scenario's action depth")
+    p.add_argument("--mutant", choices=sorted(mc.MUTANTS),
+                   help="run ONE seeded protocol bug; exit 0 when the "
+                        "checker catches it (its registered budget "
+                        "applies unless --budget/--max-faults given)")
+    p.add_argument("--replay", metavar="FILE",
+                   help="replay a counterexample JSON; exit 0 when the "
+                        "recorded violation reproduces")
+    p.add_argument("--ci", action="store_true",
+                   help="the fixed-budget CI gate over every scenario")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable result")
+    p.add_argument("--dump-dir", default="",
+                   help="write counterexample JSONs here")
+    p.add_argument("--list", action="store_true",
+                   help="list scenarios and mutants, then exit")
+    args = p.parse_args(argv)
+
+    if args.list:
+        print("scenarios:")
+        for name, s in sorted(mc.SCENARIOS.items()):
+            print(f"  {name:12s} depth {s.max_actions:3d}  "
+                  f"{s.description}")
+        print("mutants (seeded protocol bugs, one per invariant):")
+        for name, spec in sorted(mc.MUTANTS.items()):
+            print(f"  {name:20s} inv {spec['invariant']} "
+                  f"({spec['rule']}, scenario {spec['scenario']}): "
+                  f"{spec['description']}")
+        return 0
+
+    if args.replay:
+        with open(args.replay) as f:
+            cx = json.load(f)
+        violation = mc.replay(cx)
+        if violation is None:
+            print(f"modelcheck: replay of {args.replay} ran CLEAN — "
+                  f"the recorded {cx.get('rule')} violation no longer "
+                  f"reproduces")
+            return 1
+        ok = violation.rule == cx.get("rule")
+        print(f"modelcheck: replay reproduced {violation.rule} "
+              f"(recorded {cx.get('rule')}): {violation.message}")
+        return 0 if ok else 1
+
+    if args.mutant:
+        spec = mc.MUTANTS[args.mutant]
+        kwargs = dict(spec["explore"])
+        if "--budget" in (argv if argv is not None else sys.argv):
+            kwargs["budget"] = args.budget
+        if "--max-faults" in (argv if argv is not None else sys.argv):
+            kwargs["max_faults"] = args.max_faults
+        result = mc.explore(spec["scenario"], mutant=args.mutant,
+                            seed=args.seed, max_actions=args.depth,
+                            stop_on_violation=False, **kwargs)
+        caught = [v for v in result.violations
+                  if v["rule"] == spec["rule"]]
+        for cx in caught[:1]:
+            _print_violation(cx, args)
+        print(f"modelcheck: mutant {args.mutant} "
+              f"{'CAUGHT' if caught else 'ESCAPED'} after "
+              f"{result.schedules} schedule(s) "
+              f"({len(result.violations)} violation(s) total)")
+        return 0 if caught else 1
+
+    scenarios = args.scenario or sorted(mc.SCENARIOS)
+    budget = CI_BUDGET if args.ci else args.budget
+    seed = CI_SEED if args.ci else args.seed
+    max_faults = CI_MAX_FAULTS if args.ci else args.max_faults
+    results = [mc.explore(name, budget=budget, seed=seed,
+                          max_actions=args.depth,
+                          max_faults=max_faults,
+                          stop_on_violation=False)
+               for name in scenarios]
+    findings = mc.findings_from(results)
+    total = sum(r.schedules for r in results)
+    _route_telemetry(results, "violation" if findings else "clean")
+
+    if args.json:
+        print(json.dumps({
+            "schedules": total,
+            "pruned": sum(r.pruned for r in results),
+            "scenarios": {r.scenario: {
+                "schedules": r.schedules, "pruned": r.pruned,
+                "exhausted": r.exhausted,
+                "violations": r.violations} for r in results},
+            "findings": [f.as_dict() for f in findings]}))
+    else:
+        for r in results:
+            for cx in r.violations:
+                _print_violation(cx, args)
+        print(f"modelcheck: {total} schedule(s) across "
+              f"{len(results)} scenario(s), "
+              f"{sum(r.pruned for r in results)} pruned, "
+              f"{len(findings)} violation(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

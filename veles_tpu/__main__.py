@@ -103,8 +103,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax NaN checking (debug runs)")
     p.add_argument("--verify-workflow", nargs="?", const="graph",
-                   default=None, choices=("graph", "audit", "resources"),
-                   metavar="{graph,audit,resources}",
+                   default=None,
+                   choices=("graph", "audit", "resources", "modelcheck"),
+                   metavar="{graph,audit,resources,modelcheck}",
                    help="statically verify the constructed workflow "
                         "(analysis pass: dangling/shadowed link_attrs "
                         "aliases, AND-gate control cycles, unreachable "
@@ -122,7 +123,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "and the per-device HBM model (params + grads "
                         "+ ZeRO optimizer vectors + activation "
                         "high-water + feed buffers) vs the memstats "
-                        "device limit")
+                        "device limit. --verify-workflow=modelcheck "
+                        "ALSO runs a small fixed-budget sweep of the "
+                        "protocol model checker (pass 8): bounded "
+                        "interleaving exploration of the election / "
+                        "membership / hot-swap planes — the full CI "
+                        "gate is tools/modelcheck.py --ci")
     p.add_argument("--serve", nargs="?", const=0, default=None, type=int,
                    metavar="PORT",
                    help="serve the (snapshot-restored) model over HTTP "

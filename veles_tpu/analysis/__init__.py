@@ -1,7 +1,7 @@
 """Static analysis for veles_tpu: make wiring, tracing and hot-path
 mistakes checkable BEFORE anything runs — on CPU, in CI.
 
-Seven passes (docs/ANALYSIS.md has the full rule catalogue):
+Eight passes (docs/ANALYSIS.md has the full rule catalogue):
 
 - `graph`  — workflow-graph verifier over a constructed `Workflow`
   (dangling/shadowed aliases, AND-gate cycles, unreachable units,
@@ -27,6 +27,13 @@ Seven passes (docs/ANALYSIS.md has the full rule catalogue):
   gated by the `resources` ledgers, behind `tools/plan.py`,
   `tools/ablate.py --plan` and bench's `predicted`/`pred_err`
   calibration block.
+- `modelcheck` — bounded protocol model checker: exhaustive
+  interleaving + fault-injection exploration of the REAL election /
+  membership / hot-swap logic (resilience/cluster.py, serving_watch)
+  under a simulated world and virtual clock, against the 8-invariant
+  ledger in docs/RESILIENCE.md. Every violation carries a replayable
+  counterexample schedule. `tools/modelcheck.py --ci` is the gate;
+  `--verify-workflow=modelcheck` runs a small fixed-budget sweep.
 
 `findings.Finding` is the shared record the workflow-facing passes
 emit; `concurrency`/`protocol` emit `lint.LintFinding` so they share
@@ -61,4 +68,10 @@ def __getattr__(name: str):
         # backend); lazy for the same import-light consumers as trace
         import importlib
         return importlib.import_module("veles_tpu.analysis.planner")
+    if name == "modelcheck":
+        # jax-free but heavy on protocol modules (cluster, serving_gen,
+        # serving_watch); lazy so `import veles_tpu.analysis` stays a
+        # findings/lint-sized import for the supervisor's exit report
+        import importlib
+        return importlib.import_module("veles_tpu.analysis.modelcheck")
     raise AttributeError(name)

@@ -40,6 +40,15 @@ them (rule catalogue + one-line triggering examples in docs/ANALYSIS.md):
   (the `grad_reduce` reduce-scatter is the precedent) or move it into
   the step modules that own collective placement.
 
+- `raw-clock` (error): a direct `time.time()` / `time.monotonic()` /
+  `time.sleep()` CALL in the seamed protocol planes (`resilience/`,
+  `serving_watch.py`). Those loops run under the bounded model checker
+  (analysis pass 8) with a `VirtualClock`; a raw `time.*` call is a
+  hidden real-time dependency the checker cannot own. Take a `clock`
+  parameter (resilience/clock.py, default `SYSTEM_CLOCK`) instead.
+  Naming a function without calling it (`sleep=time.sleep` defaults)
+  stays legal; clock.py's delegating bodies carry suppressions.
+
 Suppression: append `# velint: disable=RULE[,RULE2]` (or `disable=all`)
 to the offending line. CI gate: `tools/velint.py --ci` compares against
 the checked-in baseline (`tools/velint_baseline.json`) and fails only on
@@ -83,6 +92,10 @@ RULES: Dict[str, str] = {
                            "Pallas kernel function body — a frozen "
                            "tuning axis the template config space "
                            "(ops/templates.py) cannot search",
+    "raw-clock": "direct time.time()/time.monotonic()/time.sleep() in "
+                 "a resilience/serving-watch control loop — go through "
+                 "the resilience/clock.py seam so the model checker "
+                 "and tests can own time",
 }
 
 #: registry lookup method names (telemetry/metrics.py): calling one
@@ -131,6 +144,26 @@ _TILE_NAME_RE = re.compile(r"tile|blk|block", re.IGNORECASE)
 
 def _is_pallas_file(path: str) -> bool:
     return "pallas" in re.split(r"[/\\]", path)[-1].lower()
+
+#: time.* calls the raw-clock rule bans in the seamed planes (the
+#: protocol control loops the model checker re-executes): each one is a
+#: hidden dependency on REAL time that a VirtualClock cannot own.
+#: References that merely NAME a function (`sleep=time.sleep` signature
+#: defaults, backoff.py's injectable idiom) are not calls and stay
+#: legal — the caller decides what to inject.
+_RAW_CLOCK_CALLS = ("time.time", "time.monotonic", "time.sleep",
+                    "time.time_ns", "time.monotonic_ns")
+
+
+def _is_clocked_path(path: str) -> bool:
+    """The raw-clock rule's scope: the cluster/supervisor protocol
+    plane (anything under `resilience/`) plus the serving-side watch
+    loop — the code the model checker runs against a virtual clock.
+    clock.py itself is IN scope and carries explicit suppressions: it
+    is the one blessed home for the delegating time.* calls."""
+    parts = re.split(r"[/\\]", path)
+    return any(p == "resilience" for p in parts[:-1]) \
+        or parts[-1] == "serving_watch.py"
 
 #: method names that ARE the per-minibatch hot path of a unit
 _HOT_METHODS = ("run", "xla_run")
@@ -188,6 +221,7 @@ class _Linter(ast.NodeVisitor):
         self._loader_file = _is_loader_path(path)
         self._collective_home = _is_collective_home(path)
         self._pallas_file = _is_pallas_file(path)
+        self._clocked_file = _is_clocked_path(path)
         self._func_depth = 0
         #: innermost-class stack of "defines a stop() method" flags
         self._class_stop: List[bool] = []
@@ -464,6 +498,15 @@ class _Linter(ast.NodeVisitor):
                        "— register it in ops/variants.py (grad_reduce "
                        "is the precedent) or place it in the step "
                        "builders that own collectives")
+
+        if self._clocked_file and chain in _RAW_CLOCK_CALLS:
+            self._emit(node, "raw-clock",
+                       f"`{chain}()` in a resilience/serving-watch "
+                       "control loop bypasses the injectable clock "
+                       "seam: take a `clock` (resilience/clock.py, "
+                       "default SYSTEM_CLOCK) and call "
+                       f"`clock.{chain.split('.', 1)[1]}()` so the "
+                       "model checker and tests can own time")
 
         if chain == "jax.jit" and self._loop_depth:
             self._emit(node, "jit-in-loop",

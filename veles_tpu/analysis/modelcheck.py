@@ -1,0 +1,1470 @@
+"""Pass 8 — protocol model checker: exhaustive bounded-interleaving
+exploration of the election / membership / hot-swap planes.
+
+The chaos matrix (tools/chaos.py) kills real processes and checks that
+ONE schedule recovers; the concurrency pass (pass 5) reasons statically
+about locks. This pass closes the gap between them: it runs the REAL
+protocol logic — `ClusterCoordinator`/`ClusterMember` election and
+membership from resilience/cluster.py, the quorum pick, and the
+`WeightWatcher` + `GenerationLedger` hot-swap/rollback plane — inside a
+simulated world (in-memory mirror, virtual clock, synchronous message
+scheduler) and explores MANY schedules: every "which agent acts next"
+choice and every injected fault (dropped beat, stale route, torn meta
+read, lost beacon, crash before/after the coordinator announcement) is
+a branch point in a deterministic choice tree walked DFS up to a depth
+and schedule budget.
+
+What is real and what is simulated
+----------------------------------
+Real (imported, unmodified): `handle_beat`/`handle_join`, the dead
+sweep, gather mode, `_membership_bump`/`_initiate_restart` and
+`quorum_snapshot`, member `step()` (fencing, failover, isolation
+fail-stop), `_seek_coordinator`/`_try_adopt`/`_promote`,
+`_publish_beacon`/`_live_hosts`, `WeightWatcher.poll_once` (scan,
+pinning, deterministic-refusal memory) and `GenerationLedger`
+(commit/rollback/pinning). Simulated (via the seams those classes
+expose — `_mirror`, `_bind_http`, `_bind_coordinator`, `_post`,
+`_spawn`, `_children_status`, `_local_snapshots`, `_resolve_snapshot`,
+`_obtain`, the injected `Clock`): processes, files, sockets and time.
+
+The invariant ledger (checked after every action)
+-------------------------------------------------
+1. mc-term-fence           a member's observed term never decreases,
+                           and no member acts on a directive from a
+                           term below the one it had already seen.
+2. mc-single-coordinator   at most one LIVE bound coordinator per term.
+3. mc-generation-rollback  member generations never decrease, and the
+                           epoch of successive restart picks never
+                           regresses (the PR-10 no-rollback contract).
+4. mc-single-writer        at most one host spawns its children as the
+                           snapshot WRITER per generation.
+5. mc-verified-pick        a quorum pick names a snapshot with at least
+                           one sidecar-verified copy somewhere.
+6. mc-atomic-commit        every (params, label) pair a ring round
+                           reads was published by ONE ledger call.
+7. mc-rollback-pin         a digest that was rolled back FROM is never
+                           watcher-re-applied.
+8. mc-floor-failstop       a fleet below the floor fail-stops at
+                           quiescence instead of wedging or running.
+
+Determinism and reduction
+-------------------------
+A schedule is the sequence of (label, index) choices; replaying the
+same schedule against the same scenario and seed reproduces the run
+bit-for-bit (`random.seed` per run pins the backoff jitter; the
+VirtualClock owns time). Exploration is stateless replay-from-root DFS
+with state-fingerprint convergence pruning (two schedules reaching an
+identical world state explore a pending action only once) and a fault
+BUDGET: at most `max_faults` injected faults per schedule, so the tree
+stays exhaustive *within k concurrent infrastructure faults* rather
+than astronomically wide. Counterexamples serialize as replayable JSON
+schedules (`replay()` re-runs one and returns the violation).
+
+Known blind spots are catalogued in docs/ANALYSIS.md (pass 8): depth/
+fault bounds, name-level (not digest-level) pick verification, and the
+3-fault torn-read + stale-beacon claim-overwrite coincidence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from veles_tpu.analysis.findings import SEV_ERROR, Finding
+from veles_tpu.resilience.clock import VirtualClock
+from veles_tpu.resilience.cluster import (COORD_META, ClusterCoordinator,
+                                          ClusterMember)
+from veles_tpu.serving_gen import GenerationLedger
+from veles_tpu.serving_watch import WeightWatcher
+
+__all__ = ["MUTANTS", "SCENARIOS", "ExploreResult", "Violation",
+           "check_tree", "explore", "findings_from", "quick_check",
+           "replay"]
+
+
+class AgentCrashed(BaseException):
+    """A crash-point fault fired inside an agent's action. BaseException
+    on purpose: the production code's broad `except Exception` nets
+    (best-effort mirror I/O, beacon publishes) must not swallow a
+    simulated host death."""
+
+    def __init__(self, host_id: str) -> None:
+        super().__init__(f"host {host_id} crashed")
+        self.host_id = host_id
+
+
+class Violation(Exception):
+    """One invariant violation; aborts the run that produced it."""
+
+    def __init__(self, rule: str, invariant: int, message: str) -> None:
+        super().__init__(message)
+        self.rule = rule
+        self.invariant = invariant
+        self.message = message
+        self.events: List[Dict[str, Any]] = []
+
+
+class Scheduler:
+    """The choice tree's cursor: replays a recorded prefix, then takes
+    default (index 0) choices while RECORDING every point's label and
+    arity, so the explorer can enumerate siblings. Fault points stop
+    advertising alternatives once the per-run fault budget is spent."""
+
+    def __init__(self, prefix: Sequence[Tuple[str, int]] = (),
+                 max_faults: int = 2) -> None:
+        self.prefix = list(prefix)
+        self.pos = 0
+        self.max_faults = max_faults
+        self.faults_used = 0
+        self.quiescing = False
+        self.diverged = False
+        #: (label, index, advertised_arity, option_label, fingerprint)
+        self.trace: List[tuple] = []
+
+    def choose(self, label: str, options: Sequence[str],
+               fault: bool = False, fp: Optional[str] = None) -> int:
+        n = len(options)
+        if self.quiescing:
+            # deterministic cooldown: no new branch points, take the
+            # fault-free default so quiescence converges
+            return 0
+        if self.pos < len(self.prefix):
+            plabel, pidx = self.prefix[self.pos]
+            if plabel != label:
+                self.diverged = True
+            idx = pidx if 0 <= pidx < n else 0
+            arity = n
+        else:
+            idx = 0
+            arity = n if (not fault
+                          or self.faults_used < self.max_faults) else 1
+        if fault and idx > 0:
+            self.faults_used += 1
+        self.trace.append((label, idx, arity, options[idx], fp))
+        self.pos += 1
+        return idx
+
+
+class SimMirror:
+    """In-memory mirror store implementing the meta/entries subset the
+    protocol uses, with scheduler-controlled faults at exactly the
+    points the real DirMirror can fail: the COORD_META write (crash
+    before/after — kill-before-announce / kill-after-announce), the
+    beacon write (lost — a delayed beacon that stays stale) and every
+    meta read (torn — the hardened `DirMirror.get_meta` degrades a torn
+    record to None after its bounded re-reads)."""
+
+    spec = "sim://"
+
+    def __init__(self, world: "SimWorld") -> None:
+        self.world = world
+        self.metas: Dict[str, Dict[str, Any]] = {}
+
+    def put_meta(self, name: str, record: Dict[str, Any]) -> bool:
+        actor = self.world.current_host()
+        if name == COORD_META:
+            pick = self.world.choice(
+                f"announce:{actor}",
+                ("ok", "crash-before-write", "crash-after-write"),
+                fault=True)
+            if pick == 1:
+                raise AgentCrashed(actor)
+            self.metas[name] = dict(record)
+            if pick == 2:
+                raise AgentCrashed(actor)
+            return True
+        pick = self.world.choice(f"beacon:{actor}", ("ok", "lost"),
+                                 fault=True)
+        if pick == 0:
+            self.metas[name] = dict(record)
+        return True
+
+    def get_meta(self, name: str) -> Optional[Dict[str, Any]]:
+        rec = self.metas.get(name)
+        if rec is None:
+            return None       # absence is deterministic: no branch
+        pick = self.world.choice(
+            f"meta-read:{self.world.current_host()}", ("ok", "torn"),
+            fault=True)
+        if pick == 1:
+            return None
+        return dict(rec)
+
+    def entries(self) -> List[Dict[str, Any]]:
+        return [{"name": n, "digest": s["claimed"], "mtime": s["mtime"]}
+                for n, s in sorted(self.world.mirror_snaps.items())]
+
+    def fetch(self, name: str, dest: str) -> Optional[str]:
+        rec = self.world.mirror_snaps.get(name)
+        if rec is None or rec["claimed"] != rec["true"]:
+            return None       # fetch re-verifies the bytes
+        return name
+
+
+class SimCoordinator(ClusterCoordinator):
+    """The real coordinator bound into the simulated world: no HTTP
+    (peers reach `handle_beat` synchronously through the world's
+    router), the world's mirror, and a pick-event hook so the invariant
+    ledger observes every restart/membership decision."""
+
+    def __init__(self, world: "SimWorld", *args, **kwargs) -> None:
+        self.world = world
+        super().__init__(*args, **kwargs)
+
+    def _bind_http(self):
+        return None
+
+    def _mirror(self):
+        return self.world.mirror
+
+    def _initiate_restart(self, reason, nonfinite=False):
+        super()._initiate_restart(reason, nonfinite=nonfinite)
+        if self.action == "run":
+            self.snapshot = self.world.mutate_pick(self.snapshot)
+            self.world.record_pick(self)
+
+    def _membership_bump(self, reason, admit=None, evict=None):
+        super()._membership_bump(reason, admit=admit, evict=evict)
+        if self.action == "run":
+            self.snapshot = self.world.mutate_pick(self.snapshot)
+            self.world.record_pick(self)
+
+
+class NoFloorStopCoordinator(SimCoordinator):
+    """Seeded mutant (invariant 8): the membership-bump floor guard is
+    gone, so a coordinator promoted over a sub-floor live view resumes
+    the job instead of fail-stopping."""
+
+    def _membership_bump(self, reason, admit=None, evict=None):
+        keep = self.floor
+        self.floor = 1
+        try:
+            super()._membership_bump(reason, admit=admit, evict=evict)
+        finally:
+            self.floor = keep
+
+
+class SimMember(ClusterMember):
+    """The real member agent over simulated children / mirror /
+    transport. Only the process- and I/O-facing seams are overridden;
+    the beat loop, fencing, failover, election and promotion logic is
+    the shipped code."""
+
+    def __init__(self, world: "SimWorld", **kwargs) -> None:
+        self.world = world
+        self.sim_child: Optional[str] = None   # running|failed|done|dead
+        self.sim_epoch = -1
+        self.sim_local: Dict[str, Dict[str, Any]] = {}
+        self._mc_rx: Optional[Tuple[int, int]] = None
+        super().__init__([["true"]], clock=world.clock, mirror="sim://",
+                         **kwargs)
+
+    # -- simulated child set --------------------------------------------------
+
+    def _sim_writer(self) -> bool:
+        # the real `_spawn` env contract: the host homed to its own
+        # embedded coordinator drops the VELES_SNAPSHOT_DRY_RUN pin, a
+        # host whose embedded coordinator was deposed re-pins, and a
+        # coordinator-less host keeps whatever its launch env says
+        if self._is_writer():
+            return True
+        if self.coordinator is not None:
+            return False
+        return "VELES_SNAPSHOT_DRY_RUN" not in self.env
+
+    def _spawn(self, run_dir, snapshot):
+        self._respawns += 1
+        self._procs = [object()]          # truthy: step() probes status
+        self.sim_child = "running"
+        self.sim_epoch = (self.world.snap_epochs.get(snapshot, 0)
+                          if snapshot else 0)
+        self.world.record_spawn(self, snapshot, self._sim_writer())
+
+    def _children_status(self):
+        if self.sim_child == "failed":
+            return "failed", [1]
+        if self.sim_child == "done":
+            return "done", [0]
+        if self.sim_child == "dead":
+            return "failed", [-15]
+        return "running", [None]
+
+    def _kill_children(self):
+        if self.sim_child == "running":
+            self.sim_child = "dead"
+
+    def _child_payload(self):
+        return {"epoch": self.sim_epoch}
+
+    def _plan(self):
+        return None
+
+    # -- simulated snapshot store ---------------------------------------------
+
+    def _local_snapshots(self):
+        out = []
+        for name, s in sorted(self.sim_local.items()):
+            if s["claimed"] != s["true"]:
+                continue      # the sidecar re-hash fails: no vote
+            out.append({"name": name, "digest": s["claimed"],
+                        "mtime": s["mtime"]})
+        return out
+
+    def _resolve_snapshot(self, name):
+        if name:
+            loc = self.sim_local.get(name)
+            if loc is not None and loc["claimed"] == loc["true"]:
+                return name
+            rec = self.world.mirror_snaps.get(name)
+            if rec is not None and rec["claimed"] == rec["true"]:
+                self.sim_local[name] = dict(rec)   # mirror restore
+                return name
+            if rec is not None:
+                self._bad_mirror.add(name)   # fetch re-verify failed
+        best = None
+        for n, s in sorted(self.sim_local.items()):
+            if s["claimed"] == s["true"] \
+                    and (best is None or s["mtime"] > best[1]):
+                best = (n, s["mtime"])
+        if best is None:
+            for n, rec in sorted(self.world.mirror_snaps.items()):
+                if n in self._bad_mirror \
+                        or rec["claimed"] != rec["true"]:
+                    continue
+                if best is None or rec["mtime"] > best[1]:
+                    best = (n, rec["mtime"])
+        return best[0] if best else None
+
+    # -- simulated transport / control plane ----------------------------------
+
+    def _mirror(self):
+        return self.world.mirror
+
+    def _post(self, path, report):
+        return self.world.deliver(self, path, report)
+
+    def _beat(self, status, codes):
+        self._mc_rx = None
+        d = super()._beat(status, codes)
+        if d is not None:
+            self._mc_rx = (int(d.get("term", 0) or 0), self.term)
+        return d
+
+    def _join_cluster(self, status, codes):
+        self._mc_rx = None
+        d = super()._join_cluster(status, codes)
+        if d is not None:
+            self._mc_rx = (int(d.get("term", 0) or 0), self.term)
+        return d
+
+    def _bind_coordinator(self, term, members):
+        coord = self.world.coord_cls(
+            self.world, self.floor, host=self.advertise,
+            port=self.world.next_port(), token=None,
+            dead_after=self.dead_after, max_restarts=self.max_restarts,
+            members=members, mirror="sim://", term=term,
+            coord_id=self.host_id, advertise=self.advertise,
+            gather=True, clock=self._clock,
+            join_grace=self.dead_after * 2)
+        coord.start()
+        self.world.register_coordinator(coord)
+        return coord
+
+    def _finish(self, code, outcome, dead_hosts=None):
+        self.world.record_finish(self, code, outcome)
+        if self.coordinator is not None:
+            self.coordinator.stop()
+        return code
+
+
+# -- seeded member mutants ----------------------------------------------------
+
+class NoFenceMember(SimMember):
+    """Seeded mutant (invariant 1): the directive term fence is gone —
+    the member treats a stale coordinator's directive as current (the
+    term is rewritten up before step() compares it; the original term
+    stays on the ledger wire so the violation is observable)."""
+
+    def _beat(self, status, codes):
+        d = super()._beat(status, codes)
+        if d is not None:
+            d = dict(d)
+            d["term"] = max(int(d.get("term", 0) or 0), self.term)
+        return d
+
+
+class DoubleCoordinatorMember(SimMember):
+    """Seeded mutant (invariant 2): the election plane rots — deaf to
+    announcements, a solipsist liveness view, and a term counter that
+    saturates at 2 — so two hosts can each bind a coordinator at the
+    SAME term."""
+
+    def _try_adopt(self, ann):
+        return False
+
+    def _live_hosts(self, mirror):
+        return [self.host_id]
+
+    def _bind_coordinator(self, term, members):
+        return super()._bind_coordinator(min(term, 2), members)
+
+
+class AllWritersMember(SimMember):
+    """Seeded mutant (invariant 4): the single-writer dry-run pin is
+    dropped — every host spawns its children as the snapshot writer."""
+
+    def _sim_writer(self):
+        return True
+
+
+class UnverifiedVotesMember(SimMember):
+    """Seeded mutant (invariant 5): local snapshot reports skip the
+    sidecar re-hash, so a rotted local copy votes its CLAIMED digest
+    into the quorum."""
+
+    def _local_snapshots(self):
+        return [{"name": name, "digest": s["claimed"],
+                 "mtime": s["mtime"]}
+                for name, s in sorted(self.sim_local.items())]
+
+
+class NoBeaconTermMember(SimMember):
+    """Regression mutant (invariant 2): reverts the beacon-term claim
+    fence this checker's partition scenario motivated — the claim
+    target ignores terms carried on peer beacons, so a candidate whose
+    announcement reads are lossy re-claims a term that is already
+    live-bound."""
+
+    def _live_hosts(self, mirror):
+        live = super()._live_hosts(mirror)
+        self._beacon_term = 0
+        return live
+
+
+class NoWriterRepinMember(SimMember):
+    """Regression mutant (invariant 4): reverts the writer re-pin —
+    any host embedding a coordinator object spawns as the snapshot
+    writer, even after re-homing to a successor control plane."""
+
+    def _sim_writer(self):
+        return (self.coordinator is not None
+                or "VELES_SNAPSHOT_DRY_RUN" not in self.env)
+
+
+class HostAgent:
+    """One schedulable host: the member plus its crash/exit state."""
+
+    def __init__(self, member: SimMember) -> None:
+        self.member = member
+        self.exit_code: Optional[int] = None
+        self.crashed = False
+        self.steps = 0
+        self.prev_term = member.term
+        self.prev_gen = member.generation
+
+    @property
+    def live(self) -> bool:
+        return not self.crashed and self.exit_code is None
+
+
+class SimWorld:
+    """Base world: scheduler plumbing, the router (synchronous
+    transport), the event/invariant ledger and the explore loop's
+    run/quiesce/final hooks. Scenario builders subclass or configure."""
+
+    scenario = "base"
+
+    def __init__(self, sched: Scheduler, mutant: Optional[str] = None
+                 ) -> None:
+        self.sched = sched
+        self.mutant = mutant
+        self.clock = VirtualClock()
+        self.mirror = SimMirror(self)
+        #: ground truth snapshot stores: name -> {claimed, true, mtime}
+        self.mirror_snaps: Dict[str, Dict[str, Any]] = {}
+        self.snap_epochs: Dict[str, int] = {}
+        self.agents: Dict[str, HostAgent] = {}
+        self.router: Dict[Tuple[str, int], SimCoordinator] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.writer_by_gen: Dict[int, str] = {}
+        self.max_picked_epoch = -1
+        self.used: set = set()
+        self.floor = 1
+        self.stale_route = False
+        #: True while a scenario builds its PREBUILT start state: every
+        #: choice takes the fault-free default and records nothing —
+        #: faults belong to scheduled actions, not to world seeding
+        self.seeding = False
+        self._actor: List[str] = ["boot"]
+        self._ports = iter(range(9000, 9900))
+        self.coord_cls: Callable = (
+            NoFloorStopCoordinator if mutant == "no_floor_stop"
+            else SimCoordinator)
+        self.member_cls: Callable = {
+            "no_term_fence": NoFenceMember,
+            "double_coordinator": DoubleCoordinatorMember,
+            "all_writers": AllWritersMember,
+            "unverified_votes": UnverifiedVotesMember,
+            "no_beacon_term": NoBeaconTermMember,
+            "no_writer_repin": NoWriterRepinMember,
+        }.get(mutant or "", SimMember)
+
+    # -- plumbing -------------------------------------------------------------
+
+    def choice(self, label: str, options: Sequence[str],
+               fault: bool = False, fp: Optional[str] = None) -> int:
+        if self.seeding:
+            return 0
+        return self.sched.choose(label, options, fault=fault, fp=fp)
+
+    def current_host(self) -> str:
+        return self._actor[-1]
+
+    def next_port(self) -> int:
+        return next(self._ports)
+
+    def register_coordinator(self, coord: SimCoordinator) -> None:
+        self.router[(coord.advertise or coord.host, coord.port)] = coord
+        self.events.append({"ev": "bind", "coord": coord.coord_id,
+                            "term": coord.term,
+                            "generation": coord.generation})
+
+    def deregister_host(self, host_id: str) -> None:
+        for addr in [a for a, c in self.router.items()
+                     if c.coord_id == host_id]:
+            del self.router[addr]
+
+    def kill_host(self, host_id: str) -> None:
+        agent = self.agents.get(host_id)
+        if agent is not None:
+            agent.crashed = True
+        self.deregister_host(host_id)
+        self.events.append({"ev": "crash", "host": host_id})
+
+    def add_snap(self, name: str, epoch: int, mtime: float,
+                 rotted: bool = False, on_mirror: bool = True,
+                 hosts: Sequence[str] = ()) -> None:
+        digest = f"d-{name}"
+        rec = {"claimed": digest,
+               "true": digest if not rotted else f"rot-{name}",
+               "mtime": mtime}
+        self.snap_epochs[name] = epoch
+        if on_mirror:
+            self.mirror_snaps[name] = dict(rec)
+        for hid in hosts:
+            self.agents[hid].member.sim_local[name] = dict(rec)
+
+    # -- transport ------------------------------------------------------------
+
+    def deliver(self, member: SimMember, path: str,
+                report: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        coord = self.router.get((member.coord_host, member.coord_port))
+        if coord is None:
+            return None       # connection refused: deterministic
+        options = ["deliver", "drop"]
+        stale = None
+        if self.stale_route:
+            stale = [c for c in set(self.router.values())
+                     if c.term < coord.term]
+            if stale:
+                options.append("stale-route")
+        pick = self.choice(f"net:{member.host_id}", tuple(options),
+                           fault=True)
+        if options[pick] == "drop":
+            return None
+        if options[pick] == "stale-route":
+            # a stale VIP/DNS entry routes the beat to a deposed
+            # incumbent and returns ITS directive — exactly what the
+            # member-side term fence exists to reject
+            coord = min(stale, key=lambda c: (c.term, c.coord_id))
+        self._actor.append(coord.coord_id)
+        try:
+            handle = (coord.handle_join if path == "/join"
+                      else coord.handle_beat)
+            return handle(dict(report))
+        except AgentCrashed as c:
+            self.kill_host(c.host_id)
+            return None       # the connection died mid-request
+        finally:
+            self._actor.pop()
+
+    # -- the invariant ledger -------------------------------------------------
+
+    def _verified_copy_exists(self, name: str) -> bool:
+        rec = self.mirror_snaps.get(name)
+        if rec is not None and rec["claimed"] == rec["true"]:
+            return True
+        for agent in self.agents.values():
+            s = agent.member.sim_local.get(name)
+            if s is not None and s["claimed"] == s["true"]:
+                return True
+        return False
+
+    def record_pick(self, coord: SimCoordinator) -> None:
+        name = coord.snapshot
+        epoch = self.snap_epochs.get(name) if name else None
+        self.events.append({"ev": "pick", "coord": coord.coord_id,
+                            "term": coord.term,
+                            "generation": coord.generation,
+                            "snapshot": name, "epoch": epoch})
+        if name is None:
+            return   # scratch pick: nothing to verify (blind spot:
+            # a scratch pick after progress is quorum-sanctioned)
+        if not self._verified_copy_exists(name):
+            raise Violation(
+                "mc-verified-pick", 5,
+                f"coordinator {coord.coord_id} (term {coord.term}) "
+                f"picked {name} for generation {coord.generation} but "
+                f"no sidecar-verified copy of it exists anywhere")
+        if epoch is not None:
+            if epoch < self.max_picked_epoch:
+                raise Violation(
+                    "mc-generation-rollback", 3,
+                    f"restart pick {name} (epoch {epoch}) regresses "
+                    f"past an earlier pick at epoch "
+                    f"{self.max_picked_epoch}")
+            self.max_picked_epoch = epoch
+
+    def record_spawn(self, member: SimMember, snapshot: Optional[str],
+                     writer: bool) -> None:
+        self.events.append({"ev": "spawn", "host": member.host_id,
+                            "generation": member.generation,
+                            "term": member.term, "snapshot": snapshot,
+                            "writer": writer,
+                            "epoch": member.sim_epoch})
+        rx = member._mc_rx
+        if rx is not None and rx[0] and rx[0] < rx[1]:
+            raise Violation(
+                "mc-term-fence", 1,
+                f"host {member.host_id} spawned generation "
+                f"{member.generation} on a directive from stale term "
+                f"{rx[0]} (the member had already seen term {rx[1]})")
+        if writer:
+            prev = self.writer_by_gen.get(member.generation)
+            if prev is not None and prev != member.host_id:
+                raise Violation(
+                    "mc-single-writer", 4,
+                    f"hosts {prev} and {member.host_id} both spawned "
+                    f"as the snapshot writer for generation "
+                    f"{member.generation}")
+            self.writer_by_gen[member.generation] = member.host_id
+
+    def record_finish(self, member: SimMember, code: int,
+                      outcome: str) -> None:
+        self.events.append({"ev": "finish", "host": member.host_id,
+                            "code": code, "term": member.term,
+                            "outcome": outcome[:80]})
+        rx = member._mc_rx
+        if rx is not None and rx[0] and rx[0] < rx[1]:
+            raise Violation(
+                "mc-term-fence", 1,
+                f"host {member.host_id} exited ({code}) on a terminal "
+                f"directive from stale term {rx[0]} (the member had "
+                f"already seen term {rx[1]})")
+
+    def check_state(self) -> None:
+        for agent in self.agents.values():
+            m = agent.member
+            if m.term < agent.prev_term:
+                raise Violation(
+                    "mc-term-fence", 1,
+                    f"host {m.host_id} observed term went backwards: "
+                    f"{agent.prev_term} -> {m.term}")
+            if m.generation < agent.prev_gen:
+                raise Violation(
+                    "mc-generation-rollback", 3,
+                    f"host {m.host_id} generation went backwards: "
+                    f"{agent.prev_gen} -> {m.generation}")
+            agent.prev_term, agent.prev_gen = m.term, m.generation
+        by_term: Dict[int, set] = {}
+        for coord in set(self.router.values()):
+            by_term.setdefault(coord.term, set()).add(coord.coord_id)
+        for term, ids in by_term.items():
+            if len(ids) > 1:
+                raise Violation(
+                    "mc-single-coordinator", 2,
+                    f"two live coordinators bound at term {term}: "
+                    f"hosts {sorted(ids)}")
+
+    # -- scenario hooks -------------------------------------------------------
+
+    def start(self) -> None:
+        pass
+
+    def enabled_actions(self) -> List[Tuple[str, Callable[[], None]]]:
+        raise NotImplementedError
+
+    def fingerprint(self) -> str:
+        raise NotImplementedError
+
+    def run(self, max_actions: int) -> None:
+        self._actor = [next(iter(self.agents), "boot")]
+        try:
+            self.start()
+        except AgentCrashed as c:
+            self.kill_host(c.host_id)
+        self.check_state()
+        for _ in range(max_actions):
+            acts = self.enabled_actions()
+            if not acts:
+                break
+            idx = self.choice("act", tuple(a[0] for a in acts),
+                              fp=self.fingerprint())
+            self.perform(acts[idx])
+            self.check_state()
+        self.quiesce()
+        self.check_final()
+
+    def perform(self, act: Tuple[str, Callable[[], None]]) -> None:
+        self.events.append({"ev": "act", "act": act[0]})
+        act[1]()
+
+    def step_agent(self, agent: HostAgent) -> None:
+        agent.steps += 1
+        self._actor.append(agent.member.host_id)
+        try:
+            code = agent.member.step("sim")
+            if code is not None:
+                agent.exit_code = code
+                self.deregister_host(agent.member.host_id)
+        except AgentCrashed as c:
+            self.kill_host(c.host_id)
+        finally:
+            self._actor.pop()
+
+    def quiesce(self, rounds: int = 60) -> None:
+        """Bounded cooldown past every protocol timeout: all agents
+        step under a fault-free deterministic scheduler, so fail-stop
+        paths (isolation, below-floor sweeps, drains) get to finish."""
+        self.sched.quiescing = True
+        for _ in range(rounds):
+            live = [a for a in self.agents.values() if a.live]
+            if not live:
+                break
+            for agent in live:
+                if agent.live:
+                    self.step_agent(agent)
+            self.check_state()
+
+    def check_final(self) -> None:
+        alive = [a for a in self.agents.values() if not a.crashed]
+        if len(alive) < self.floor:
+            for a in alive:
+                if a.exit_code is None:
+                    raise Violation(
+                        "mc-floor-failstop", 8,
+                        f"only {len(alive)} host(s) survive (< floor "
+                        f"{self.floor}) but host "
+                        f"{a.member.host_id} is still running at "
+                        f"quiescence instead of fail-stopping")
+
+
+class ClusterWorld(SimWorld):
+    """The election / membership / partition planes: N member hosts
+    (host 0 embeds the boot coordinator), a shared SimMirror, and
+    schedulable crash / child-failure / training actions."""
+
+    def __init__(self, sched: Scheduler, mutant: Optional[str],
+                 *, hosts: int = 3, floor: int = 3, join_host: bool =
+                 False, beat_s: float = 1.0, dead_after: float = 6.0,
+                 coord_timeout: float = 24.0, trains: int = 2,
+                 crashes: Sequence[str] = (), fails: Sequence[str] = ()
+                 ) -> None:
+        super().__init__(sched, mutant)
+        self.floor = floor
+        self.beat_s = beat_s
+        self.dead_after = dead_after
+        self.coord_timeout = coord_timeout
+        self.trains_left = trains
+        self.crashable = list(crashes)
+        self.failable = list(fails)
+        self.boot_port = self.next_port()
+        self.boot_coord = self.coord_cls(
+            self, floor, host="h0", port=self.boot_port, token=None,
+            dead_after=dead_after, max_restarts=3,
+            members=[str(i) for i in range(hosts)], mirror="sim://",
+            term=1, coord_id="0", advertise="h0", gather=False,
+            clock=self.clock)
+        for i in range(hosts):
+            hid = str(i)
+            env = {} if i == 0 else {"VELES_SNAPSHOT_DRY_RUN": "1"}
+            member = self.member_cls(
+                self, host_id=hid, coordinator_addr=f"h0:"
+                f"{self.boot_port}",
+                coordinator=self.boot_coord if i == 0 else None,
+                env=env, floor=floor, beat_s=beat_s,
+                dead_after=dead_after, coord_timeout=coord_timeout,
+                max_restarts=3, advertise=f"h{hid}")
+            self.agents[hid] = HostAgent(member)
+        if join_host:
+            hid = str(hosts)
+            member = self.member_cls(
+                self, host_id=hid,
+                coordinator_addr=f"h0:{self.boot_port}",
+                env={"VELES_SNAPSHOT_DRY_RUN": "1"}, floor=floor,
+                beat_s=beat_s, dead_after=dead_after,
+                coord_timeout=coord_timeout, max_restarts=3,
+                join=True, advertise=f"h{hid}")
+            self.agents[hid] = HostAgent(member)
+
+    def mutate_pick(self, snapshot: Optional[str]) -> Optional[str]:
+        if self.mutant == "oldest_pick" and self.mirror_snaps:
+            # seeded bug (invariant 3): the pick sorts the wrong way
+            return min(self.mirror_snaps,
+                       key=lambda n: self.mirror_snaps[n]["mtime"])
+        return snapshot
+
+    def start(self) -> None:
+        self._actor = ["0"]
+        self.boot_coord.start()       # announces through the mirror
+        self.register_coordinator(self.boot_coord)
+
+    def enabled_actions(self):
+        acts: List[Tuple[str, Callable[[], None]]] = []
+        live = [a for a in self.agents.values() if a.live]
+        # round-robin default: the least-stepped live host acts first,
+        # so the all-defaults schedule is the fair healthy run and
+        # every sibling branch perturbs it at one point
+        for agent in sorted(live, key=lambda a: (a.steps,
+                                                 a.member.host_id)):
+            acts.append((f"step:h{agent.member.host_id}",
+                         lambda a=agent: self.step_agent(a)))
+        for agent in live:
+            m = agent.member
+            if m.sim_child == "running" and self.trains_left > 0 \
+                    and m._sim_writer():
+                acts.append((f"train:h{m.host_id}",
+                             lambda a=agent: self._train(a)))
+        for hid in self.failable:
+            agent = self.agents.get(hid)
+            if agent is not None and agent.live \
+                    and agent.member.sim_child == "running" \
+                    and f"fail:{hid}" not in self.used:
+                acts.append((f"fail:h{hid}",
+                             lambda h=hid: self._fail_children(h)))
+        for hid in self.crashable:
+            agent = self.agents.get(hid)
+            if agent is not None and agent.live \
+                    and f"crash:{hid}" not in self.used:
+                acts.append((f"crash:h{hid}",
+                             lambda h=hid: self._crash(h)))
+        return acts
+
+    def _train(self, agent: HostAgent) -> None:
+        m = agent.member
+        self.trains_left -= 1
+        m.sim_epoch = max(m.sim_epoch, 0) + 1
+        self.clock.advance(0.25)
+        name = f"snap_h{m.host_id}_{m.sim_epoch:03d}.pickle"
+        self.add_snap(name, epoch=m.sim_epoch,
+                      mtime=self.clock.time(), hosts=(m.host_id,))
+
+    def _fail_children(self, hid: str) -> None:
+        self.used.add(f"fail:{hid}")
+        self.agents[hid].member.sim_child = "failed"
+
+    def _crash(self, hid: str) -> None:
+        self.used.add(f"crash:{hid}")
+        self.kill_host(hid)
+
+    def fingerprint(self) -> str:
+        st: Dict[str, Any] = {
+            "t": round(self.clock.monotonic(), 4),
+            "faults": self.sched.faults_used,
+            "used": sorted(self.used),
+            "trains": self.trains_left,
+            "metas": self.mirror.metas,
+            "snaps": sorted(self.mirror_snaps),
+            "picked": self.max_picked_epoch,
+            "writers": sorted(self.writer_by_gen.items()),
+        }
+        st["agents"] = [
+            [a.member.host_id, a.member.term, a.member.generation,
+             a.exit_code, a.crashed, a.steps, a.member.sim_child,
+             a.member.sim_epoch, a.member._join_pending,
+             a.member._reconnect_streak, a.member._killed_gen,
+             round(a.member._last_contact, 4),
+             a.member._beats_sent, a.member._respawns,
+             a.member._beacon_term,
+             sorted(a.member._stale_terms_seen),
+             list(a.member._adopted), sorted(a.member._bad_mirror),
+             sorted(a.member.sim_local)]
+            for a in self.agents.values()]
+        st["coords"] = sorted(
+            [[c.coord_id, c.term, c.generation, c.action, c.restarts,
+              c._gather, round(c._gather_deadline, 4), c._best_epoch,
+              c._stagnant, c._superseded, sorted(c._acked),
+              sorted(c.dead_hosts), sorted(c.members),
+              sorted((hid, round(h["last_beat"], 4),
+                      str(h["report"].get("status")),
+                      int(h["report"].get("generation", 0) or 0))
+                     for hid, h in c._hosts.items())]
+             for c in set(self.router.values())])
+        blob = json.dumps(st, sort_keys=True, default=str)
+        return hashlib.md5(blob.encode()).hexdigest()
+
+
+class PartitionWorld(ClusterWorld):
+    """A legal mid-protocol start state: the fleet is already split —
+    C1 (term 1, the pre-partition incumbent, two generations ahead on
+    its island) still steers hosts 0 and 2, while host 1 was re-elected
+    away and runs under its own C2 (term 2). The stale-route fault can
+    deliver one of C1's directives to host 1; the member term fence is
+    what must reject it."""
+
+    def __init__(self, sched: Scheduler, mutant: Optional[str]) -> None:
+        super().__init__(sched, mutant, hosts=3, floor=3, trains=0)
+        self.stale_route = True
+        now = self.clock.time()
+        self.add_snap("snap_001.pickle", epoch=1, mtime=now - 100.0)
+        self.add_snap("snap_002.pickle", epoch=2, mtime=now - 50.0,
+                      hosts=("0",))
+        self.max_picked_epoch = 2     # the fleet resumed from e2
+
+    def start(self) -> None:
+        self._actor = ["0"]
+        self.seeding = True
+        c1, clock = self.boot_coord, self.clock
+        c1.start()
+        self.register_coordinator(c1)
+        c1.generation, c1.restarts = 8, 2
+        c1.snapshot = "snap_002.pickle"
+        # host 1's island: a promoted C2 at term 2, gathered at gen 7
+        h1 = self.agents["1"].member
+        c2 = self.coord_cls(
+            self, self.floor, host="h1", port=self.next_port(),
+            token=None, dead_after=self.dead_after, max_restarts=3,
+            members=["1", "2"], mirror="sim://", term=2, coord_id="1",
+            advertise="h1", gather=False, clock=clock,
+            join_grace=self.dead_after * 2)
+        c2.start()
+        self.register_coordinator(c2)
+        c2.generation, c2.snapshot = 7, "snap_002.pickle"
+        h1.coordinator = c2
+        h1.coord_host, h1.coord_port = "h1", c2.port
+        h1.term, h1.generation = 2, 7
+        h1._adopted = (2, f"h1:{c2.port}")
+        h1.sim_child, h1.sim_epoch = "running", 2
+        h1.env.pop("VELES_SNAPSHOT_DRY_RUN", None)   # h1 is C2's writer
+        for hid, gen in (("0", 8), ("2", 8)):
+            m = self.agents[hid].member
+            m.generation, m.sim_child, m.sim_epoch = gen, "running", 2
+        rep = {h: self.agents[h].member._report("running", [None])
+               for h in ("0", "1", "2")}
+        mono = clock.monotonic()
+        c1._hosts = {h: {"last_beat": mono, "report": dict(rep[h])}
+                     for h in ("0", "1", "2")}
+        c2._hosts = {"1": {"last_beat": mono, "report": dict(rep["1"])}}
+        for h in ("0", "1", "2"):
+            self.agents[h].member._publish_beacon()
+        self.seeding = False
+        self.check_state()
+
+
+class SimServer:
+    """The serving tier's hot-swap surface as the watcher sees it,
+    owning a REAL GenerationLedger: `swap_params` validation outcomes
+    are scheduler choices (the jax-side checks are out of model), the
+    commit/rollback/pinning state machine is the shipped code."""
+
+    def __init__(self, world: "HotSwapWorld",
+                 ledger: GenerationLedger) -> None:
+        self.world = world
+        self.ledger = ledger
+        ledger.boot("d-boot", ("P", "d-boot"))
+        self.n_swap_refusals = 0
+
+    @property
+    def rolled_back(self):
+        return self.ledger.rolled_back
+
+    def generation(self):
+        return self.ledger.snapshot()
+
+    def note_swap_refused(self, reason: str, msg: str = "") -> None:
+        self.n_swap_refusals += 1
+
+    def swap_params(self, wf, digest=None, source="watcher"):
+        from veles_tpu.serving import SwapRefused
+        pick = self.world.choice(
+            f"validate:{digest}", ("ok", "nonfinite", "device_put"),
+            fault=True)
+        if pick == 1:   # deterministic: content is bad, digest pinned
+            raise SwapRefused("nonfinite",
+                              f"{digest} probe went non-finite")
+        if pick == 2:   # transient: retried on a later poll
+            raise SwapRefused("device_put",
+                              f"{digest} device placement failed")
+        gen = self._commit(digest, source)
+        self.world.record_apply(str(digest), source)
+        return gen
+
+    def _commit(self, digest, source):
+        return self.ledger.commit(str(digest), source, ("P",
+                                                        str(digest)))
+
+    def rollback(self):
+        gen, outgoing = self.ledger.rollback()
+        self.world.gt_rolled_back.add(str(outgoing["digest"]))
+        self.world.events.append({"ev": "rollback",
+                                  "from": outgoing["digest"],
+                                  "to": gen["digest"]})
+        return gen
+
+
+class SplitCommitServer(SimServer):
+    """Seeded mutant (invariant 6): the swap commit is torn in two —
+    the params handle flips immediately, the generation label lands
+    only when a separate `finish-commit` action fires, so a ring round
+    scheduled in between reads a pair no single call published."""
+
+    def __init__(self, world, ledger):
+        super().__init__(world, ledger)
+        self.pending: Optional[Tuple[str, str]] = None
+
+    def _commit(self, digest, source):
+        self.ledger.params = ("P", str(digest))
+        self.pending = (str(digest), source)
+        return dict(self.ledger.generation)
+
+    def finish_commit(self) -> None:
+        digest, source = self.pending
+        self.pending = None
+        self.ledger.prev_gen = dict(self.ledger.generation)
+        self.ledger.generation = {
+            "digest": digest, "since": self.world.clock.time(),
+            "source": source}
+        self.ledger.n_swaps += 1
+
+
+class PinlessLedger(GenerationLedger):
+    """Seeded mutant (invariant 7): rollback forgets to pin the digest
+    it rolled back from, so the watcher re-applies it one poll later."""
+
+    def rollback(self):
+        out = super().rollback()
+        self.rolled_back.clear()
+        return out
+
+
+class SimWatcher(WeightWatcher):
+    """The real watcher over the simulated obtain: fetch/verify/import
+    outcomes are scheduler choices; the scan, pinning and
+    deterministic-refusal protocol above them is the shipped code."""
+
+    def __init__(self, world: "HotSwapWorld", server: SimServer) -> None:
+        self.world = world
+        super().__init__(server, world.mirror, poll_s=1.0,
+                         tmp_dir="sim")
+
+    def _obtain(self, name, digest):
+        pick = self.world.choice(
+            f"obtain:{name}", ("ok", "fetch-failed", "import-failed"),
+            fault=True)
+        if pick == 1:
+            self._refuse("fetch_failed", digest,
+                         f"mirror could not deliver {name}")
+            return None
+        if pick == 2:
+            self._refuse("import_failed", digest,
+                         f"snapshot import of {name} failed")
+            return None
+        return ("wf", digest)
+
+
+class HotSwapWorld(SimWorld):
+    """The train→serve plane: a trainer pushing digest-addressed
+    snapshots, the watcher polling, an operator who may roll back, and
+    the serving ring reading its (params, generation) pair once per
+    round — the read the commit must be atomic against."""
+
+    def __init__(self, sched: Scheduler, mutant: Optional[str]) -> None:
+        super().__init__(sched, mutant)
+        ledger_cls = (PinlessLedger if mutant == "no_rollback_pin"
+                      else GenerationLedger)
+        server_cls = (SplitCommitServer if mutant == "split_commit"
+                      else SimServer)
+        self.server = server_cls(self, ledger_cls(clock=self.clock))
+        self.watcher = SimWatcher(self, self.server)
+        self.gt_rolled_back: set = set()
+        self.pushes_left = 3
+        self.rollbacks_left = 2
+        self.rounds_left = 4
+        self.polls = 0
+
+    def enabled_actions(self):
+        acts: List[Tuple[str, Callable[[], None]]] = [
+            ("poll", self._poll)]
+        if self.rounds_left > 0:
+            acts.append(("round", self._round))
+        if self.pushes_left > 0:
+            acts.append(("push", self._push))
+        if self.rollbacks_left > 0 \
+                and self.server.ledger.prev_params is not None:
+            acts.append(("rollback", self._rollback))
+        pending = getattr(self.server, "pending", None)
+        if pending is not None:
+            acts.append(("finish-commit", self.server.finish_commit))
+        return acts
+
+    def _poll(self) -> None:
+        self.polls += 1
+        self.clock.advance(1.0)
+        self.watcher.poll_once()
+
+    def _push(self) -> None:
+        self.pushes_left -= 1
+        k = 3 - self.pushes_left
+        self.clock.advance(1.0)
+        self.add_snap(f"hot_{k:03d}.pickle", epoch=k,
+                      mtime=self.clock.time())
+
+    def _rollback(self) -> None:
+        self.rollbacks_left -= 1
+        self.server.rollback()
+
+    def _round(self) -> None:
+        self.rounds_left -= 1
+        self._check_pair("a ring round")
+
+    def _check_pair(self, where: str) -> None:
+        led = self.server.ledger
+        params, gen = led.params, dict(led.generation)
+        if params != ("P", str(gen["digest"])):
+            raise Violation(
+                "mc-atomic-commit", 6,
+                f"{where} read params handle {params!r} against "
+                f"generation label {gen['digest']!r} — a pair no "
+                f"single ledger call published")
+
+    def record_apply(self, digest: str, source: str) -> None:
+        self.events.append({"ev": "apply", "digest": digest,
+                            "source": source})
+        if source == "watcher" and digest in self.gt_rolled_back:
+            raise Violation(
+                "mc-rollback-pin", 7,
+                f"the watcher re-applied {digest} after the operator "
+                f"rolled back from it — the rollback pin is gone")
+
+    def check_state(self) -> None:
+        pass              # the plane has no term/generation agents
+
+    def quiesce(self, rounds: int = 4) -> None:
+        self.sched.quiescing = True
+        for _ in range(rounds):
+            self._poll()
+
+    def check_final(self) -> None:
+        self._check_pair("quiescence")
+
+    def fingerprint(self) -> str:
+        led = self.server.ledger
+        st = {
+            "gen": led.generation["digest"], "params": led.params,
+            "prev": (led.prev_gen or {}).get("digest"),
+            "swaps": led.n_swaps, "pins": sorted(led.rolled_back),
+            "gt": sorted(self.gt_rolled_back),
+            "pushes": self.pushes_left, "rb": self.rollbacks_left,
+            "rounds": self.rounds_left, "polls": self.polls,
+            "snaps": sorted(self.mirror_snaps),
+            "refused": sorted(self.watcher._refused_digests),
+            "streak": self.watcher._streak,
+            "pending": getattr(self.server, "pending", None),
+            "faults": self.sched.faults_used,
+        }
+        blob = json.dumps(st, sort_keys=True, default=str)
+        return hashlib.md5(blob.encode()).hexdigest()
+
+
+# -- scenario / mutant registries ---------------------------------------------
+
+@dataclass
+class Scenario:
+    name: str
+    build: Callable[[Scheduler, Optional[str]], SimWorld]
+    max_actions: int
+    description: str
+
+
+SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
+    Scenario(
+        "election",
+        lambda sched, mutant: ClusterWorld(
+            sched, mutant, crashes=("0", "1"), fails=("2",)),
+        14,
+        "3-host boot fleet; coordinator-host and peer crashes force "
+        "re-elections, a child failure forces a quorum restart"),
+    Scenario(
+        "membership",
+        lambda sched, mutant: _build_membership(sched, mutant),
+        14,
+        "3-host fleet + one joining host; child failures, a peer "
+        "crash and trainer snapshots drive admission / eviction / "
+        "quorum-pick bumps"),
+    Scenario(
+        "partition",
+        lambda sched, mutant: PartitionWorld(sched, mutant),
+        10,
+        "already-split fleet: a deposed term-1 incumbent still steers "
+        "two hosts while host 1 runs under its term-2 successor; "
+        "stale routes probe the member term fence"),
+    Scenario(
+        "hotswap",
+        lambda sched, mutant: HotSwapWorld(sched, mutant),
+        10,
+        "trainer pushes, watcher polls, operator rollbacks and ring "
+        "rounds interleave against the real GenerationLedger"),
+)}
+
+
+def _build_membership(sched: Scheduler,
+                      mutant: Optional[str]) -> ClusterWorld:
+    world = ClusterWorld(sched, mutant, hosts=3, floor=3,
+                         join_host=True, crashes=("2",),
+                         fails=("1", "2"), trains=2)
+    now = world.clock.time()
+    world.add_snap("snap_001.pickle", epoch=1, mtime=now - 100.0)
+    world.add_snap("snap_002.pickle", epoch=2, mtime=now - 50.0,
+                   hosts=("0",))
+    # the rotted pair: two hosts hold the same corrupt local copy of a
+    # NEWER snapshot whose bytes no longer match its sidecar claim —
+    # honest reports re-hash and exclude it; the unverified_votes
+    # mutant lets its claimed digest reach quorum
+    world.add_snap("snap_009.pickle", epoch=9, mtime=now - 5.0,
+                   rotted=True, on_mirror=False, hosts=("1", "2"))
+    # the fleet is running FROM snap_002 (the boot pick): picks below
+    # epoch 2 are a rollback
+    world.boot_coord.snapshot = "snap_002.pickle"
+    world.max_picked_epoch = 2
+    return world
+
+
+#: seeded mutants: one per invariant, each a deliberate protocol bug
+#: the checker must catch (tests pair every entry with a clean run)
+MUTANTS: Dict[str, Dict[str, Any]] = {
+    "no_term_fence": {
+        "scenario": "partition", "invariant": 1,
+        "rule": "mc-term-fence",
+        "explore": {"budget": 400, "max_faults": 2},
+        "description": "directive term fence dropped — a stale "
+                       "coordinator's directive is executed"},
+    "double_coordinator": {
+        "scenario": "election", "invariant": 2,
+        "rule": "mc-single-coordinator",
+        "explore": {"budget": 400, "max_faults": 0},
+        "description": "election plane rots (deaf adoption, solipsist "
+                       "liveness, saturating term counter) — two "
+                       "coordinators bind the same term"},
+    "oldest_pick": {
+        "scenario": "membership", "invariant": 3,
+        "rule": "mc-generation-rollback",
+        "explore": {"budget": 600, "max_faults": 0},
+        "description": "restart pick sorts the wrong way — the fleet "
+                       "resumes from the OLDEST snapshot"},
+    "all_writers": {
+        "scenario": "membership", "invariant": 4,
+        "rule": "mc-single-writer",
+        "explore": {"budget": 600, "max_faults": 0},
+        "description": "single-writer dry-run pin dropped — every "
+                       "host spawns as the snapshot writer"},
+    "unverified_votes": {
+        "scenario": "membership", "invariant": 5,
+        "rule": "mc-verified-pick",
+        "explore": {"budget": 400, "max_faults": 0},
+        "description": "local snapshot reports skip the sidecar "
+                       "re-hash — a rotted copy's claim reaches "
+                       "quorum"},
+    "split_commit": {
+        "scenario": "hotswap", "invariant": 6,
+        "rule": "mc-atomic-commit",
+        "explore": {"budget": 400, "max_faults": 0},
+        "description": "swap commit torn in two — params flip before "
+                       "the generation label lands"},
+    "no_rollback_pin": {
+        "scenario": "hotswap", "invariant": 7,
+        "rule": "mc-rollback-pin",
+        "explore": {"budget": 400, "max_faults": 0},
+        "description": "rollback forgets the pin — the watcher "
+                       "re-applies the rolled-back digest"},
+    "no_floor_stop": {
+        "scenario": "election", "invariant": 8,
+        "rule": "mc-floor-failstop",
+        "explore": {"budget": 400, "max_faults": 0},
+        "description": "promotion-path floor guard removed — a "
+                       "sub-floor fleet resumes instead of "
+                       "fail-stopping"},
+    "no_beacon_term": {
+        "scenario": "partition", "invariant": 2,
+        "rule": "mc-single-coordinator",
+        "explore": {"budget": 500, "max_faults": 2},
+        "description": "beacon-term claim fence reverted — a "
+                       "candidate with lossy announcement reads "
+                       "double-binds a live term (regression witness "
+                       "for the shipped fix)"},
+    "no_writer_repin": {
+        "scenario": "partition", "invariant": 4,
+        "rule": "mc-single-writer",
+        "explore": {"budget": 800, "max_faults": 2},
+        "description": "writer re-pin reverted — a re-homed "
+                       "ex-coordinator host and the successor's host "
+                       "both write one generation (regression witness "
+                       "for the shipped fix)"},
+}
+
+
+# -- the explorer -------------------------------------------------------------
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    mutant: Optional[str]
+    seed: int
+    schedules: int = 0
+    pruned: int = 0
+    exhausted: bool = False
+    violations: List[Dict[str, Any]] = field(default_factory=list)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"scenario": self.scenario, "mutant": self.mutant,
+                "seed": self.seed, "schedules": self.schedules,
+                "pruned": self.pruned, "exhausted": self.exhausted,
+                "violations": self.violations}
+
+
+def _run_schedule(scenario: str, prefix: Sequence[Tuple[str, int]],
+                  seed: int, mutant: Optional[str], max_actions: int,
+                  max_faults: int
+                  ) -> Tuple[Scheduler, Optional[Violation]]:
+    sched = Scheduler(prefix=prefix, max_faults=max_faults)
+    random.seed(seed)           # pins the backoff jitter per run
+    violation: Optional[Violation] = None
+    try:
+        world = SCENARIOS[scenario].build(sched, mutant)
+        world.run(max_actions)
+    except Violation as v:
+        violation = v
+        violation.events = world.events[-40:]
+    return sched, violation
+
+
+def _counterexample(scenario: str, mutant: Optional[str], seed: int,
+                    max_actions: int, max_faults: int, sched: Scheduler,
+                    violation: Violation) -> Dict[str, Any]:
+    return {
+        "scenario": scenario, "mutant": mutant, "seed": seed,
+        "max_actions": max_actions, "max_faults": max_faults,
+        "rule": violation.rule, "invariant": violation.invariant,
+        "message": violation.message,
+        "schedule": [[label, idx, opt]
+                     for (label, idx, _n, opt, _fp) in sched.trace],
+        "events": violation.events,
+    }
+
+
+def explore(scenario: str, *, budget: int = 500, seed: int = 0,
+            mutant: Optional[str] = None,
+            max_actions: Optional[int] = None, max_faults: int = 2,
+            stop_on_violation: bool = True) -> ExploreResult:
+    """DFS over the scenario's choice tree: run the all-defaults
+    schedule, enumerate every unexplored sibling of every choice point,
+    and keep replaying prefixes until the budget or the tree runs out.
+    State-fingerprint convergence pruning skips a pending action whose
+    (state, action) pair another schedule already explored."""
+    if max_actions is None:
+        max_actions = SCENARIOS[scenario].max_actions
+    result = ExploreResult(scenario=scenario, mutant=mutant, seed=seed)
+    prev_disable = logging.root.manager.disable
+    logging.disable(logging.CRITICAL)
+    try:
+        stack: List[tuple] = [()]
+        visited: set = set()
+        while stack and result.schedules < budget:
+            prefix = stack.pop()
+            sched, violation = _run_schedule(
+                scenario, prefix, seed, mutant, max_actions, max_faults)
+            result.schedules += 1
+            if violation is not None:
+                result.violations.append(_counterexample(
+                    scenario, mutant, seed, max_actions, max_faults,
+                    sched, violation))
+                if stop_on_violation:
+                    return result
+            for p in range(len(prefix), len(sched.trace)):
+                label, _idx, arity, _opt, fp = sched.trace[p]
+                base = tuple((t[0], t[1]) for t in sched.trace[:p])
+                for alt in range(arity - 1, 0, -1):
+                    if fp is not None:
+                        key = (fp, label, alt)
+                        if key in visited:
+                            result.pruned += 1
+                            continue
+                        visited.add(key)
+                    stack.append(base + ((label, alt),))
+        result.exhausted = not stack
+        return result
+    finally:
+        logging.disable(prev_disable)
+
+
+def replay(counterexample: Dict[str, Any]) -> Optional[Violation]:
+    """Re-run one recorded schedule; returns the reproduced Violation
+    (None if the run is clean — e.g. the bug it witnessed was fixed)."""
+    prefix = [(c[0], int(c[1]))
+              for c in counterexample.get("schedule", ())]
+    prev_disable = logging.root.manager.disable
+    logging.disable(logging.CRITICAL)
+    try:
+        _sched, violation = _run_schedule(
+            counterexample["scenario"], prefix,
+            int(counterexample.get("seed", 0)),
+            counterexample.get("mutant"),
+            int(counterexample.get("max_actions", 14)),
+            int(counterexample.get("max_faults", 2)))
+        return violation
+    finally:
+        logging.disable(prev_disable)
+
+
+def findings_from(results: Sequence[ExploreResult]) -> List[Finding]:
+    out: List[Finding] = []
+    for res in results:
+        for cx in res.violations:
+            unit = f"modelcheck:{cx['scenario']}" + (
+                f"+{cx['mutant']}" if cx.get("mutant") else "")
+            out.append(Finding(
+                rule=cx["rule"], severity=SEV_ERROR, unit=unit,
+                message=cx["message"],
+                site=f"schedule[{len(cx['schedule'])} choices, "
+                     f"seed {cx['seed']}]"))
+    return out
+
+
+def check_tree(budget_per_scenario: int = 300, seed: int = 0,
+               max_faults: int = 2,
+               scenarios: Optional[Sequence[str]] = None
+               ) -> Tuple[List[Finding], List[ExploreResult]]:
+    """The shipped-tree sweep every CI/verify entry point runs: explore
+    every scenario with no mutant; any finding is a protocol bug (or a
+    checker bug — both block)."""
+    results = [explore(name, budget=budget_per_scenario, seed=seed,
+                       max_faults=max_faults, stop_on_violation=False)
+               for name in (scenarios or SCENARIOS)]
+    return findings_from(results), results
+
+
+def quick_check(budget_per_scenario: int = 40,
+                seed: int = 0) -> Tuple[List[Finding], Dict[str, Any]]:
+    """The `--verify-workflow` section: a small fixed-budget sweep over
+    every scenario (seconds, deterministic)."""
+    findings, results = check_tree(
+        budget_per_scenario=budget_per_scenario, seed=seed)
+    stats = {
+        "schedules": sum(r.schedules for r in results),
+        "pruned": sum(r.pruned for r in results),
+        "scenarios": {r.scenario: r.schedules for r in results},
+    }
+    return findings, stats

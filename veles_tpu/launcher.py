@@ -459,6 +459,19 @@ class Launcher(Logger):
                 print(f"verify-workflow: resources section "
                       f"({len(res_finds)} finding(s))", flush=True)
                 findings += res_finds
+        elif self.verify_workflow == "modelcheck":
+            # pass 8 (analysis/modelcheck.py): a small fixed-budget
+            # bounded-interleaving sweep of the real election /
+            # membership / hot-swap protocol logic under a simulated
+            # world. Deterministic and jax-free (seconds); the full
+            # exhaustiveness budget lives in tools/modelcheck.py --ci.
+            from veles_tpu.analysis.modelcheck import quick_check
+            mc_finds, mc_stats = quick_check()
+            print(f"verify-workflow: modelcheck explored "
+                  f"{mc_stats['schedules']} schedule(s) across "
+                  f"{len(mc_stats['scenarios'])} scenario(s) "
+                  f"({len(mc_finds)} finding(s))", flush=True)
+            findings += mc_finds
         # concurrency section: the whole-program thread/endpoint
         # contracts (analysis passes 4/5) over the installed package —
         # the same findings tools/velint.py --ci ratchets on, surfaced

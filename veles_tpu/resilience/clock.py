@@ -1,0 +1,77 @@
+"""Clock seam for the resilience / serving-watch control loops.
+
+Every protocol loop that previously called ``time.monotonic()`` /
+``time.time()`` / ``time.sleep()`` directly now goes through a
+:class:`Clock` instance so the bounded model checker
+(``analysis/modelcheck.py``) can substitute a :class:`VirtualClock`
+and own time deterministically.  Production behaviour is unchanged:
+everything defaults to :data:`SYSTEM_CLOCK`, which delegates to the
+``time`` module.
+
+The velint ``raw-clock`` rule flags direct ``time.*`` calls in the
+seamed planes; this module is the one place they are allowed to live.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Clock:
+    """System clock: thin delegating wrapper over the ``time`` module.
+
+    Subclass and override all three methods together — the protocol
+    loops assume ``sleep(s)`` advances ``monotonic()`` by at least
+    ``s`` (the VirtualClock contract; the OS only approximates it).
+    """
+
+    def monotonic(self) -> float:
+        return time.monotonic()  # velint: disable=raw-clock
+
+    def time(self) -> float:
+        return time.time()  # velint: disable=raw-clock
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)  # velint: disable=raw-clock
+
+
+#: Shared default. Stateless, so one instance serves every loop.
+SYSTEM_CLOCK = Clock()
+
+
+class VirtualClock(Clock):
+    """Deterministic clock for the model checker and tests.
+
+    ``monotonic()`` and ``time()`` read one virtual counter (``time()``
+    adds a fixed wall offset so timestamps look plausible in meta
+    records); ``sleep(s)`` advances it by exactly ``s`` and returns
+    immediately.  ``advance(s)`` lets a scheduler push time forward
+    without any agent sleeping.  Thread-safe, though the checker runs
+    single-threaded by construction.
+    """
+
+    def __init__(self, start: float = 0.0, wall_offset: float = 1.7e9):
+        self._now = float(start)
+        self._wall_offset = float(wall_offset)
+        self._lock = threading.Lock()
+        self.total_slept = 0.0
+
+    def monotonic(self) -> float:
+        with self._lock:
+            return self._now
+
+    def time(self) -> float:
+        with self._lock:
+            return self._now + self._wall_offset
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+        with self._lock:
+            self.total_slept += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        if seconds < 0:
+            raise ValueError(f"cannot advance a clock backwards: {seconds}")
+        with self._lock:
+            self._now += float(seconds)

@@ -70,13 +70,13 @@ import subprocess
 import sys
 import tempfile
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Set
 
 from veles_tpu.logger import Logger
 from veles_tpu.resilience import (EXIT_GIVEUP, EXIT_HOST_DEAD,
                                   EXIT_ISOLATED, EXIT_NONFINITE)
 from veles_tpu.resilience.backoff import backoff_delay
+from veles_tpu.resilience.clock import SYSTEM_CLOCK, Clock
 from veles_tpu.resilience.supervisor import read_heartbeat
 
 #: heartbeats a partition fault suppresses once it fires (long enough
@@ -166,8 +166,12 @@ class ClusterCoordinator(Logger):
                  max_body: int = 1 << 20, term: int = 1,
                  members: Optional[Sequence[str]] = None,
                  mirror: str = "", coord_id: str = "0",
-                 advertise: str = "", gather: bool = False) -> None:
+                 advertise: str = "", gather: bool = False,
+                 clock: Optional[Clock] = None) -> None:
         super().__init__()
+        #: time source for every beat-age / gather-deadline / drain
+        #: decision — the model checker injects a VirtualClock here
+        self._clock = clock or SYSTEM_CLOCK
         if n_hosts < 1:
             raise ValueError(f"n_hosts must be >= 1 (got {n_hosts})")
         #: the MINIMUM live host count, not an exact size: membership
@@ -218,7 +222,7 @@ class ClusterCoordinator(Logger):
         self._gather = bool(gather)
         self._gather_deadline = 0.0
         self._lock = threading.Lock()
-        self._started = time.monotonic()
+        self._started = self._clock.monotonic()
         #: host_id -> {"last_beat": monotonic, "report": {...}}
         self._hosts: Dict[str, Dict[str, Any]] = {}
         self.generation = 0 if gather else 1
@@ -252,7 +256,7 @@ class ClusterCoordinator(Logger):
                     joining: bool = False) -> Dict[str, Any]:
         """Ingest one host heartbeat, advance the state machine, return
         the directive the host must follow."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         host_id = str(report.get("host", ""))[:128]
         with self._lock:
             self._hosts[host_id] = {"last_beat": now, "report": report}
@@ -429,6 +433,24 @@ class ClusterCoordinator(Logger):
         self.members = (self.members | (admit or set())) \
             - (evict or set())
         self._recompute_quorum()
+        if len(self.members) < self.floor:
+            # found by the protocol model checker (analysis pass 8,
+            # scenario `election`): a coordinator promoted over a live
+            # view that ALREADY shrank below the floor reaches this
+            # bump without ever tripping `_sweep_dead`'s floor check —
+            # nobody in its (too small) membership is dead. Without
+            # this guard the sub-floor fleet resumes and runs
+            # indefinitely; the floor contract is one rule shared with
+            # the sweep: BELOW the floor always fail-stops.
+            self.action = "stop"
+            self.exit_code = EXIT_HOST_DEAD
+            self.outcome = (
+                f"membership would shrink to {len(self.members)} "
+                f"host(s) — below the --cluster-hosts floor of "
+                f"{self.floor} ({reason}): the scheduler must re-place "
+                f"the missing hosts")
+            self.error("%s", self.outcome)
+            return
         # a re-admitted host is alive again by definition
         self.dead_hosts = [d for d in self.dead_hosts
                            if d not in self.members]
@@ -465,7 +487,7 @@ class ClusterCoordinator(Logger):
         self._announce_record = {
             "term": self.term, "host": self.coord_id,
             "endpoint": f"{self.advertise or self.host}:{self.port}",
-            "generation": self.generation, "time": time.time()}
+            "generation": self.generation, "time": self._clock.time()}
 
     def _flush_announce(self) -> None:
         """Publish the queued announcement (lock released: mirror I/O
@@ -478,14 +500,18 @@ class ClusterCoordinator(Logger):
             self._announce_record = None
         if record is None:
             return
-        from veles_tpu.resilience.mirror import get_mirror
         try:
-            get_mirror(self.mirror_spec, token=self.token).put_meta(
-                COORD_META, record)
+            self._mirror().put_meta(COORD_META, record)
         except Exception as e:  # noqa: BLE001 — announcement is
             # best-effort durability, never the control path
             self.warning("could not persist control-plane record to "
                          "%s: %s", self.mirror_spec, e)
+
+    def _mirror(self):
+        """The mirror client announcements go through (overridable
+        seam: the model checker substitutes an in-memory SimMirror)."""
+        from veles_tpu.resilience.mirror import get_mirror
+        return get_mirror(self.mirror_spec, token=self.token)
 
     def _directive(self) -> Dict[str, Any]:
         delay = 0.0
@@ -507,14 +533,14 @@ class ClusterCoordinator(Logger):
         """Block until every live host that ever reported has received
         the terminal directive (dead hosts cannot ack), or `timeout`.
         Returns whether the drain completed."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._clock.monotonic() + timeout
+        while self._clock.monotonic() < deadline:
             with self._lock:
                 waiting = (set(self._hosts) - self._acked
                            - set(self.dead_hosts))
                 if not waiting:
                     return True
-            time.sleep(0.05)
+            self._clock.sleep(0.05)
         return False
 
     def summary(self) -> Dict[str, Any]:
@@ -536,7 +562,7 @@ class ClusterCoordinator(Logger):
                     "generation": h["report"].get("generation"),
                     "epoch": h["report"].get("epoch"),
                     "beat_age_s": round(
-                        time.monotonic() - h["last_beat"], 3)}
+                        self._clock.monotonic() - h["last_beat"], 3)}
                     for hid, h in sorted(self._hosts.items())}}
 
     def metrics_exposition(self) -> str:
@@ -679,7 +705,12 @@ class ClusterCoordinator(Logger):
 
     # -- HTTP transport -------------------------------------------------------
 
-    def start(self) -> "ClusterCoordinator":
+    def _bind_http(self):
+        """Bind (but do not serve) the HTTP transport; returns the
+        server. Overridable seam: the model checker's coordinator
+        returns None here — peers reach it synchronously through the
+        scheduler's transport instead — while everything above this
+        line (the decision core) runs unmodified."""
         from http.server import (BaseHTTPRequestHandler,
                                  ThreadingHTTPServer)
 
@@ -756,10 +787,13 @@ class ClusterCoordinator(Logger):
             def log_message(self, *args):
                 pass
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          Handler)
-        self.port = self._httpd.server_address[1]
-        self._started = time.monotonic()
+        return ThreadingHTTPServer((self.host, self.port), Handler)
+
+    def start(self) -> "ClusterCoordinator":
+        self._httpd = self._bind_http()
+        if self._httpd is not None:
+            self.port = self._httpd.server_address[1]
+        self._started = self._clock.monotonic()
         self._gather_deadline = self._started + max(self.dead_after,
                                                     5.0)
         self.info("cluster control plane on %s:%d (term %d, members "
@@ -774,10 +808,11 @@ class ClusterCoordinator(Logger):
         with self._lock:
             self._announce()
         self._flush_announce()
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="cluster-coordinator")
-        self._thread.start()
+        if self._httpd is not None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="cluster-coordinator")
+            self._thread.start()
         return self
 
     def stop(self) -> None:
@@ -803,8 +838,12 @@ class ClusterMember(Logger):
                  env: Optional[Dict[str, str]] = None,
                  report_path: str = "", floor: int = 1,
                  dead_after: float = 30.0, max_restarts: int = 3,
-                 join: bool = False, advertise: str = "") -> None:
+                 join: bool = False, advertise: str = "",
+                 clock: Optional[Clock] = None) -> None:
         super().__init__()
+        #: time source for the beat loop, silence windows and election
+        #: settles — the model checker injects a VirtualClock here
+        self._clock = clock or SYSTEM_CLOCK
         if commands and isinstance(commands[0], str):
             commands = [commands]
         self.commands = [list(c) for c in commands]
@@ -864,6 +903,12 @@ class ClusterMember(Logger):
         #: never re-adopt the same record, so a successor that died too
         #: cannot pin the member in a re-home loop
         self._adopted: tuple = (0, "")
+        #: highest term seen on any peer's presence beacon — a lower
+        #: bound on the highest term bound anywhere, folded into the
+        #: claim target so lossy announcement reads cannot lead this
+        #: member to claim a term that is already live (model checker
+        #: invariant 2)
+        self._beacon_term = 0
         self._reconnect_streak = 0
         self._stale_terms_seen: set = set()
         self.generation = 0           # nothing spawned yet
@@ -880,6 +925,9 @@ class ClusterMember(Logger):
         #: TERM round — one kill per generation transition
         self._killed_gen = 0
         self._snap_cache: Dict[str, tuple] = {}
+        #: monotonic stamp of the last accepted directive — the silence
+        #: window `step()` measures failover/isolation against
+        self._last_contact = self._clock.monotonic()
         #: mirror entries whose FETCH failed digest verification: their
         #: sidecar claim is a lie (bit rot in the store), so this host
         #: stops reporting them as visible — the next quorum pick can't
@@ -934,10 +982,8 @@ class ClusterMember(Logger):
         check lands in `_bad_mirror` and stops being reported."""
         snaps = {s["name"]: s for s in self._local_snapshots()}
         if self.mirror_spec:
-            from veles_tpu.resilience.mirror import get_mirror
             try:
-                for e in get_mirror(self.mirror_spec,
-                                    token=self.token).entries():
+                for e in self._mirror().entries():
                     name = str(e["name"])
                     if name in self._bad_mirror:
                         continue
@@ -964,11 +1010,8 @@ class ClusterMember(Logger):
             if os.path.exists(local) and Snapshotter.verify(local):
                 return local
             if self.mirror_spec:
-                from veles_tpu.resilience.mirror import get_mirror
                 try:
-                    got = get_mirror(self.mirror_spec,
-                                     token=self.token).fetch(
-                        name, self.snapshot_dir)
+                    got = self._mirror().fetch(name, self.snapshot_dir)
                 except Exception as e:  # noqa: BLE001
                     self.warning("mirror fetch of %s failed: %s",
                                  name, e)
@@ -1029,19 +1072,30 @@ class ClusterMember(Logger):
                     len(self.cluster_members))
                 env["VELES_CLUSTER_HOST_IDS"] = ",".join(
                     self.cluster_members)
-            if self.coordinator is not None:
+            if self._is_writer():
                 # the coordinator's host is the snapshot WRITER: a
                 # promoted host drops the single-writer dry-run pin it
                 # may have been launched with, so the fleet keeps
                 # producing durable snapshots after the original
                 # writer host died
                 env.pop("VELES_SNAPSHOT_DRY_RUN", None)
+            elif self.coordinator is not None:
+                # this host still embeds a control plane but is homed
+                # to a SUCCESSOR's: its coordinator was deposed, and
+                # the successor's host owns the writer role now — the
+                # pin must come BACK even if this host was launched
+                # without one. Found by the protocol model checker
+                # (analysis pass 8, scenario `partition`): without the
+                # re-pin, a re-homed ex-coordinator host and the new
+                # coordinator's host both write snapshots for the same
+                # generation, racing their pushes on the mirror.
+                env["VELES_SNAPSHOT_DRY_RUN"] = "1"
             self._procs.append(subprocess.Popen(argv, env=env))
         self.attempts.append({
             "generation": self.generation,
             "snapshot": snapshot, "pids":
                 [p.pid for p in self._procs]})
-        self._spawned_at = time.time()   # wall: compared to hb mtimes
+        self._spawned_at = self._clock.time()  # wall: vs hb mtimes
         self.info("generation %d: spawned %d process(es)%s",
                   self.generation, len(self._procs),
                   f" from {snapshot}" if snapshot else " fresh")
@@ -1049,6 +1103,18 @@ class ClusterMember(Logger):
     def _kill_children(self) -> None:
         from veles_tpu.resilience.supervisor import kill_procs
         kill_procs(self._procs, self.term_grace)  # TERM→grace→KILL
+
+    def _is_writer(self) -> bool:
+        """Whether this host's children produce durable snapshots:
+        true iff the control plane this member is CURRENTLY homed to
+        is its own embedded coordinator. Merely holding a coordinator
+        object is not enough — after re-homing to a successor, the
+        embedded one is deposed (it keeps running only to drain its
+        remaining peers) and the successor's host owns the writer
+        role."""
+        return (self.coordinator is not None
+                and self.coord_port == self.coordinator.port
+                and self.term == self.coordinator.term)
 
     def _gang_kill(self, gen: int) -> None:
         """Kill this host's children at most ONCE per generation
@@ -1079,7 +1145,7 @@ class ClusterMember(Logger):
         if codes and all(c == 0 for c in codes):
             return "done", codes
         if self.stall_timeout > 0 and self._procs:
-            wall_now = time.time()
+            wall_now = self._clock.time()
             spawned = getattr(self, "_spawned_at", wall_now)
             for hb, c in zip(self._hb_paths, codes):
                 if c is not None:
@@ -1118,6 +1184,13 @@ class ClusterMember(Logger):
         return out
 
     # -- control-plane client -------------------------------------------------
+
+    def _mirror(self):
+        """The mirror client for every rendezvous read/write
+        (overridable seam: the model checker substitutes an in-memory
+        SimMirror so elections run against simulated shared truth)."""
+        from veles_tpu.resilience.mirror import get_mirror
+        return get_mirror(self.mirror_spec, token=self.token)
 
     def _plan(self):
         from veles_tpu.resilience.faults import active_plan
@@ -1191,12 +1264,10 @@ class ClusterMember(Logger):
         election's liveness view)."""
         if not self.mirror_spec:
             return
-        from veles_tpu.resilience.mirror import get_mirror
         try:
-            (mirror or get_mirror(self.mirror_spec,
-                                  token=self.token)).put_meta(
+            (mirror or self._mirror()).put_meta(
                 BEACON_META.format(host=self.host_id),
-                {"host": self.host_id, "time": time.time(),
+                {"host": self.host_id, "time": self._clock.time(),
                  "generation": self.generation, "term": self.term})
         except Exception as e:  # noqa: BLE001 — liveness is best-effort
             self.warning("presence beacon publish failed: %s", e)
@@ -1206,7 +1277,7 @@ class ClusterMember(Logger):
         beacon is fresher than dead_after — who is still standing for
         election purposes. Wall-clock ages: the same NTP-synced-fleet
         assumption the quorum rule makes for snapshot mtimes."""
-        now = time.time()
+        now = self._clock.time()
         live = {self.host_id}
         for hid in set(self.cluster_members) | {self.host_id}:
             if hid == self.host_id:
@@ -1217,6 +1288,20 @@ class ClusterMember(Logger):
                 beacon = None
             if beacon is None:
                 continue
+            try:
+                # terms are monotone per host, so even a STALE beacon's
+                # term is a valid lower bound on the highest term bound
+                # anywhere — remembered so a claim can never target a
+                # term this member has indirect evidence of. Found by
+                # the protocol model checker (analysis pass 8, scenario
+                # `partition`): with the announcement record unreadable
+                # (lossy NFS reads degrade to None), a candidate that
+                # never observed term T+1 directly would claim it OVER
+                # a live term-T+1 coordinator and double-bind the term.
+                self._beacon_term = max(
+                    self._beacon_term, int(beacon.get("term", 0) or 0))
+            except (TypeError, ValueError):
+                pass
             try:
                 age = now - float(beacon.get("time", 0.0))
             except (TypeError, ValueError):
@@ -1267,8 +1352,7 @@ class ClusterMember(Logger):
         wait a jittered settle window for a lower-id claim to override,
         and PROMOTE self. Returns True when the member has a control
         plane to talk to again."""
-        from veles_tpu.resilience.mirror import get_mirror
-        mirror = get_mirror(self.mirror_spec, token=self.token)
+        mirror = self._mirror()
         self._publish_beacon(mirror)
         try:
             ann = mirror.get_meta(COORD_META)
@@ -1300,25 +1384,25 @@ class ClusterMember(Logger):
         # adopts its announcement on the re-read below
         rank = _host_key(self.host_id)[1]
         if rank:
-            time.sleep(min(rank, 8) * max(self.beat_s, 0.25))
+            self._clock.sleep(min(rank, 8) * max(self.beat_s, 0.25))
             try:
                 ann = mirror.get_meta(COORD_META)
             except Exception:  # noqa: BLE001
                 return False
             if self._try_adopt(ann):
                 return True
-        target = max(self.term,
+        target = max(self.term, self._beacon_term,
                      int((ann or {}).get("term", 0) or 0)) + 1
         claim = {"term": target, "host": self.host_id, "endpoint": "",
-                 "time": time.time()}
+                 "time": self._clock.time()}
         for attempt in range(3):
             if not mirror.put_meta(COORD_META, dict(claim)):
                 return False
             # jittered settle: a racing lower-id candidate's rewrite
             # must get the chance to land before we commit
-            time.sleep(backoff_delay(attempt,
-                                     base=max(self.beat_s, 0.25),
-                                     cap=2.0))
+            self._clock.sleep(backoff_delay(attempt,
+                                            base=max(self.beat_s, 0.25),
+                                            cap=2.0))
             try:
                 now_ann = mirror.get_meta(COORD_META)
             except Exception:  # noqa: BLE001
@@ -1337,7 +1421,7 @@ class ClusterMember(Logger):
             # a higher id raced us: rewrite our claim and settle again
             target = max(target, a_term)
             claim = {"term": target, "host": self.host_id,
-                     "endpoint": "", "time": time.time()}
+                     "endpoint": "", "time": self._clock.time()}
         return False
 
     def _promote(self, term: int, live: List[str]) -> bool:
@@ -1348,20 +1432,8 @@ class ClusterMember(Logger):
         snapshot the re-homed members report — promotion can never roll
         the fleet back (the pick needs a majority of the live set)."""
         members = sorted(set(live) | {self.host_id}, key=_host_key)
-        loopback = self.advertise in ("127.0.0.1", "localhost", "::1")
-        coord = ClusterCoordinator(
-            self.floor, host="127.0.0.1" if loopback else "0.0.0.0",
-            port=0, token=self.token, dead_after=self.dead_after,
-            max_restarts=self.max_restarts, members=members,
-            mirror=self.mirror_spec, term=term, coord_id=self.host_id,
-            advertise=self.advertise, gather=True,
-            # a live member re-homes within ~one seek interval; a host
-            # whose beacon was borderline-fresh at promotion but is
-            # actually dead must not get the default two-minute
-            # first-contact grace before the membership can shrink
-            join_grace=self.dead_after * 2)
         try:
-            coord.start()
+            coord = self._bind_coordinator(term, members)
         except OSError as e:
             self.error("could not bind the promoted control plane: %s",
                        e)
@@ -1385,14 +1457,140 @@ class ClusterMember(Logger):
             os.kill(os.getpid(), signal.SIGKILL)
         return True
 
+    def _bind_coordinator(self, term: int,
+                          members: List[str]) -> ClusterCoordinator:
+        """Construct and start the promoted control plane (overridable
+        seam: the model checker binds a transport-free coordinator into
+        its simulated world instead of an HTTP server). Raises OSError
+        when the bind fails."""
+        loopback = self.advertise in ("127.0.0.1", "localhost", "::1")
+        coord = ClusterCoordinator(
+            self.floor, host="127.0.0.1" if loopback else "0.0.0.0",
+            port=0, token=self.token, dead_after=self.dead_after,
+            max_restarts=self.max_restarts, members=members,
+            mirror=self.mirror_spec, term=term, coord_id=self.host_id,
+            advertise=self.advertise, gather=True, clock=self._clock,
+            # a live member re-homes within ~one seek interval; a host
+            # whose beacon was borderline-fresh at promotion but is
+            # actually dead must not get the default two-minute
+            # first-contact grace before the membership can shrink
+            join_grace=self.dead_after * 2)
+        coord.start()
+        return coord
+
     # -- main loop ------------------------------------------------------------
+
+    def step(self, run_dir: str) -> Optional[int]:
+        """ONE beat-loop iteration: probe the children, beat (or join),
+        fence stale terms, handle silence (failover / isolation
+        fail-stop) and the accepted directive's actions. Returns the
+        process exit code when the member is finished, None to keep
+        looping. Extracted from `run()` so the model checker can drive
+        the REAL loop logic one schedulable action at a time."""
+        status, codes = (self._children_status()
+                         if self._procs else ("joining", []))
+        directive = (self._join_cluster(status, codes)
+                     if self._join_pending
+                     else self._beat(status, codes))
+        if directive is not None:
+            dterm = int(directive.get("term", self.term) or 0)
+            if dterm < self.term:
+                # term fencing: a stale coordinator (the
+                # pre-partition incumbent coming back, or one
+                # this member already moved past) must not
+                # steer this host — treat its directive as
+                # silence so the failover path takes over
+                if dterm not in self._stale_terms_seen:
+                    self._stale_terms_seen.add(dterm)
+                    self.warning(
+                        "rejecting directive from stale term "
+                        "%d (this member has seen term %d)",
+                        dterm, self.term)
+                directive = None
+        if directive is None:
+            now = self._clock.monotonic()
+            silent = now - self._last_contact
+            if self.mirror_spec and silent > self.dead_after:
+                if self._seek_coordinator():
+                    # re-homed (or promoted): fresh window
+                    self._last_contact = self._clock.monotonic()
+                    self._reconnect_streak = 0
+                    return None
+            elif self.mirror_spec:
+                # stay visibly ALIVE to electors while cut off:
+                # a beacon that goes stale during the silence
+                # window would let a higher host-id believe it
+                # is the lowest live and double-promote
+                self._publish_beacon()
+            if silent > self.coord_timeout:
+                self.error(
+                    "no control-plane contact for %.0fs: this "
+                    "host is partitioned — killing children "
+                    "and exiting (fail-stop, the quorum side "
+                    "owns the job)", self.coord_timeout)
+                self._kill_children()
+                return self._finish(EXIT_ISOLATED,
+                                    "isolated from the control "
+                                    "plane")
+            # jittered exponential reconnect backoff (shared
+            # resilience/backoff.py policy), capped well under
+            # coord_timeout so the isolation check stays live
+            self._clock.sleep(backoff_delay(
+                self._reconnect_streak, base=self.beat_s,
+                cap=max(self.beat_s,
+                        min(5.0, self.coord_timeout / 4))))
+            self._reconnect_streak += 1
+            return None
+        self._last_contact = self._clock.monotonic()
+        self._reconnect_streak = 0
+        self.term = max(self.term,
+                        int(directive.get("term", 0) or 0))
+        members = directive.get("members")
+        if isinstance(members, list) and members:
+            self.cluster_members = [str(m) for m in members]
+        action = directive.get("action")
+        if action in ("done", "stop"):
+            self._kill_children()   # "done": no-op, exited 0
+            if self.coordinator is not None:
+                # keep the control plane up until every live
+                # peer has received the terminal directive too
+                self.coordinator.drain(
+                    timeout=max(5.0, self.beat_s * 10))
+            if action == "done":
+                return self._finish(0, "completed")
+            code = int(directive.get("exit_code")
+                       or EXIT_GIVEUP)
+            return self._finish(
+                code, directive.get("reason") or "stopped",
+                dead_hosts=directive.get("dead_hosts"))
+        gen = int(directive.get("generation", 1))
+        if gen > self.generation:
+            # gang restart on the coordinated generation counter
+            # (deduped: a stall kill or a replayed directive for
+            # this same bump already tore the children down)
+            self._gang_kill(gen)
+            backoff = float(directive.get("backoff") or 0.0)
+            if backoff:
+                self._clock.sleep(backoff)
+            self.generation = gen
+            # no directive snapshot = run the argv as-is: the
+            # initial generation, or a quorum that agreed on
+            # NOTHING (scratch restart — resolving a local
+            # latest() unilaterally here would reintroduce the
+            # stale-dir rollback hazard the quorum exists for)
+            name = directive.get("snapshot")
+            self._spawn(run_dir,
+                        self._resolve_snapshot(name)
+                        if name else None)
+        self._clock.sleep(self.beat_s)
+        return None
 
     def run(self) -> int:
         run_dir = tempfile.mkdtemp(
             prefix=f"veles_cluster_h{self.host_id}_")
         self.env.setdefault("VELES_FAULT_STATE",
                             os.path.join(run_dir, "fault_state.json"))
-        last_contact = time.monotonic()
+        self._last_contact = self._clock.monotonic()
 
         # SIGTERM (scheduler preempting the AGENT) must not orphan the
         # training children: convert to the Ctrl-C teardown path (same
@@ -1406,102 +1604,9 @@ class ClusterMember(Logger):
             prev_term = None
         try:
             while True:
-                status, codes = (self._children_status()
-                                 if self._procs else ("joining", []))
-                directive = (self._join_cluster(status, codes)
-                             if self._join_pending
-                             else self._beat(status, codes))
-                if directive is not None:
-                    dterm = int(directive.get("term", self.term) or 0)
-                    if dterm < self.term:
-                        # term fencing: a stale coordinator (the
-                        # pre-partition incumbent coming back, or one
-                        # this member already moved past) must not
-                        # steer this host — treat its directive as
-                        # silence so the failover path takes over
-                        if dterm not in self._stale_terms_seen:
-                            self._stale_terms_seen.add(dterm)
-                            self.warning(
-                                "rejecting directive from stale term "
-                                "%d (this member has seen term %d)",
-                                dterm, self.term)
-                        directive = None
-                if directive is None:
-                    now = time.monotonic()
-                    silent = now - last_contact
-                    if self.mirror_spec and silent > self.dead_after:
-                        if self._seek_coordinator():
-                            # re-homed (or promoted): fresh window
-                            last_contact = time.monotonic()
-                            self._reconnect_streak = 0
-                            continue
-                    elif self.mirror_spec:
-                        # stay visibly ALIVE to electors while cut off:
-                        # a beacon that goes stale during the silence
-                        # window would let a higher host-id believe it
-                        # is the lowest live and double-promote
-                        self._publish_beacon()
-                    if silent > self.coord_timeout:
-                        self.error(
-                            "no control-plane contact for %.0fs: this "
-                            "host is partitioned — killing children "
-                            "and exiting (fail-stop, the quorum side "
-                            "owns the job)", self.coord_timeout)
-                        self._kill_children()
-                        return self._finish(EXIT_ISOLATED,
-                                            "isolated from the control "
-                                            "plane")
-                    # jittered exponential reconnect backoff (shared
-                    # resilience/backoff.py policy), capped well under
-                    # coord_timeout so the isolation check stays live
-                    time.sleep(backoff_delay(
-                        self._reconnect_streak, base=self.beat_s,
-                        cap=max(self.beat_s,
-                                min(5.0, self.coord_timeout / 4))))
-                    self._reconnect_streak += 1
-                    continue
-                last_contact = time.monotonic()
-                self._reconnect_streak = 0
-                self.term = max(self.term,
-                                int(directive.get("term", 0) or 0))
-                members = directive.get("members")
-                if isinstance(members, list) and members:
-                    self.cluster_members = [str(m) for m in members]
-                action = directive.get("action")
-                if action in ("done", "stop"):
-                    self._kill_children()   # "done": no-op, exited 0
-                    if self.coordinator is not None:
-                        # keep the control plane up until every live
-                        # peer has received the terminal directive too
-                        self.coordinator.drain(
-                            timeout=max(5.0, self.beat_s * 10))
-                    if action == "done":
-                        return self._finish(0, "completed")
-                    code = int(directive.get("exit_code")
-                               or EXIT_GIVEUP)
-                    return self._finish(
-                        code, directive.get("reason") or "stopped",
-                        dead_hosts=directive.get("dead_hosts"))
-                gen = int(directive.get("generation", 1))
-                if gen > self.generation:
-                    # gang restart on the coordinated generation counter
-                    # (deduped: a stall kill or a replayed directive for
-                    # this same bump already tore the children down)
-                    self._gang_kill(gen)
-                    backoff = float(directive.get("backoff") or 0.0)
-                    if backoff:
-                        time.sleep(backoff)
-                    self.generation = gen
-                    # no directive snapshot = run the argv as-is: the
-                    # initial generation, or a quorum that agreed on
-                    # NOTHING (scratch restart — resolving a local
-                    # latest() unilaterally here would reintroduce the
-                    # stale-dir rollback hazard the quorum exists for)
-                    name = directive.get("snapshot")
-                    self._spawn(run_dir,
-                                self._resolve_snapshot(name)
-                                if name else None)
-                time.sleep(self.beat_s)
+                code = self.step(run_dir)
+                if code is not None:
+                    return code
         except KeyboardInterrupt:
             self._kill_children()
             return self._finish(130, "terminated by signal")

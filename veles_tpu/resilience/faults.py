@@ -216,8 +216,13 @@ class FaultPlan:
         if e is not None:
             self._mark_fired(e)
             _log.warning("FAULT INJECTION: %s -> hanging forever", e.key)
+            # raw-clock suppressed on purpose: this IS the injected
+            # fault — a process wedged on a real OS sleep so the
+            # supervisor's stall detector has something true to detect.
+            # Routing it through the clock seam would let a virtual
+            # clock "advance" the hang away and un-inject the fault.
             while True:                      # pragma: no cover — killed
-                time.sleep(3600)
+                time.sleep(3600)  # velint: disable=raw-clock
 
     def nan_at_step(self, step: Optional[int] = None) -> bool:
         """True when the current (or given) train step's loss should be

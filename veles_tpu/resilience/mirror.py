@@ -153,9 +153,11 @@ class Mirror:
 class DirMirror(Mirror):
     """Second-directory mirror (attached volume, NFS mount)."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, clock=None) -> None:
+        from veles_tpu.resilience.clock import SYSTEM_CLOCK
         self.root = root
         self.spec = root
+        self._clock = clock or SYSTEM_CLOCK
 
     def _path(self, name: str) -> str:
         return os.path.join(self.root, _safe_name(name))
@@ -256,13 +258,35 @@ class DirMirror(Mirror):
             return False
         return True
 
+    #: torn-read retries in get_meta: put_meta's tmp+fsync+replace makes
+    #: a mid-replace read impossible on POSIX-local stores, but the
+    #: DirMirror contract includes NFS/network mounts where a reader can
+    #: still observe partial bytes — retry briefly, then degrade to None
+    META_READ_RETRIES = 2
+    META_READ_RETRY_S = 0.02
+
     def get_meta(self, name: str) -> Optional[Dict[str, object]]:
-        try:
-            with open(self._path(name)) as f:
-                data = json.load(f)
-        except (OSError, ValueError):
-            return None
-        return data if isinstance(data, dict) else None
+        for attempt in range(self.META_READ_RETRIES + 1):
+            try:
+                with open(self._path(name)) as f:
+                    data = json.load(f)
+            except OSError:
+                # absent (or unreadable) record: nothing a retry fixes
+                return None
+            except ValueError:
+                # torn/partial JSON mid-replace: the complete record
+                # lands with the writer's atomic rename — give it a
+                # beat, then degrade to None (callers already treat
+                # None as "no record yet" and re-poll)
+                if attempt < self.META_READ_RETRIES:
+                    self._clock.sleep(self.META_READ_RETRY_S)
+                    continue
+                _log.warning("meta record %s unparseable after %d "
+                             "re-reads (torn write?) — treating as "
+                             "absent", name, attempt + 1)
+                return None
+            return data if isinstance(data, dict) else None
+        return None
 
     def _corrupt(self, name: str) -> None:
         from veles_tpu.resilience.faults import corrupt_file

@@ -40,11 +40,11 @@ import signal
 import subprocess
 import sys
 import tempfile
-import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from veles_tpu.logger import Logger
 from veles_tpu.resilience import EXIT_GIVEUP, EXIT_NONFINITE, EXIT_STALLED
+from veles_tpu.resilience.clock import SYSTEM_CLOCK, Clock
 from veles_tpu.snapshotter import Snapshotter
 
 
@@ -53,7 +53,8 @@ from veles_tpu.snapshotter import Snapshotter
 def write_heartbeat(path: str, epoch: int,
                     feed: Optional[Dict[str, Any]] = None,
                     mem: Optional[Dict[str, Any]] = None,
-                    metrics: Optional[Dict[str, Any]] = None) -> None:
+                    metrics: Optional[Dict[str, Any]] = None,
+                    clock: Clock = SYSTEM_CLOCK) -> None:
     """Atomically publish liveness + the epoch counter. Atomic so a
     supervisor read never sees a torn file; the file's mtime is the
     liveness signal, the payload is the progress signal. `feed` is the
@@ -65,7 +66,7 @@ def write_heartbeat(path: str, epoch: int,
     member forwards them so the coordinator's /metrics can aggregate
     the fleet."""
     tmp = f"{path}.{os.getpid()}.tmp"
-    payload: Dict[str, Any] = {"epoch": int(epoch), "ts": time.time()}
+    payload: Dict[str, Any] = {"epoch": int(epoch), "ts": clock.time()}
     if feed:
         # drop the bulky per-epoch rows: the heartbeat is read every
         # poll interval and only the totals matter to the supervisor
@@ -157,7 +158,8 @@ def _with_snapshot(argv: Sequence[str], snapshot: str) -> List[str]:
 
 
 def kill_procs(procs: Sequence[subprocess.Popen],
-               term_grace: float = 5.0) -> None:
+               term_grace: float = 5.0,
+               clock: Clock = SYSTEM_CLOCK) -> None:
     """TERM, short grace, then KILL — every child, idempotent. Shared by
     the per-host Supervisor and the cluster member's gang-kill."""
     live = [p for p in procs if p.poll() is None]
@@ -166,10 +168,10 @@ def kill_procs(procs: Sequence[subprocess.Popen],
             p.terminate()
         except OSError:
             pass
-    deadline = time.time() + term_grace
+    deadline = clock.monotonic() + term_grace
     for p in live:
         try:
-            p.wait(timeout=max(0.0, deadline - time.time()))
+            p.wait(timeout=max(0.0, deadline - clock.monotonic()))
         except subprocess.TimeoutExpired:
             try:
                 p.send_signal(signal.SIGKILL)
@@ -189,7 +191,8 @@ class Supervisor(Logger):
                  jitter: float = 0.25, no_progress_limit: int = 2,
                  poll_interval: float = 0.2, term_grace: float = 5.0,
                  env: Optional[Dict[str, str]] = None,
-                 report_path: str = "", mirror: str = "") -> None:
+                 report_path: str = "", mirror: str = "",
+                 clock: Clock = SYSTEM_CLOCK) -> None:
         super().__init__()
         if commands and isinstance(commands[0], str):
             commands = [commands]        # a single argv, not a list of them
@@ -209,6 +212,10 @@ class Supervisor(Logger):
         self.no_progress_limit = no_progress_limit
         self.poll_interval = poll_interval
         self.term_grace = term_grace
+        #: injectable time source (resilience/clock.py): every wait /
+        #: deadline in the restart loop goes through it so tests and
+        #: the model checker can own time
+        self._clock = clock
         self.env = dict(env) if env is not None else dict(os.environ)
         #: optional JSON exit report (attempt log, outcome, final codes)
         self.report_path = report_path
@@ -338,7 +345,7 @@ class Supervisor(Logger):
                                   jitter=self.jitter)
             self.info("backing off %.2fs before restart %d", delay,
                       restarts)
-            time.sleep(delay)
+            self._clock.sleep(delay)
             # EXIT_NONFINITE: the newest snapshot may already embed the
             # divergence (it was written before the guard tripped) —
             # roll back one valid snapshot.
@@ -371,7 +378,9 @@ class Supervisor(Logger):
         """Watch one attempt. Returns (reason, exit_codes): reason "ok"
         (all exited 0), "died" (some child exited nonzero), or "stall"
         (a heartbeat went stale; children were killed)."""
-        start = time.time()
+        # wall time (clock.time(), not monotonic): staleness compares
+        # against heartbeat-file mtimes, which live on the wall axis
+        start = self._clock.time()
         while True:
             codes = [p.poll() for p in procs]
             if any(c is not None and c != 0 for c in codes):
@@ -380,7 +389,7 @@ class Supervisor(Logger):
             if all(c == 0 for c in codes):
                 return "ok", codes
             if self.stall_timeout > 0:
-                now = time.time()
+                now = self._clock.time()
                 for p, hb, c in zip(procs, hb_paths, codes):
                     if c is not None:
                         continue     # finished children don't heartbeat
@@ -401,10 +410,10 @@ class Supervisor(Logger):
                         return "stall", [
                             EXIT_STALLED if c < 0 else c
                             for c in (p.wait() for p in procs)]
-            time.sleep(self.poll_interval)
+            self._clock.sleep(self.poll_interval)
 
     def _kill_all(self, procs: List[subprocess.Popen]) -> None:
-        kill_procs(procs, self.term_grace)
+        kill_procs(procs, self.term_grace, clock=self._clock)
 
     def _finish(self, code: int, outcome: str) -> int:
         """Log the actionable exit report (and mirror it to JSON when

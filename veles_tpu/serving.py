@@ -279,24 +279,16 @@ class InferenceServer(Logger):
         #: where the executable came from ("compile"/"cache"/None)
         self.aot_compiles = 0
         self.aot_source: Optional[str] = None
-        #: blue/green weight generations (ISSUE 16 hot-swap): the LIVE
-        #: generation label (/healthz exposes it), the one PREVIOUS
-        #: generation kept device-resident for instant rollback, and
-        #: the swap ledger. _build overwrites the boot digest with the
-        #: content hash of the served params. All guarded by _cv.
-        self._generation: Dict[str, Any] = {
-            "digest": "boot", "since": self._started_at,
-            "source": "boot"}
-        self._prev_gen: Optional[Dict[str, Any]] = None
-        self._params_prev: Any = None
-        self.n_swaps = 0
+        #: blue/green weight generations (ISSUE 16 hot-swap): the
+        #: GenerationLedger owns the live (label, params) pair, the one
+        #: PREVIOUS pair kept device-resident for instant rollback, the
+        #: swap counter and the rolled-back digest pins. _build boots it
+        #: with the content hash of the served params. Guarded by _cv;
+        #: the dispatch loop reads `params` lock-free once per round.
+        from veles_tpu.serving_gen import GenerationLedger
+        self._gens = GenerationLedger()
         self.n_swap_refusals = 0
         self._last_swap_refusal: Optional[Dict[str, Any]] = None
-        #: digests explicitly rolled back FROM: the WeightWatcher skips
-        #: these, so a rollback pins serving until a NEW digest is
-        #: pushed (without this the watcher would re-apply the bad
-        #: generation one poll after the operator rolled it back)
-        self.rolled_back: set = set()
         #: lazily computed /healthz capacity hint (analysis pass 6);
         #: _UNSET -> computed once on first health() call
         self._capacity: Any = _UNSET
@@ -355,6 +347,35 @@ class InferenceServer(Logger):
         them; in ring mode `max_batch` remains live but is clamped to
         the frozen ring.)"""
         return self._ring_slots if self.dispatch == "ring" else None
+
+    # -- ledger views: serving_gen.GenerationLedger owns the blue/green
+    # state; these read-only properties keep the attribute names the
+    # rest of this file (and the WeightWatcher) read. All mutation goes
+    # through ledger methods under _cv.
+
+    @property
+    def _params_dev(self):
+        return self._gens.params
+
+    @property
+    def _params_prev(self):
+        return self._gens.prev_params
+
+    @property
+    def _generation(self) -> Dict[str, Any]:
+        return self._gens.generation
+
+    @property
+    def _prev_gen(self) -> Optional[Dict[str, Any]]:
+        return self._gens.prev_gen
+
+    @property
+    def n_swaps(self) -> int:
+        return self._gens.n_swaps
+
+    @property
+    def rolled_back(self) -> set:
+        return self._gens.rolled_back
 
     def _request_cap(self) -> int:
         """Largest admissible request (rows). Live `max_batch`, clamped
@@ -538,16 +559,15 @@ class InferenceServer(Logger):
         self._dense = dense
         # params live device-resident under the plan for the server's
         # lifetime; the ring batch is the only per-round transfer
-        self._params_dev = (jax.device_put(prepared, plan["params"])
-                            if mesh is not None
-                            else jax.device_put(prepared))
+        params_dev = (jax.device_put(prepared, plan["params"])
+                      if mesh is not None
+                      else jax.device_put(prepared))
         self._ring_put = make_input_put(step) or jax.device_put
         # the boot generation serves under the content hash of its own
         # params (a watcher-applied snapshot serves under the mirror's
         # sidecar digest — one namespace, two sources)
         with self._cv:
-            self._generation = {"digest": params_digest(params_host),
-                                "since": time.time(), "source": "boot"}
+            self._gens.boot(params_digest(params_host), params_dev)
         # warm + validate the executable NOW (a corrupt-but-loadable
         # artifact must fail the start, not the first request), and
         # probe a quantized wire against the f32 forward of the REAL
@@ -705,20 +725,15 @@ class InferenceServer(Logger):
         if digest is None:
             digest = params_digest(params_host)
         with self._cv:
-            self._params_prev = self._params_dev
-            self._prev_gen = dict(self._generation)
-            # _ring_dispatch reads this pointer once per round WITHOUT
-            # _cv (an atomic attribute load under the GIL; either side
-            # of the swap is a fully valid generation, and taking the
-            # lock there would serialize admission against dispatch) —
-            # a deliberate lock-free publish the static pass can't see.
-            # velint: disable=shared-write-no-lock
-            self._params_dev = new_dev
-            self._generation = {"digest": digest,
-                                "since": time.time(),
-                                "source": source}
-            self.n_swaps += 1
-            gen = dict(self._generation)
+            # _ring_dispatch reads the ledger's params pointer once per
+            # round WITHOUT _cv (an atomic attribute load under the
+            # GIL; either side of the swap is a fully valid generation,
+            # and taking the lock there would serialize admission
+            # against dispatch) — a deliberate lock-free publish; the
+            # ledger's ONE commit() call is what keeps the (params,
+            # label) pair consistent, and the model checker's
+            # commit-atomicity invariant holds it to that.
+            gen = self._gens.commit(digest, source, new_dev)
         self._m_swap_applied.inc()
         self._m_gen_age.set(0.0)
         self.info("hot swap applied: serving generation %s (from %s, "
@@ -730,7 +745,7 @@ class InferenceServer(Logger):
         cheap accessor the WeightWatcher polls (health() also computes
         capacity hints; a poll loop needs none of that)."""
         with self._cv:
-            return dict(self._generation)
+            return self._gens.snapshot()
 
     def rollback(self) -> Dict[str, Any]:
         """Re-point the ring at the PREVIOUS generation — its params
@@ -739,24 +754,14 @@ class InferenceServer(Logger):
         rollback rolls forward again (the pair just swaps). Refused
         (`no_previous`) when no prior generation exists."""
         with self._cv:
-            have_prev = self._params_prev is not None
+            have_prev = self._gens.prev_params is not None
         if not have_prev:
             self._refuse_swap(
                 "no_previous",
                 "no previous generation is resident (nothing was ever "
                 "swapped in)")
         with self._cv:
-            self._params_dev, self._params_prev = \
-                self._params_prev, self._params_dev
-            outgoing = dict(self._generation)
-            restored = dict(self._prev_gen or {})
-            self._generation = {"digest": restored.get("digest", "boot"),
-                                "since": time.time(),
-                                "source": "rollback"}
-            self._prev_gen = outgoing
-            self.rolled_back.add(outgoing["digest"])
-            self.n_swaps += 1
-            gen = dict(self._generation)
+            gen, outgoing = self._gens.rollback()
         self._m_swap_applied.inc()
         self._m_gen_age.set(0.0)
         self.info("rollback applied: serving generation %s (was %s)",

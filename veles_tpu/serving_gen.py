@@ -1,0 +1,95 @@
+"""Blue/green weight-generation ledger for the serving tier.
+
+The hot-swap state machine (ISSUE 16) used to live as five loose
+attributes on `InferenceServer`; this extracts it into ONE import-light
+object so (a) every transition — boot, commit, rollback — is a single
+method call whose atomicity is a checkable property rather than a code
+comment, and (b) the protocol model checker (`analysis/modelcheck.py`)
+can drive the REAL generation/rollback/pinning logic without jax or a
+device in sight.
+
+The ledger pairs the generation LABEL with the live params handle (an
+opaque token: device arrays in production, anything hashable in the
+checker), so "swap commits are atomic between ring rounds" reduces to:
+any `(params, label)` pair read together matches a pair some single
+`commit`/`rollback`/`boot` call published together.
+
+NOT thread-safe by itself: the owner provides the mutual exclusion
+(`InferenceServer` calls every mutator under its `_cv`; the model
+checker is single-threaded by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Set, Tuple
+
+from veles_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+
+class GenerationLedger:
+    """Blue/green generations: the LIVE (label, params) pair, one
+    PREVIOUS pair kept resident as the rollback target, the swap
+    counter, and the rolled-back digest pins the WeightWatcher honors."""
+
+    def __init__(self, clock: Optional[Clock] = None) -> None:
+        self._clock = clock or SYSTEM_CLOCK
+        #: the live generation label: {"digest", "since", "source"}
+        self.generation: Dict[str, Any] = {
+            "digest": "boot", "since": self._clock.time(),
+            "source": "boot"}
+        self.prev_gen: Optional[Dict[str, Any]] = None
+        #: the live params handle — read lock-free (one attribute load)
+        #: by the dispatch loop once per ring round
+        self.params: Any = None
+        self.prev_params: Any = None
+        self.n_swaps = 0
+        #: digests explicitly rolled back FROM: the WeightWatcher skips
+        #: these, so a rollback pins serving until a NEW digest is
+        #: pushed (without this the watcher would re-apply the bad
+        #: generation one poll after the operator rolled it back)
+        self.rolled_back: Set[str] = set()
+
+    def boot(self, digest: str, params: Any,
+             source: str = "boot") -> Dict[str, Any]:
+        """Publish the startup generation (no previous: rollback from
+        boot is `no_previous` by definition)."""
+        self.params = params
+        self.generation = {"digest": digest,
+                           "since": self._clock.time(),
+                           "source": source}
+        return dict(self.generation)
+
+    def commit(self, digest: str, source: str,
+               params: Any) -> Dict[str, Any]:
+        """Commit a validated candidate as the live generation — the
+        outgoing pair becomes the rollback target. ONE call publishes
+        label and params together; callers must not split it."""
+        self.prev_params = self.params
+        self.prev_gen = dict(self.generation)
+        self.params = params
+        self.generation = {"digest": digest,
+                           "since": self._clock.time(),
+                           "source": source}
+        self.n_swaps += 1
+        return dict(self.generation)
+
+    def rollback(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Swap live and previous pairs and PIN the outgoing digest so
+        the watcher never re-applies it. Returns (restored label,
+        outgoing label); raises LookupError when nothing is resident."""
+        if self.prev_params is None:
+            raise LookupError("no previous generation is resident")
+        self.params, self.prev_params = self.prev_params, self.params
+        outgoing = dict(self.generation)
+        restored = dict(self.prev_gen or {})
+        self.generation = {"digest": restored.get("digest", "boot"),
+                           "since": self._clock.time(),
+                           "source": "rollback"}
+        self.prev_gen = outgoing
+        self.rolled_back.add(str(outgoing["digest"]))
+        self.n_swaps += 1
+        return dict(self.generation), outgoing
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A copy of the live label (never the internal dict)."""
+        return dict(self.generation)

@@ -37,7 +37,6 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
-import time
 from typing import Any, Dict, Optional, Set
 
 from veles_tpu.logger import Logger
@@ -169,9 +168,13 @@ class WeightWatcher(Logger):
         self._clear_streak()
         return None
 
-    def _try_swap(self, name: str,
-                  digest: str) -> Optional[Dict[str, Any]]:
-        from veles_tpu.serving import SwapRefused
+    def _obtain(self, name: str, digest: str) -> Optional[Any]:
+        """Fetch + sha256-verify + import one candidate snapshot —
+        the host-side, jax/filesystem-heavy half of a swap attempt.
+        Returns the imported workflow, or None after recording the
+        refusal. Overridable seam: the model checker substitutes a
+        simulated obtain so the scan/pinning/refusal protocol above it
+        runs unmodified against a simulated world."""
         from veles_tpu.snapshotter import Snapshotter
         path = None
         try:
@@ -194,7 +197,7 @@ class WeightWatcher(Logger):
                 return None
             # restore_prng=False: a serving-side import must not
             # clobber the process-wide RNG streams
-            wf = Snapshotter.import_(path, restore_prng=False)
+            return Snapshotter.import_(path, restore_prng=False)
         except Exception as e:  # noqa: BLE001 — a truncated/garbage
             # pickle lands here, not in the server
             self._refuse("import_failed", digest,
@@ -207,6 +210,13 @@ class WeightWatcher(Logger):
                         os.remove(victim)
                 except OSError:
                     pass
+
+    def _try_swap(self, name: str,
+                  digest: str) -> Optional[Dict[str, Any]]:
+        from veles_tpu.serving import SwapRefused
+        wf = self._obtain(name, digest)
+        if wf is None:
+            return None
         try:
             gen = self._server.swap_params(wf, digest=digest,
                                            source="watcher")
