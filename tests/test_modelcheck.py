@@ -250,7 +250,7 @@ def test_findings_from_shape():
 def test_quick_check_stats():
     finds, stats = mc.quick_check(budget_per_scenario=10)
     assert finds == []
-    assert stats["schedules"] == 40
+    assert stats["schedules"] == 10 * len(mc.SCENARIOS)
     assert set(stats["scenarios"]) == set(mc.SCENARIOS)
 
 

@@ -6,6 +6,7 @@ recovery matrix.
     python tools/chaos.py [--keep] [--only kill,stall,...]
     python tools/chaos.py --cluster [--only kill_h0,coord_loss,...]
     python tools/chaos.py --swap [--only corrupt_mid_push,...]
+    python tools/chaos.py --fleet [--only kill_replica,...]
 
 Each single-host scenario runs `python -m veles_tpu --supervise` on a
 tiny synthetic-classifier workflow (6 epochs, snapshots on improvement)
@@ -38,11 +39,24 @@ POST /rollback flips to the previous device-resident generation (and
 pins it against re-application), and that a dead mirror endpoint costs
 bounded per-poll retries and nothing else.
 
+`--fleet` runs the SERVING-FLEET matrix (ISSUE 19) instead: per
+scenario an in-process replica group (ring `InferenceServer`s + mirror
+presence beacons) behind the real `ServingRouter` front door, with a
+live client lane counting outcomes through the router. Scenarios: a
+replica crashed to beacon silence mid-load (retries absorb the death,
+the corpse is TTL-evicted, zero client-visible errors), a replica
+joining mid-load (discovered from the bus, receives traffic, no
+config push), a slow replica tripping its circuit breaker open and
+being readmitted through the half-open probe once it recovers, and an
+unreachable beacon bus (the registry coasts on last-known state —
+nothing is amputated — and discovery resumes on restore).
+
 This is the operational twin of tests/test_supervisor.py +
-tests/test_cluster.py (+ tests/test_serving_swap.py for --swap): CI
-asserts a fast subset; this prints the whole matrix for a human (and
-is the thing to run after touching supervisor/cluster/mirror/
-snapshotter/fault/serving-swap code).
+tests/test_cluster.py (+ tests/test_serving_swap.py for --swap,
+tests/test_serving_router.py for --fleet): CI asserts a fast subset;
+this prints the whole matrix for a human (and is the thing to run
+after touching supervisor/cluster/mirror/snapshotter/fault/serving
+code).
 """
 
 from __future__ import annotations
@@ -662,6 +676,357 @@ def run_swap_scenario(name: str, verbose: bool) -> dict:
             "elapsed": time.time() - t0}
 
 
+# -- the serving-fleet matrix (ISSUE 19) -------------------------------------
+#
+# In-process: a replica group (real ring `InferenceServer`s and/or a
+# controllable stub) publishes presence beacons on a DirMirror bus;
+# the real `ServingRouter` discovers them and fronts a background
+# client lane. Every scenario's contract is the fleet one: ANY
+# replica-level failure degrades to router-side retry / circuit /
+# eviction — the client lane must see ZERO errors and zero sheds.
+
+class _StubReplica:
+    """Controllable fake replica (the slow-replica scenario): answers
+    POST /predict 200 after `delay_s` seconds — adjustable mid-run, so
+    one scenario can trip the router's circuit breaker with timeouts
+    and then recover to earn readmission."""
+
+    def __init__(self) -> None:
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        from veles_tpu.http_util import check_shared_token
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self) -> None:  # noqa: N802
+                # same endpoint contract as the real replica: token
+                # first (trivially open — chaos runs tokenless on
+                # loopback), bounded body before reading
+                if not check_shared_token(self, None):
+                    return
+                n = min(int(self.headers.get("Content-Length", "0")),
+                        1 << 20)
+                self.rfile.read(n)
+                time.sleep(outer.delay_s)
+                body = json.dumps({"outputs": [], "stub": True}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass
+
+        class Quiet(ThreadingHTTPServer):
+            def handle_error(self, request, client_address) -> None:
+                pass        # router timed out and hung up mid-delay
+
+        self.delay_s = 0.0
+        self._httpd = Quiet(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="chaos-stub").start()
+
+    def stop(self, drain_s: float = 0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _FleetHarness:
+    """One fleet scenario's stack: DirMirror beacon bus + replicas +
+    the ServingRouter front door + a background client lane counting
+    outcomes THROUGH the router."""
+
+    def __init__(self) -> None:
+        if REPO not in sys.path:    # run as `python tools/chaos.py`
+            sys.path.insert(0, REPO)
+        from veles_tpu.resilience.mirror import DirMirror
+        self.tmp = tempfile.mkdtemp(prefix="chaos_fleet_")
+        self.mirror = DirMirror(os.path.join(self.tmp, "mirror"))
+        self.wf = _swap_build_wf()
+        self.sample = 8
+        self.reps = {}              # rid -> {"srv", "beacon"}
+        self.router = None
+        self.url = None
+        self.counts = {"ok": 0, "shed": 0, "error": 0}
+        self._load_stop = threading.Event()
+        self._load_thread = None
+
+    # -- fleet membership -----------------------------------------------------
+
+    def spawn(self, rid: str, capacity=None) -> None:
+        """One real ring replica + its presence beacon. `capacity`
+        overrides the /healthz-derived hint (to level the field
+        against a stub in the circuit scenario)."""
+        from veles_tpu.serving import InferenceServer
+        from veles_tpu.serving_router import ReplicaBeacon
+        srv = InferenceServer(self.wf, max_batch=16, queue_limit=64,
+                              dispatch="ring", ring_slots=16,
+                              replica=rid).start()
+        beacon = ReplicaBeacon(
+            self.mirror, rid, f"http://127.0.0.1:{srv.port}",
+            health=srv.health, capacity=capacity,
+            interval_s=0.3).start()
+        self.reps[rid] = {"srv": srv, "beacon": beacon}
+
+    def spawn_stub(self, rid: str, capacity: float) -> _StubReplica:
+        from veles_tpu.serving_router import ReplicaBeacon
+        stub = _StubReplica()
+        beacon = ReplicaBeacon(self.mirror, rid,
+                               f"http://127.0.0.1:{stub.port}",
+                               capacity=capacity, interval_s=0.3).start()
+        self.reps[rid] = {"srv": stub, "beacon": beacon}
+        return stub
+
+    def kill(self, rid: str) -> None:
+        """Crash `rid`: the beacon goes SILENT (no 'gone' goodbye a
+        dead process could not send) and the server hard-stops."""
+        rep = self.reps.pop(rid)
+        rep["beacon"].silence()
+        rep["srv"].stop(drain_s=0)
+
+    def start_router(self, ttl_s: float = 3.0, open_s: float = 1.5,
+                     dispatch_timeout_s: float = 5.0,
+                     hedge: bool = True) -> None:
+        from veles_tpu.serving_router import RouterCore, ServingRouter
+        self.router = ServingRouter(
+            self.mirror, poll_s=0.2,
+            core=RouterCore(open_s=open_s, beacon_ttl_s=ttl_s),
+            dispatch_timeout_s=dispatch_timeout_s,
+            backoff_base=0.02, backoff_cap=0.1, hedge=hedge).start()
+        self.url = f"http://127.0.0.1:{self.router.port}"
+
+    # -- router views ---------------------------------------------------------
+
+    def await_routable(self, n: int, timeout: float = 15.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self.router.health()["routable"] == n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def circuit(self, rid: str):
+        for r in self.router.fleet()["replicas"]:
+            if r["rid"] == rid:
+                return r["circuit"]
+        return None
+
+    def await_circuit(self, rid: str, state: str,
+                      timeout: float = 10.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self.circuit(rid) == state:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def dispatch_n(self, rid: str, outcome: str = "ok") -> float:
+        """Router-side per-replica dispatch counter (the telemetry
+        registry is process-global, so compare DELTAS)."""
+        child = self.router._f_dispatch._children.get((rid, outcome))
+        return child.value if child is not None else 0.0
+
+    # -- client lane ----------------------------------------------------------
+
+    def load_start(self, interval_s: float = 0.02) -> None:
+        body = json.dumps({"inputs": [[0.0] * self.sample] * 2}).encode()
+        # capture the router URL BEFORE the lane thread exists (the
+        # lane never reads harness state that the main thread mutates)
+        url = self.url + "/predict"
+
+        def lane() -> None:
+            while not self._load_stop.wait(interval_s):
+                try:
+                    req = urllib.request.Request(
+                        url, data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=20) as r:
+                        r.read()
+                        self.counts["ok" if r.status == 200
+                                    else "error"] += 1
+                except urllib.error.HTTPError as e:
+                    self.counts["shed" if e.code == 503
+                                else "error"] += 1
+                except OSError:
+                    self.counts["error"] += 1
+
+        self._load_stop.clear()
+        self._load_thread = threading.Thread(target=lane, daemon=True,
+                                             name="chaos-fleet-load")
+        self._load_thread.start()
+
+    def load_stop(self) -> None:
+        self._load_stop.set()
+        if self._load_thread is not None:
+            self._load_thread.join(timeout=30)
+
+    def stop(self) -> None:
+        self.load_stop()
+        if self.router is not None:
+            self.router.stop()
+        for rep in self.reps.values():
+            try:
+                rep["beacon"].stop()
+                rep["srv"].stop(drain_s=1)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _fleet_kill_replica(h: "_FleetHarness") -> list:
+    problems = []
+    h.spawn("r0")
+    h.spawn("r1")
+    h.start_router(ttl_s=2.0)
+    if not h.await_routable(2):
+        problems.append("fleet never formed")
+    h.load_start()
+    time.sleep(0.6)             # traffic on both replicas
+    h.kill("r1")                # crash: silence, not a goodbye
+    time.sleep(3.0)             # > TTL + poll: eviction must land
+    h.load_stop()
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"client-visible failures: {h.counts}")
+    if not h.counts["ok"]:
+        problems.append("no traffic served")
+    snap = h.router.fleet()
+    if any(r["rid"] == "r1" for r in snap["replicas"]):
+        problems.append("dead replica never TTL-evicted")
+    if snap["routable"] != 1:
+        problems.append(f"routable {snap['routable']} != 1")
+    return problems
+
+
+def _fleet_join_mid_load(h: "_FleetHarness") -> list:
+    problems = []
+    h.spawn("r0")
+    h.start_router()
+    if not h.await_routable(1):
+        problems.append("first replica never registered")
+    joined_before = h.dispatch_n("r1")
+    h.load_start()
+    time.sleep(0.5)
+    h.spawn("r1")               # no config push: beacon is the join
+    if not h.await_routable(2):
+        problems.append("joined replica never discovered")
+    time.sleep(1.5)             # traffic must spread onto it
+    h.load_stop()
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"client-visible failures: {h.counts}")
+    if h.dispatch_n("r1") <= joined_before:
+        problems.append("joined replica received no traffic")
+    return problems
+
+
+def _fleet_slow_circuit(h: "_FleetHarness") -> list:
+    problems = []
+    h.spawn("r0", capacity=4.0)     # level weights vs the stub
+    stub = h.spawn_stub("slow", capacity=4.0)
+    h.start_router(open_s=1.5, dispatch_timeout_s=0.4, hedge=False)
+    if not h.await_routable(2):
+        problems.append("fleet never formed")
+    ok_before = h.dispatch_n("slow")
+    stub.delay_s = 2.0              # >> dispatch timeout: every
+    h.load_start(0.05)              # dispatch there now times out
+    if not h.await_circuit("slow", "open"):
+        problems.append("slow replica never tripped its circuit")
+    stub.delay_s = 0.0              # recovered: the half-open probe
+    if not h.await_circuit("slow", "closed"):   # must readmit it
+        problems.append("recovered replica never readmitted")
+    time.sleep(0.5)                 # a few rounds back in rotation
+    h.load_stop()
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"client-visible failures: {h.counts}")
+    if h.dispatch_n("slow") <= ok_before:
+        problems.append("no successful dispatch after readmission")
+    return problems
+
+
+def _fleet_mirror_unreachable(h: "_FleetHarness") -> list:
+    from veles_tpu.resilience.mirror import HttpMirror
+    problems = []
+    h.spawn("r0")
+    h.spawn("r1")
+    h.start_router(ttl_s=10.0)      # generous TTL = coasting window
+    if not h.await_routable(2):
+        problems.append("fleet never formed")
+    h.load_start()
+    live_bus = h.router.mirror
+    # swap the router's bus for a dead endpoint with a retry budget
+    # scaled to the 0.2s poll (production: bounded under poll_s)
+    h.router.mirror = HttpMirror(
+        f"http://127.0.0.1:{_free_port()}", retries=2,
+        retry_base=0.02, retry_cap=0.05, retry_total=0.15)
+    time.sleep(1.5)                 # many polls of empty listings
+    snap = h.router.fleet()
+    if snap["routable"] != 2:
+        problems.append("registry amputated during the bus outage")
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"failures during the outage: {h.counts}")
+    h.router.mirror = live_bus      # bus restored: discovery resumes
+    h.spawn("r2")
+    if not h.await_routable(3):
+        problems.append("join not discovered after bus restore")
+    h.load_stop()
+    if h.counts["error"] or h.counts["shed"]:
+        problems.append(f"client-visible failures: {h.counts}")
+    return problems
+
+
+#: the serving-fleet matrix: name -> (scenario fn, blurb)
+FLEET_SCENARIOS = {
+    "kill_replica": (
+        _fleet_kill_replica,
+        "replica crashed to beacon silence mid-load -> retries absorb "
+        "the death, corpse TTL-evicted, zero client errors"),
+    "join_mid_load": (
+        _fleet_join_mid_load,
+        "replica joins mid-load -> discovered from the beacon bus "
+        "(no config push), receives traffic"),
+    "slow_circuit": (
+        _fleet_slow_circuit,
+        "slow replica times out -> circuit trips open; on recovery "
+        "the half-open probe readmits it"),
+    "mirror_unreachable": (
+        _fleet_mirror_unreachable,
+        "beacon bus dead -> registry coasts on last-known state, "
+        "nothing amputated; discovery resumes on restore"),
+}
+
+
+def run_fleet_scenario(name: str, verbose: bool) -> dict:
+    fn, _blurb = FLEET_SCENARIOS[name]
+    t0 = time.time()
+    h = None
+    try:
+        h = _FleetHarness()
+        problems = fn(h)
+    except Exception as e:  # noqa: BLE001 — a crashed scenario is a
+        # FAIL row, not a crashed matrix
+        problems = [f"{type(e).__name__}: {e!s:.200}"]
+    finally:
+        tmp = h.tmp if h is not None else None
+        counts = dict(h.counts) if h is not None else {}
+        try:
+            if h is not None:
+                h.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    ok = not problems
+    if verbose and not ok:
+        sys.stderr.write(f"--- {name} problems: {problems} ---\n")
+    return {"tmp": tmp or tempfile.mkdtemp(prefix="chaos_fleet_empty_"),
+            "ok": ok, "problems": problems,
+            "served": counts.get("ok"), "shed": counts.get("shed"),
+            "errors": counts.get("error"),
+            "elapsed": time.time() - t0}
+
+
 #: the matrix: name -> (fault plan, extra CLI flags, expectation)
 SCENARIOS = {
     "baseline": ("", (), "completes uninterrupted"),
@@ -758,7 +1123,8 @@ def main() -> int:
     ap.add_argument("--only", default="",
                     help="comma-separated scenario subset "
                          f"(of {', '.join(SCENARIOS)}; with --cluster: "
-                         f"{', '.join(CLUSTER_SCENARIOS)})")
+                         f"{', '.join(CLUSTER_SCENARIOS)}; with "
+                         f"--fleet: {', '.join(FLEET_SCENARIOS)})")
     ap.add_argument("--cluster", action="store_true",
                     help="run the CROSS-HOST fault matrix (2 loopback "
                          "member processes + shared mirror) instead of "
@@ -767,19 +1133,59 @@ def main() -> int:
                     help="run the HOT-SWAP fault matrix (in-process "
                          "ring server + mirror + weight watcher, "
                          "ISSUE 16) instead of the single-host one")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the SERVING-FLEET fault matrix (replica "
+                         "group + beacon bus + routing front door, "
+                         "ISSUE 19) instead of the single-host one")
     ap.add_argument("--keep", action="store_true",
                     help="keep the per-scenario temp dirs for debugging")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="dump child stderr on failure")
     args = ap.parse_args()
-    if args.cluster and args.swap:
-        ap.error("--cluster and --swap are separate matrices: pick one")
+    if sum((args.cluster, args.swap, args.fleet)) > 1:
+        ap.error("--cluster / --swap / --fleet are separate matrices: "
+                 "pick one")
     catalogue = (CLUSTER_SCENARIOS if args.cluster else
-                 SWAP_SCENARIOS if args.swap else SCENARIOS)
+                 SWAP_SCENARIOS if args.swap else
+                 FLEET_SCENARIOS if args.fleet else SCENARIOS)
     only = {s.strip() for s in args.only.split(",") if s.strip()}
     unknown = only - set(catalogue)
     if unknown:
         ap.error(f"unknown scenarios: {sorted(unknown)}")
+
+    if args.fleet:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        rows = []
+        for name, (_fn, blurb) in FLEET_SCENARIOS.items():
+            if only and name not in only:
+                continue
+            print(f"chaos[fleet]: {name}: {blurb} …", flush=True)
+            r = run_fleet_scenario(name, args.verbose)
+            rows.append((name, blurb, r))
+            if not args.keep:
+                import shutil
+                shutil.rmtree(r["tmp"], ignore_errors=True)
+        print()
+        print(f"{'scenario':<19} {'ok':<5} {'served':<7} {'shed':<5} "
+              f"{'errors':<7} {'secs':<6} problems")
+        failed = 0
+        for name, _blurb, r in rows:
+            verdict = "PASS" if r["ok"] else "FAIL"
+            failed += not r["ok"]
+            print(f"{name:<19} {verdict:<5} "
+                  f"{str(r['served'] if r['served'] is not None else '-'):<7} "
+                  f"{str(r['shed'] if r['shed'] is not None else '-'):<5} "
+                  f"{str(r['errors'] if r['errors'] is not None else '-'):<7} "
+                  f"{r['elapsed']:<6.1f} "
+                  f"{'; '.join(r['problems']) or '—'}")
+        print()
+        _route_telemetry(rows, cluster=False, matrix="fleet")
+        if failed:
+            print(f"{failed} fleet scenario(s) did NOT keep serving",
+                  file=sys.stderr)
+            return 1
+        print("all fleet scenarios kept serving")
+        return 0
 
     if args.swap:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -29,6 +29,15 @@ Modes:
   sheds) while the serving generation changes live; the record lands
   in SWAP_RECORD.json with every swap event timed and the final
   generation asserted;
+- ``--fleet``: the elasticity proof (ISSUE 19) — N ring replicas over
+  one shared AOT cache behind the beacon-discovered ``ServingRouter``,
+  a single-replica baseline leg, then the ramp against the full fleet
+  while one replica is HARD-KILLED (beacon silent) and a fresh one
+  joins mid-stream; asserts zero failed (non-shed, non-retried)
+  requests and near-linear per-replica throughput. Composes with
+  ``--ramp``; the record lands in FLEET_RECORD.json. Clients honor
+  Retry-After (one retry, exactly when told — the ``retried``
+  outcome);
 - ``--smoke``: tiny-budget tier-1 mode (seconds, loopback) asserting
   the record schema and that p50/p99/throughput reached the registry.
 
@@ -74,6 +83,7 @@ def _registry_handles(leg: str):
         "ok": req.labels(leg=leg, outcome="ok"),
         "shed": req.labels(leg=leg, outcome="shed"),
         "error": req.labels(leg=leg, outcome="error"),
+        "retried": req.labels(leg=leg, outcome="retried"),
         "latency": lat.labels(leg=leg),
         "lat_family": lat,
     }
@@ -90,6 +100,10 @@ class _Client:
         self._mk = lambda: http.client.HTTPConnection(
             host, port, timeout=timeout)
         self._conn = None
+        #: Retry-After seconds from the last 503, or None — the
+        #: backpressure contract: a shed tells the client WHEN to
+        #: come back, and an honoring client waits exactly that
+        self.retry_after: Optional[float] = None
 
     def post(self, body: bytes) -> int:
         for attempt in (0, 1):      # one reconnect on a dropped conn
@@ -101,6 +115,11 @@ class _Client:
                     {"Content-Type": "application/json"})
                 resp = self._conn.getresponse()
                 resp.read()
+                ra = resp.getheader("Retry-After")
+                try:
+                    self.retry_after = float(ra) if ra else None
+                except ValueError:
+                    self.retry_after = None
                 return resp.status
             except OSError:
                 try:
@@ -124,10 +143,17 @@ class _Client:
 def drive_leg(url: str, leg: str, rate: float, duration: float,
               rows: int, sample_shape, seed: int = 7,
               workers: int = 64, timeout: float = 30.0,
-              warmup: int = 4, max_lag: float = 0.25) -> Dict[str, Any]:
+              warmup: int = 4, max_lag: float = 0.25,
+              honor_retry_after: bool = False) -> Dict[str, Any]:
     """One open-loop phase: poisson arrivals at `rate`/s for `duration`
     seconds of `rows`-row requests. Returns the phase summary with the
-    percentiles READ BACK from the registry."""
+    percentiles READ BACK from the registry.
+
+    `honor_retry_after`: on a 503 the lane waits the server's
+    Retry-After (capped — a lane is not a parking lot) and retries
+    ONCE; a retry that lands counts as the distinct `retried` outcome,
+    never as `ok` (the first-try latency story stays honest) and never
+    hammers (exactly one retry, exactly when told)."""
     import numpy as np
 
     from veles_tpu.telemetry import metrics as tm
@@ -148,7 +174,8 @@ def drive_leg(url: str, leg: str, rate: float, duration: float,
     arrivals = arrivals[arrivals <= duration]
     q: "queue.Queue[Optional[float]]" = queue.Queue()
     t0 = time.perf_counter()
-    counts = {"ok": 0, "shed": 0, "error": 0, "missed": 0}
+    counts = {"ok": 0, "shed": 0, "error": 0, "retried": 0,
+              "missed": 0}
     lock = threading.Lock()
 
     def worker() -> None:
@@ -175,8 +202,19 @@ def drive_leg(url: str, leg: str, rate: float, duration: float,
                 ts = time.perf_counter()
                 status = cli.post(body)
                 dt = time.perf_counter() - ts
-                outcome = ("ok" if status == 200
-                           else "shed" if status == 503 else "error")
+                if status == 200:
+                    outcome = "ok"
+                elif status == 503 and honor_retry_after:
+                    # wait exactly as told (capped), retry exactly once
+                    time.sleep(min(cli.retry_after or 1.0, 2.0))
+                    status = cli.post(body)
+                    outcome = ("retried" if status == 200
+                               else "shed" if status == 503
+                               else "error")
+                elif status == 503:
+                    outcome = "shed"
+                else:
+                    outcome = "error"
                 h[outcome].inc()
                 if outcome == "ok":
                     h["latency"].observe(dt)
@@ -211,10 +249,13 @@ def drive_leg(url: str, leg: str, rate: float, duration: float,
         "ok": counts["ok"],
         "shed": counts["shed"],
         "errors": counts["error"],
+        "retried": counts["retried"],
         "missed": counts["missed"],
         "rows_per_request": rows,
-        "throughput_rps": round(counts["ok"] / wall, 2),
-        "throughput_rows_s": round(counts["ok"] * rows / wall, 1),
+        "throughput_rps": round(
+            (counts["ok"] + counts["retried"]) / wall, 2),
+        "throughput_rows_s": round(
+            (counts["ok"] + counts["retried"]) * rows / wall, 1),
         "p50_s": p50,
         "p99_s": p99,
     }
@@ -391,6 +432,169 @@ def _run_swap(args, record: Dict[str, Any]) -> bool:
     return ok
 
 
+def _run_fleet(args, record: Dict[str, Any]) -> bool:
+    """The elasticity proof (ISSUE 19): self-host N ring replicas over
+    ONE workflow (shared AOT cache: replicas 2..N start with zero
+    compiles) behind a beacon-discovered ServingRouter, measure a
+    single-replica baseline leg THROUGH the router, then drive the
+    ramp staircase against the full fleet while an orchestrator
+    HARD-KILLS one replica (server down, beacon silent — the router
+    must degrade via retry + circuit + TTL eviction) and JOINS a fresh
+    replica mid-stream. Gates: zero failed (non-shed, non-retried)
+    requests across every fleet leg, and fleet throughput per nominal
+    replica >= `--min-replica-ratio` x the baseline."""
+    import tempfile
+
+    from veles_tpu.resilience.mirror import DirMirror
+    from veles_tpu.serving import InferenceServer
+    from veles_tpu.serving_router import (ReplicaBeacon, RouterCore,
+                                          ServingRouter)
+
+    wf = _build_workflow(args.width, args.sample, 4, depth=args.depth)
+    mirror = DirMirror(tempfile.mkdtemp(prefix="veles_fleet_mirror_"))
+    n = max(1, args.replicas)
+    replicas: Dict[str, Any] = {}     # rid -> (server, beacon)
+    events: List[Dict[str, Any]] = []
+
+    def _spawn(rid: str) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        srv = InferenceServer(
+            wf, max_batch=args.batch, queue_limit=args.queue_limit,
+            dispatch="ring", ring_slots=args.ring,
+            quantize=args.quantize, replica=rid).start()
+        beacon = ReplicaBeacon(
+            mirror, rid, f"http://127.0.0.1:{srv.port}",
+            health=srv.health, interval_s=0.3).start()
+        replicas[rid] = (srv, beacon)
+        return {"rid": rid, "port": srv.port,
+                "aot": srv.model_info().get("aot"),
+                "start_s": round(time.perf_counter() - t0, 3)}
+
+    def _await_routable(router, want: int, timeout: float = 15.0
+                        ) -> int:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout:
+            if router.health()["routable"] >= want:
+                break
+            time.sleep(0.05)
+        return router.health()["routable"]
+
+    spawns = [_spawn("r0")]
+    # short TTL so the killed replica's eviction lands INSIDE the
+    # window (production keeps the generous default; the proof needs
+    # to witness the sweep, not wait 20s for it)
+    router = ServingRouter(mirror, poll_s=0.3,
+                           core=RouterCore(beacon_ttl_s=3.0),
+                           backoff_base=0.02,
+                           backoff_cap=0.1).start()
+    url = f"http://127.0.0.1:{router.port}"
+    phases = _phases(args)
+    if not args.ramp:
+        # no explicit staircase: offer the fleet N x the baseline rate
+        # (the near-linear claim needs a load only N replicas can take)
+        phases = [{"rate": args.rate * n, "duration": args.duration}]
+    total_ramp = sum(p["duration"] for p in phases)
+
+    def _orchestrate(t_start: float) -> None:
+        # kill at ~40% of the ramp, join at ~65% — both mid-phase so
+        # the staircase legs straddle the membership changes
+        plan = [(0.40, "kill", "r1"), (0.65, "join", f"r{n}")]
+        for frac, kind, rid in plan:
+            if kind == "kill" and rid not in replicas:
+                continue          # single-replica smoke: nothing to kill
+            delay = t_start + frac * total_ramp - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            ev: Dict[str, Any] = {
+                "kind": kind, "rid": rid,
+                "at_s": round(time.perf_counter() - t_start, 3)}
+            try:
+                if kind == "kill":
+                    srv, beacon = replicas.pop(rid)
+                    beacon.silence()    # crash: no 'gone' goodbye
+                    srv.stop(drain_s=0)
+                else:
+                    ev.update(_spawn(rid))
+            except Exception as e:  # noqa: BLE001 — a failed chaos
+                # event is recorded and judged, never kills the window
+                ev["error"] = f"{type(e).__name__}: {e!s:.200}"
+            events.append(ev)
+
+    try:
+        got = _await_routable(router, 1)
+        if got < 1:
+            raise RuntimeError("router never discovered r0")
+        base = drive_leg(url, "fleet_baseline", args.rate,
+                         args.duration, args.rows, (args.sample,),
+                         seed=args.seed, workers=args.workers,
+                         honor_retry_after=True)
+        record["legs"]["fleet_baseline"] = base
+        for i in range(1, n):
+            spawns.append(_spawn(f"r{i}"))
+        _await_routable(router, n)
+        t_start = time.perf_counter()
+        orch = threading.Thread(target=_orchestrate, daemon=True,
+                                args=(t_start,),
+                                name="fleet-orchestrator")
+        orch.start()
+        fleet_legs = []
+        for i, ph in enumerate(phases):
+            leg = drive_leg(url, f"fleet_ph{i}", ph["rate"],
+                            ph["duration"], args.rows, (args.sample,),
+                            seed=args.seed + i, workers=args.workers,
+                            honor_retry_after=True)
+            record["legs"][leg["leg"]] = leg
+            fleet_legs.append(leg)
+        orch.join(timeout=30)
+        fleet_view = router.fleet()
+        # per-replica dispatch outcomes from the router's own labeled
+        # registry family — the record derives from a /metrics scrape
+        from veles_tpu.telemetry import metrics as tm
+        fam = tm.default_registry().counter(
+            "veles_router_dispatch_total")
+        dispatches: Dict[str, Dict[str, float]] = {}
+        for labels, child in sorted(getattr(fam, "_children",
+                                            {}).items()):
+            d = dict(zip(fam.labelnames, labels))
+            dispatches.setdefault(d.get("replica", "?"), {})[
+                d.get("outcome", "?")] = child.value
+    finally:
+        router.stop()
+        for srv, beacon in list(replicas.values()):
+            beacon.stop()
+            srv.stop(drain_s=1)
+
+    served = sum(lg["ok"] + lg["retried"] for lg in fleet_legs)
+    wall = sum(lg["duration_s"] for lg in fleet_legs)
+    errors = sum(lg["errors"] for lg in fleet_legs)
+    fleet_rps = served / wall if wall else 0.0
+    per_replica = fleet_rps / n
+    ratio = (per_replica / base["throughput_rps"]
+             if base["throughput_rps"] else 0.0)
+    zero_failed = errors == 0 and base["errors"] == 0
+    killed = [e for e in events if e["kind"] == "kill"
+              and "error" not in e]
+    joined = [e for e in events if e["kind"] == "join"
+              and "error" not in e]
+    ok = (zero_failed and ratio >= args.min_replica_ratio
+          and (n < 2 or len(killed) >= 1) and len(joined) >= 1)
+    record["fleet"] = {
+        "replicas": n,
+        "spawns": spawns,
+        "events": events,
+        "baseline_rps": base["throughput_rps"],
+        "fleet_rps": round(fleet_rps, 2),
+        "per_replica_rps": round(per_replica, 2),
+        "replica_ratio": round(ratio, 3),
+        "min_replica_ratio": args.min_replica_ratio,
+        "dispatch_by_replica": dispatches,
+        "final_fleet": fleet_view,
+        "zero_failed_requests": zero_failed,
+        "pass": ok,
+    }
+    return ok
+
+
 def _phases(args) -> List[Dict[str, float]]:
     if args.ramp:
         out = []
@@ -417,6 +621,19 @@ def main(argv=None) -> int:
                     help="--swap: watcher poll interval, seconds "
                          "(tight so the proof fits one short window; "
                          "production default is 10s)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="elasticity proof: N beacon-discovered "
+                         "replicas behind the ServingRouter, baseline "
+                         "leg then the ramp with a hard replica kill + "
+                         "a join mid-stream; asserts zero failed "
+                         "(non-shed) requests and near-linear "
+                         "per-replica throughput (record defaults to "
+                         "FLEET_RECORD.json)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="--fleet: replica count (acceptance runs >= 3)")
+    ap.add_argument("--min-replica-ratio", type=float, default=0.8,
+                    help="--fleet SLO: fleet rps / replicas must reach "
+                         "this multiple of the single-replica baseline")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-budget tier-1 mode (loopback, seconds)")
     ap.add_argument("--rate", type=float, default=400.0,
@@ -480,6 +697,11 @@ def main(argv=None) -> int:
         # some other leg's traffic
         ap.error("--swap drives its own single-window swap plan: it "
                  "conflicts with --ab, --ramp and --url")
+    if args.fleet and (args.ab or args.swap or args.url):
+        # --fleet self-hosts the router + replica fleet (it composes
+        # with --ramp: the staircase is the fleet's drive schedule)
+        ap.error("--fleet self-hosts the routed fleet: it conflicts "
+                 "with --ab, --swap and --url")
     if args.smoke:
         # tiny budget: the tier-1 assertion is the record schema + the
         # registry read-back, not a measured claim
@@ -494,11 +716,16 @@ def main(argv=None) -> int:
         if args.swap:
             # the three swap events need room inside the window
             args.duration = max(args.duration, 4.0)
+        if args.fleet:
+            # the kill + join need room; 2 replicas keep it tiny
+            args.replicas = min(args.replicas, 2)
+            args.duration = max(args.duration, 3.0)
 
     record: Dict[str, Any] = {
         "schema": SCHEMA, "version": VERSION,
         "mode": ("ab" if args.ab else
                  "swap" if args.swap else
+                 "fleet" if args.fleet else
                  "smoke" if args.smoke else
                  "ramp" if args.ramp else "single"),
         "workload": {"rows": args.rows, "batch": args.batch,
@@ -514,6 +741,9 @@ def main(argv=None) -> int:
         if args.swap:
             if not _run_swap(args, record):
                 status = "swap_failed"
+        elif args.fleet:
+            if not _run_fleet(args, record):
+                status = "fleet_failed"
         elif args.url:
             shape = None  # external server: /info tells us the shape
             with urllib.request.urlopen(args.url + "/info",
@@ -611,11 +841,14 @@ def main(argv=None) -> int:
         from veles_tpu.telemetry import metrics as tm
         record["registry"] = [
             ln for ln in tm.default_registry().exposition().splitlines()
-            if ln.startswith(("veles_loadtest", "veles_serving"))]
+            if ln.startswith(("veles_loadtest", "veles_serving",
+                              "veles_router"))]
     except Exception:  # noqa: BLE001
         pass
     path = args.record or os.environ.get(RECORD_ENV) \
-        or ("SWAP_RECORD.json" if args.swap else "LOADTEST_RECORD.json")
+        or ("SWAP_RECORD.json" if args.swap
+            else "FLEET_RECORD.json" if args.fleet
+            else "LOADTEST_RECORD.json")
     try:
         with open(path, "w") as f:
             json.dump(record, f, indent=1, sort_keys=True)
@@ -629,10 +862,17 @@ def main(argv=None) -> int:
                          "applied": record["swap"]["swaps_applied"],
                          "refused": record["swap"]["swaps_refused"]}
                         if "swap" in record else None),
+               "fleet": ({"pass": record["fleet"]["pass"],
+                          "replicas": record["fleet"]["replicas"],
+                          "ratio": record["fleet"]["replica_ratio"],
+                          "zero_failed":
+                              record["fleet"]["zero_failed_requests"]}
+                         if "fleet" in record else None),
                "legs": {k: {"rps": v.get("throughput_rps"),
                             "p50_s": v.get("p50_s"),
                             "p99_s": v.get("p99_s"),
-                            "ok": v.get("ok"), "shed": v.get("shed")}
+                            "ok": v.get("ok"), "shed": v.get("shed"),
+                            "retried": v.get("retried")}
                         for k, v in record["legs"].items()}}
     print("LOADTEST " + json.dumps(compact, sort_keys=True), flush=True)
     return 0 if status == "ok" else 1

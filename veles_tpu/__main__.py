@@ -186,7 +186,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "server at URL — re-point its ring at the "
                         "PREVIOUS weight generation — print the "
                         "response and exit (no workflow argument; "
-                        "token from VELES_WEB_TOKEN)")
+                        "token from VELES_WEB_TOKEN). Pointed at a "
+                        "--route front door it fans out to every live "
+                        "replica and reports per-replica outcomes")
+    p.add_argument("--serve-replicas", type=int, default=None,
+                   metavar="N",
+                   help="run N independent serving replicas in this "
+                        "process (each its own slot ring, port "
+                        "[--serve PORT -> PORT..PORT+N-1], generation "
+                        "ledger, watcher and metric labels; shared AOT "
+                        "cache so replicas 2..N start with 0 "
+                        "compiles). Combine with --serve")
+    p.add_argument("--serve-announce", default=None, metavar="SPEC",
+                   help="announce each serving replica on this mirror "
+                        "bus (the --mirror grammar) as a presence "
+                        "beacon, so a --route front door discovers it "
+                        "— join-mid-run needs no config push. Combine "
+                        "with --serve")
+    p.add_argument("--route", default=None, metavar="SPEC",
+                   help="fleet front door (no workflow, no jax): "
+                        "discover serving replicas announced on this "
+                        "mirror bus and route POST /predict across "
+                        "them by live capacity — bounded "
+                        "retry/backoff, per-replica circuit breaker, "
+                        "p99 hedging, drain awareness; POST /rollback "
+                        "fans out fleet-wide (docs/SERVING.md "
+                        "'Fleet'; token from VELES_WEB_TOKEN)")
+    p.add_argument("--route-port", type=int, default=None,
+                   metavar="PORT",
+                   help="listen port for --route (default: auto)")
     p.add_argument("--pp", type=int, default=None, metavar="MICROBATCHES",
                    help="train as a GPipe pipeline over the local devices "
                         "(one stage per device) with this many microbatches")
@@ -521,6 +549,29 @@ def _serve_rollback(url: str) -> int:
     return 0
 
 
+def _route(args) -> int:
+    """Fleet front-door mode (ISSUE 19): stand up a ServingRouter over
+    the replica beacons on the given mirror bus and serve until
+    interrupted. No workflow import, no jax — a router must run on a
+    box that can't build the model (same discipline as
+    --serve-rollback)."""
+    import time
+
+    from veles_tpu.resilience.mirror import get_mirror
+    from veles_tpu.serving_router import ServingRouter
+    token = os.environ.get("VELES_WEB_TOKEN")
+    router = ServingRouter(get_mirror(args.route, token=token),
+                           port=args.route_port or 0,
+                           token=token).start()
+    print(f"ROUTING http://127.0.0.1:{router.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        router.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     # intermixed parsing: this environment's argparse otherwise refuses
     # trailing `root.a.b=value` overrides once any optional flag
@@ -540,9 +591,26 @@ def main(argv=None) -> int:
             raise SystemExit("--serve-rollback is a client mode: it "
                              "takes no workflow argument")
         return _serve_rollback(args.serve_rollback)
+    if args.route:
+        # router mode: beacon discovery + HTTP, before any workflow
+        # import or backend touch — the front door must run on a box
+        # that can't even build the model
+        if args.workflow:
+            raise SystemExit("--route is a router mode: it takes no "
+                             "workflow argument")
+        if args.daemon:
+            daemon_pid = _daemonize(
+                args.daemon, argv if argv is not None else sys.argv[1:])
+            print(daemon_pid, flush=True)
+            return 0
+        set_verbosity(args.verbose)
+        return _route(args)
+    if args.route_port is not None:
+        raise SystemExit("--route-port configures the fleet router: "
+                         "combine with --route")
     if not args.workflow:
-        raise SystemExit("workflow module required "
-                         "(or --serve-rollback URL for client mode)")
+        raise SystemExit("workflow module required (or --serve-rollback "
+                         "URL / --route SPEC for workflow-less modes)")
     if args.daemon:
         daemon_pid = _daemonize(
             args.daemon, argv if argv is not None else sys.argv[1:])
@@ -613,6 +681,8 @@ def main(argv=None) -> int:
         serve_quantize=args.serve_quantize,
         serve_mesh=args.serve_mesh, serve_batch=args.serve_batch,
         serve_watch_mirror=args.serve_watch_mirror,
+        serve_replicas=args.serve_replicas,
+        serve_announce=args.serve_announce,
         accum=args.accum, report=args.report,
         tp=args.tp, sp=args.sp, ep=args.ep,
         compile_cache=not args.no_compile_cache,

@@ -158,12 +158,13 @@ _RAW_CLOCK_CALLS = ("time.time", "time.monotonic", "time.sleep",
 def _is_clocked_path(path: str) -> bool:
     """The raw-clock rule's scope: the cluster/supervisor protocol
     plane (anything under `resilience/`) plus the serving-side watch
-    loop — the code the model checker runs against a virtual clock.
-    clock.py itself is IN scope and carries explicit suppressions: it
-    is the one blessed home for the delegating time.* calls."""
+    loop and the fleet router — the code the model checker runs
+    against a virtual clock. clock.py itself is IN scope and carries
+    explicit suppressions: it is the one blessed home for the
+    delegating time.* calls."""
     parts = re.split(r"[/\\]", path)
     return any(p == "resilience" for p in parts[:-1]) \
-        or parts[-1] == "serving_watch.py"
+        or parts[-1] in ("serving_watch.py", "serving_router.py")
 
 #: method names that ARE the per-minibatch hot path of a unit
 _HOT_METHODS = ("run", "xla_run")

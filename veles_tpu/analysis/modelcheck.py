@@ -1,5 +1,6 @@
 """Pass 8 — protocol model checker: exhaustive bounded-interleaving
-exploration of the election / membership / hot-swap planes.
+exploration of the election / membership / hot-swap / fleet-routing
+planes.
 
 The chaos matrix (tools/chaos.py) kills real processes and checks that
 ONE schedule recovers; the concurrency pass (pass 5) reasons statically
@@ -21,8 +22,11 @@ sweep, gather mode, `_membership_bump`/`_initiate_restart` and
 `quorum_snapshot`, member `step()` (fencing, failover, isolation
 fail-stop), `_seek_coordinator`/`_try_adopt`/`_promote`,
 `_publish_beacon`/`_live_hosts`, `WeightWatcher.poll_once` (scan,
-pinning, deterministic-refusal memory) and `GenerationLedger`
-(commit/rollback/pinning). Simulated (via the seams those classes
+pinning, deterministic-refusal memory), `GenerationLedger`
+(commit/rollback/pinning) and the serving-fleet `RouterCore`
+(beacon registry, capacity pick, circuit breaker, drain discipline —
+serving_router.py is clock-clean and takes `now` parameters exactly so
+this pass can drive it). Simulated (via the seams those classes
 expose — `_mirror`, `_bind_http`, `_bind_coordinator`, `_post`,
 `_spawn`, `_children_status`, `_local_snapshots`, `_resolve_snapshot`,
 `_obtain`, the injected `Clock`): processes, files, sockets and time.
@@ -46,6 +50,12 @@ The invariant ledger (checked after every action)
                            watcher-re-applied.
 8. mc-floor-failstop       a fleet below the floor fail-stops at
                            quiescence instead of wedging or running.
+9. mc-no-route-to-drained  once the router has OBSERVED a replica's
+                           draining/deregistration beacon, no routed
+                           request lands on that replica (ISSUE 19's
+                           drain protocol; a drain the router never
+                           saw — lost beacon, torn read — is out of
+                           scope by construction).
 
 Determinism and reduction
 -------------------------
@@ -79,6 +89,7 @@ from veles_tpu.resilience.clock import VirtualClock
 from veles_tpu.resilience.cluster import (COORD_META, ClusterCoordinator,
                                           ClusterMember)
 from veles_tpu.serving_gen import GenerationLedger
+from veles_tpu.serving_router import BEACON_PREFIX, RouterCore, beacon_name
 from veles_tpu.serving_watch import WeightWatcher
 
 __all__ = ["MUTANTS", "SCENARIOS", "ExploreResult", "Violation",
@@ -193,6 +204,17 @@ class SimMirror:
         if pick == 1:
             return None
         return dict(rec)
+
+    def meta_names(self, prefix: str = "") -> List[str]:
+        """Beacon discovery listing (serving_router contract): empty on
+        an unreachable mirror — the `unlistable` fault models exactly
+        that outage, and the router must coast on last-known state."""
+        pick = self.world.choice(
+            f"meta-list:{self.world.current_host()}",
+            ("ok", "unlistable"), fault=True)
+        if pick == 1:
+            return []
+        return sorted(n for n in self.metas if n.startswith(prefix))
 
     def entries(self) -> List[Dict[str, Any]]:
         return [{"name": n, "digest": s["claimed"], "mtime": s["mtime"]}
@@ -1180,6 +1202,197 @@ class HotSwapWorld(SimWorld):
         return hashlib.md5(blob.encode()).hexdigest()
 
 
+class RoutesToDrainingCore(RouterCore):
+    """Seeded mutant (invariant 9): drain awareness dropped — the pick
+    treats a draining replica as routable (the bug the beacon protocol
+    exists to prevent: deregistration the router ignores)."""
+
+    def _eligible(self, st, now):
+        keep = st.status
+        if st.status == "draining":
+            st.status = "up"
+        try:
+            return super()._eligible(st, now)
+        finally:
+            st.status = keep
+
+
+class FleetWorld(SimWorld):
+    """The serving-fleet routing plane (ISSUE 19): three replica
+    beacon publishers and the REAL `RouterCore` consuming them through
+    the simulated mirror. Replicas beat, drain gracefully or crash to
+    silence; the router polls (listing may fail — mirror outage — and
+    any read may tear) and routes. Invariant 9: once a poll has
+    OBSERVED a replica draining, no route lands there. Quiescence also
+    checks the TTL sweep: a crash-silenced replica must be evicted
+    once enough virtual time passes — a stale beacon file re-read must
+    not count as liveness."""
+
+    #: virtual seconds each poll advances; the TTL is sized so the
+    #: quiesce polls alone cross it after a silence
+    POLL_ADVANCE_S = 1.0
+    TTL_S = 4.0
+
+    def __init__(self, sched: Scheduler, mutant: Optional[str]) -> None:
+        super().__init__(sched, mutant)
+        core_cls = (RoutesToDrainingCore if mutant == "route_to_drained"
+                    else RouterCore)
+        self.core = core_cls(beacon_ttl_s=self.TTL_S, open_s=2.0)
+        self.rids = ("r0", "r1", "r2")
+        self.rep_status = {r: "up" for r in self.rids}
+        self.rep_seq = {r: 0 for r in self.rids}
+        self.rep_silent_at: Dict[str, Optional[float]] = {
+            r: None for r in self.rids}
+        #: ground truth: drains the router has actually SEEN (applied
+        #: from a successfully-read beacon) — a lost/torn drain beacon
+        #: leaves the replica legitimately routable
+        self.gt_drained: set = set()
+        self.beats_left = {r: 2 for r in self.rids}
+        self.routes_left = 5
+        self.drains_left = 1
+        self.silences_left = 1
+        self.polls = 0
+        # seed: every replica announced and discovered (faults belong
+        # to scheduled actions, not to world seeding)
+        self.seeding = True
+        for r in self.rids:
+            self._beat(r)
+        self._poll()
+        self.seeding = False
+
+    # -- replica side ---------------------------------------------------------
+
+    def _beat(self, rid: str) -> None:
+        self.rep_seq[rid] += 1
+        self._actor.append(rid)
+        try:
+            self.mirror.put_meta(beacon_name(rid), {
+                "rid": rid, "url": f"sim://{rid}",
+                "status": self.rep_status[rid],
+                "seq": self.rep_seq[rid], "capacity": 4.0})
+        finally:
+            self._actor.pop()
+
+    def _drain(self, rid: str) -> None:
+        self.drains_left -= 1
+        self.rep_status[rid] = "draining"
+        self.events.append({"ev": "drain", "rid": rid})
+        self._beat(rid)
+
+    def _silence(self, rid: str) -> None:
+        self.silences_left -= 1
+        self.rep_silent_at[rid] = self.clock.monotonic()
+        self.events.append({"ev": "silence", "rid": rid})
+
+    # -- router side ----------------------------------------------------------
+
+    def _poll(self) -> None:
+        self.polls += 1
+        self.clock.advance(self.POLL_ADVANCE_S)
+        now = self.clock.monotonic()
+        self._actor.append("router")
+        try:
+            for name in self.mirror.meta_names(BEACON_PREFIX):
+                rec = self.mirror.get_meta(name)
+                if isinstance(rec, dict):
+                    self.core.observe_beacon(rec, now)
+            self.core.evict_silent(now)
+        finally:
+            self._actor.pop()
+        for rid, st in self.core.replicas.items():
+            if st.status == "draining":
+                self.gt_drained.add(rid)
+
+    def _route(self) -> None:
+        self.routes_left -= 1
+        now = self.clock.monotonic()
+        rid = self.core.pick(now)
+        self.events.append({"ev": "route", "to": rid})
+        if rid is None:
+            return                # shed: fine, never a wrong route
+        if rid in self.gt_drained:
+            raise Violation(
+                "mc-no-route-to-drained", 9,
+                f"router routed a request to {rid} after observing "
+                f"its draining beacon — drain discipline is gone")
+        self.core.note_dispatch(rid)
+        pick = self.choice(f"dispatch:{rid}", ("ok", "fail", "shed"),
+                           fault=True)
+        if pick == 1:
+            self.core.note_fail(rid, now)
+        elif pick == 2:
+            self.core.note_shed(rid, 2.0, now)
+        else:
+            self.core.note_ok(rid, 0.05)
+
+    # -- scenario hooks -------------------------------------------------------
+
+    def enabled_actions(self):
+        acts: List[Tuple[str, Callable[[], None]]] = [
+            ("poll", self._poll)]
+        if self.routes_left > 0:
+            acts.append(("route", self._route))
+        if self.drains_left > 0 and self.rep_status["r0"] == "up":
+            acts.append(("drain:r0", lambda: self._drain("r0")))
+        if self.silences_left > 0:
+            acts.append(("silence:r2", lambda: self._silence("r2")))
+        for rid in self.rids:
+            if self.beats_left[rid] > 0 \
+                    and self.rep_silent_at[rid] is None:
+                acts.append((f"beat:{rid}",
+                             lambda r=rid: self._beat_action(r)))
+        return acts
+
+    def _beat_action(self, rid: str) -> None:
+        self.beats_left[rid] -= 1
+        self._beat(rid)
+
+    def check_state(self) -> None:
+        pass                      # the route action checks inline
+
+    def quiesce(self, rounds: int = 6) -> None:
+        self.sched.quiescing = True
+        for _ in range(rounds):
+            self._poll()
+        for _ in range(2):
+            if self.routes_left > 0:
+                self._route()
+
+    def check_final(self) -> None:
+        now = self.clock.monotonic()
+        for rid, t in self.rep_silent_at.items():
+            if t is None:
+                continue
+            if now - t > self.TTL_S + self.POLL_ADVANCE_S \
+                    and rid in self.core.replicas:
+                raise Violation(
+                    "mc-no-route-to-drained", 9,
+                    f"crash-silenced replica {rid} still registered "
+                    f"{now - t:.0f}s after its last beacon advance — "
+                    f"the stale beacon record is being counted as "
+                    f"liveness, so the TTL sweep never fires")
+
+    def fingerprint(self) -> str:
+        st = {
+            "rep": [(r, self.rep_status[r], self.rep_seq[r],
+                     self.rep_silent_at[r]) for r in self.rids],
+            "core": [(rid, s.status, s.seq, s.circuit, s.fails,
+                      s.inflight, round(s.not_before, 3),
+                      round(s.last_seen, 3))
+                     for rid, s in sorted(self.core.replicas.items())],
+            "rr": self.core._rr,
+            "tomb": sorted(self.core._tombstones.items()),
+            "gt": sorted(self.gt_drained),
+            "beats": sorted(self.beats_left.items()),
+            "routes": self.routes_left, "drains": self.drains_left,
+            "silences": self.silences_left, "polls": self.polls,
+            "metas": sorted(self.mirror.metas),
+            "faults": self.sched.faults_used,
+        }
+        blob = json.dumps(st, sort_keys=True, default=str)
+        return hashlib.md5(blob.encode()).hexdigest()
+
+
 # -- scenario / mutant registries ---------------------------------------------
 
 @dataclass
@@ -1218,6 +1431,13 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
         10,
         "trainer pushes, watcher polls, operator rollbacks and ring "
         "rounds interleave against the real GenerationLedger"),
+    Scenario(
+        "fleet",
+        lambda sched, mutant: FleetWorld(sched, mutant),
+        10,
+        "3-replica serving fleet: beacons beat / drain / crash to "
+        "silence while the real RouterCore polls (lossy listing, torn "
+        "reads) and routes; drain discipline + TTL sweep"),
 )}
 
 
@@ -1313,6 +1533,13 @@ MUTANTS: Dict[str, Dict[str, Any]] = {
                        "ex-coordinator host and the successor's host "
                        "both write one generation (regression witness "
                        "for the shipped fix)"},
+    "route_to_drained": {
+        "scenario": "fleet", "invariant": 9,
+        "rule": "mc-no-route-to-drained",
+        "explore": {"budget": 600, "max_faults": 0},
+        "description": "router drain awareness dropped — a replica "
+                       "the router saw deregister keeps receiving "
+                       "routed requests"},
 }
 
 

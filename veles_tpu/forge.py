@@ -249,8 +249,9 @@ class ForgeServer:
 
         self._srv = ThreadingHTTPServer((host, port), Handler)
         self.port = self._srv.server_port
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        self._thread = threading.Thread(
+            target=lambda: self._srv.serve_forever(poll_interval=0.05),
+            daemon=True)
 
     def start(self) -> "ForgeServer":
         self._thread.start()

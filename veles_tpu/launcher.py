@@ -46,6 +46,8 @@ class Launcher(Logger):
                  serve_mesh: Optional[str] = None,
                  serve_batch: Optional[int] = None,
                  serve_watch_mirror: Optional[str] = None,
+                 serve_replicas: Optional[int] = None,
+                 serve_announce: Optional[str] = None,
                  accum: Optional[int] = None, report: str = "",
                  tp: Optional[int] = None, sp: Optional[int] = None,
                  ep: bool = False, compile_cache: bool = True,
@@ -114,14 +116,20 @@ class Launcher(Logger):
                 v is not None for v in (serve_ring, serve_dispatch,
                                         serve_quantize, serve_mesh,
                                         serve_batch,
-                                        serve_watch_mirror)):
+                                        serve_watch_mirror,
+                                        serve_replicas,
+                                        serve_announce)):
             raise SystemExit(
                 "--serve-ring/--serve-dispatch/--serve-quantize/"
-                "--serve-mesh/--serve-batch/--serve-watch-mirror "
+                "--serve-mesh/--serve-batch/--serve-watch-mirror/"
+                "--serve-replicas/--serve-announce "
                 "configure the serving tier: combine with --serve")
         if serve_ring is not None and serve_ring < 1:
             raise SystemExit(f"--serve-ring needs N >= 1 "
                              f"(got {serve_ring})")
+        if serve_replicas is not None and serve_replicas < 1:
+            raise SystemExit(f"--serve-replicas needs N >= 1 "
+                             f"(got {serve_replicas})")
         if serve_batch is not None and serve_batch < 1:
             raise SystemExit(f"--serve-batch needs N >= 1 "
                              f"(got {serve_batch})")
@@ -165,6 +173,13 @@ class Launcher(Logger):
         #: mirror spec (dir or http(s) URL) the serving tier polls for
         #: new digest-addressed snapshots to hot-swap (ISSUE 16)
         self.serve_watch_mirror = serve_watch_mirror
+        #: fleet knobs (ISSUE 19): N independent slot rings in this
+        #: process (replica != process — each with its own port,
+        #: ledger, watcher and metric labels, sharing ONE AOT cache so
+        #: replica 2..N start with zero compiles), and the mirror bus
+        #: the replicas announce themselves on for router discovery
+        self.serve_replicas = serve_replicas or 1
+        self.serve_announce = serve_announce
         #: GPipe pipeline mode: microbatch count (stages = local devices)
         if pp is not None and pp < 1:
             raise SystemExit(f"--pp needs a microbatch count >= 1 "
@@ -572,7 +587,12 @@ class Launcher(Logger):
                     # runs have no stepped driver to bracket
                     profile_controller=(
                         _ttracer.profile_controller()
-                        if self.serve_port is None else None))
+                        if self.serve_port is None else None),
+                    # VELES_WEB_FLEET=http://host:port points the
+                    # dashboard at a serving router (--route): the
+                    # status page then carries the per-replica fleet
+                    # table (generation digest/age, capacity, circuit)
+                    fleet_source=os.environ.get("VELES_WEB_FLEET"))
                 self._web.start()
             else:
                 # workers report into the coordinator's cluster view
@@ -668,33 +688,55 @@ class Launcher(Logger):
                     raise SystemExit(
                         f"--serve: {type(self.workflow).__name__} has no "
                         "fused forward (StandardWorkflow-family only)")
+                import os as _os
+
                 from veles_tpu.serving import InferenceServer
                 self.workflow.initialize(device=self.device, **kwargs)
                 srv_kwargs = {}
                 if self.serve_batch is not None:
                     srv_kwargs["max_batch"] = self.serve_batch
-                srv = InferenceServer(self.workflow,
-                                      port=self.serve_port,
-                                      dispatch=self.serve_dispatch,
-                                      ring_slots=self.serve_ring,
-                                      quantize=self.serve_quantize,
-                                      mesh=self.serve_mesh,
-                                      **srv_kwargs).start()
-                info = srv.model_info()
-                self.info("serving: dispatch=%s ring=%s sharded=%s "
-                          "quantize=%s aot=%s",
-                          info["dispatch"], info["ring_slots"],
+                # replica != process (ISSUE 19): N independent slot
+                # rings in this one process, each with its own port
+                # (explicit --serve PORT -> PORT+i; 0 -> auto), its own
+                # generation ledger/watcher/beacon and its own metric
+                # labels. They share the workflow build and the AOT
+                # cache: replica 0 compiles-or-loads, replicas 1..N-1
+                # deserialize the same signature (0 compiles).
+                n = self.serve_replicas
+                fleet = n > 1 or self.serve_announce is not None
+                # VELES_SERVE_ADVERTISE: the host other fleet members
+                # can reach THIS process at (pod IP / DNS name). It
+                # becomes the beacon URL host and the rid suffix —
+                # container PIDs collide across pods, advertise hosts
+                # don't. Loopback fleets keep the pid suffix.
+                adv = _os.environ.get("VELES_SERVE_ADVERTISE",
+                                      "").strip()
+                rid_suffix = (adv.replace(":", "-") if adv
+                              else str(_os.getpid()))
+                servers = []
+                for i in range(n):
+                    port = self.serve_port + i if self.serve_port else 0
+                    rid = f"r{i}-{rid_suffix}" if fleet else None
+                    servers.append(InferenceServer(
+                        self.workflow, port=port,
+                        dispatch=self.serve_dispatch,
+                        ring_slots=self.serve_ring,
+                        quantize=self.serve_quantize,
+                        mesh=self.serve_mesh,
+                        replica=rid, **srv_kwargs).start())
+                info = servers[0].model_info()
+                self.info("serving: replicas=%d dispatch=%s ring=%s "
+                          "sharded=%s quantize=%s aot=%s",
+                          n, info["dispatch"], info["ring_slots"],
                           info.get("sharded"), info["quantize"],
                           info.get("aot"))
-                watcher = None
+                watchers = []
                 if self.serve_watch_mirror:
-                    # train→serve hot-swap loop (ISSUE 16): poll the
-                    # mirror for new digest-addressed snapshots and
-                    # swap them in between ring rounds. Poll cadence
-                    # via VELES_WATCH_POLL_S (default 10 s — the
-                    # HttpMirror retry budget stays below it).
-                    import os as _os
-
+                    # train→serve hot-swap loop (ISSUE 16): each
+                    # replica polls the mirror for new digest-addressed
+                    # snapshots and swaps them in between ring rounds.
+                    # Poll cadence via VELES_WATCH_POLL_S (default 10 s
+                    # — the HttpMirror retry budget stays below it).
                     from veles_tpu.resilience.mirror import get_mirror
                     from veles_tpu.serving_watch import WeightWatcher
                     try:
@@ -702,20 +744,45 @@ class Launcher(Logger):
                             "VELES_WATCH_POLL_S", "10") or 10)
                     except ValueError:
                         poll_s = 10.0
-                    watcher = WeightWatcher(
-                        srv,
-                        get_mirror(self.serve_watch_mirror,
-                                   token=srv.token),
-                        poll_s=poll_s).start()
-                print(f"SERVING http://127.0.0.1:{srv.port}", flush=True)
+                    for srv in servers:
+                        watchers.append(WeightWatcher(
+                            srv,
+                            get_mirror(self.serve_watch_mirror,
+                                       token=srv.token),
+                            poll_s=poll_s).start())
+                beacons = []
+                if self.serve_announce:
+                    # fleet presence beacons (ISSUE 19): announce each
+                    # replica on the mirror bus so a `--route` front
+                    # door discovers it — no config push, join-mid-run
+                    from veles_tpu.resilience.mirror import get_mirror
+                    from veles_tpu.serving_router import ReplicaBeacon
+                    bus = get_mirror(self.serve_announce,
+                                     token=servers[0].token)
+                    for srv in servers:
+                        beacons.append(ReplicaBeacon(
+                            bus, srv.replica,
+                            f"http://{adv or '127.0.0.1'}:{srv.port}",
+                            health=srv.health).start())
+                for srv in servers:
+                    print(f"SERVING http://127.0.0.1:{srv.port}",
+                          flush=True)
                 try:
                     while True:
                         import time
                         time.sleep(3600)
                 except KeyboardInterrupt:
-                    if watcher is not None:
-                        watcher.stop()
-                    srv.stop()
+                    # drain protocol: announce draining FIRST (the
+                    # router stops picking us), finish in-flight via
+                    # stop()'s drain wait, then say goodbye
+                    for b in beacons:
+                        b.drain()
+                    for w in watchers:
+                        w.stop()
+                    for srv in servers:
+                        srv.stop()
+                    for b in beacons:
+                        b.stop()
                 return 0
             if self.autotune:
                 if not hasattr(self.workflow, "autotune"):

@@ -810,8 +810,8 @@ class ClusterCoordinator(Logger):
         self._flush_announce()
         if self._httpd is not None:
             self._thread = threading.Thread(
-                target=self._httpd.serve_forever, daemon=True,
-                name="cluster-coordinator")
+                target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+                daemon=True, name="cluster-coordinator")
             self._thread.start()
         return self
 

@@ -136,6 +136,16 @@ class Mirror:
         JSON object)."""
         raise NotImplementedError
 
+    def meta_names(self, prefix: str = "") -> List[str]:
+        """Names of the meta records currently published, filtered by
+        `prefix`, sorted. Empty on an unreachable mirror (discovery is
+        best-effort — readers treat a missing listing like an empty
+        one and re-poll). This is what makes OPEN-membership presence
+        beacons possible: the cluster plane knows its host ids up
+        front, but a serving-fleet router must discover replicas it was
+        never told about (join-mid-run) purely from the bus."""
+        raise NotImplementedError
+
     def _corrupt(self, name: str) -> None:
         """Deterministic bit-rot injection hook (mirror_corrupt fault):
         tear the MIRRORED copy while the local one stays intact."""
@@ -287,6 +297,19 @@ class DirMirror(Mirror):
                 return None
             return data if isinstance(data, dict) else None
         return None
+
+    def meta_names(self, prefix: str = "") -> List[str]:
+        # meta records are exactly the non-snapshot files: no ".pickle"
+        # in the name (the entries() invisibility rule), no sidecars,
+        # no in-flight per-writer tmp files
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(
+            n for n in names
+            if ".pickle" not in n and not n.endswith((".sha256", ".tmp"))
+            and n.startswith(prefix))
 
     def _corrupt(self, name: str) -> None:
         from veles_tpu.resilience.faults import corrupt_file
@@ -528,6 +551,26 @@ class HttpMirror(Mirror):
             return None
         return data if isinstance(data, dict) else None
 
+    def meta_names(self, prefix: str = "") -> List[str]:
+        raw = self._get_bytes("?metas=1")
+        if raw is None:
+            return []
+        try:
+            names = json.loads(raw)
+        except (ValueError, UnicodeDecodeError):
+            return []
+        if not isinstance(names, list):
+            return []
+        out = []
+        for n in names:
+            try:
+                n = _safe_name(str(n))
+            except ValueError:
+                continue        # a hostile listing cannot smuggle paths
+            if n.startswith(prefix):
+                out.append(n)
+        return sorted(out)
+
     def _corrupt(self, name: str) -> None:
         """Re-PUT a torn copy over the mirrored file (the server keeps
         whatever bytes the last PUT sent — exactly how real bit rot
@@ -598,7 +641,8 @@ def restore_missing(mirror: "Mirror | str", directory: str,
 
 class MirrorServer:
     """Tiny blob store speaking the HttpMirror protocol: PUT/GET/DELETE
-    `/{name}` plus `GET /?index=1`. Hardened like the other control
+    `/{name}` plus `GET /?index=1` (snapshot listing) and
+    `GET /?metas=1` (meta-record listing). Hardened like the other control
     planes (task_queue/web_status): optional shared token via
     `X-Veles-Token` (constant-time compare), bounded bodies (413),
     sanitized flat names (400). Runs on a thread; `port=0` auto-picks —
@@ -673,6 +717,21 @@ class MirrorServer:
             def do_GET(self):  # noqa: N802
                 if not check_shared_token(self, token):
                     return
+                if "metas=1" in self.path:
+                    # meta-record listing (the serving-fleet beacon
+                    # discovery path): every non-snapshot file, the
+                    # same rule DirMirror.meta_names applies locally
+                    out = sorted(
+                        n for n in os.listdir(outer.root)
+                        if ".pickle" not in n
+                        and not n.endswith((".sha256", ".tmp")))
+                    body = json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if "index=1" in self.path:
                     out = []
                     for n in sorted(os.listdir(outer.root)):
@@ -727,8 +786,8 @@ class MirrorServer:
                                           Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="mirror-server")
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="mirror-server")
         self._thread.start()
         return self
 

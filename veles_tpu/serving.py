@@ -180,11 +180,20 @@ class InferenceServer(Logger):
                  ring_slots: Optional[int] = None,
                  mesh: Any = "auto",
                  quantize: str = "f32",
-                 aot_cache: Any = "auto") -> None:
+                 aot_cache: Any = "auto",
+                 replica: Optional[str] = None) -> None:
         super().__init__()
         self.workflow = workflow
         self.host = host
         self.port = port
+        #: fleet identity (ISSUE 19): a replica is NOT a process — one
+        #: host runs N independent slot rings, each with its own port,
+        #: generation ledger, watcher and metrics labels. None keeps
+        #: the single-replica process exactly as before (unlabeled
+        #: instruments); a name additionally binds the per-replica
+        #: labeled families so a mixed fleet stays tellable apart on
+        #: one scrape.
+        self.replica = str(replica) if replica is not None else None
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         if dispatch not in ("ring", "merge"):
@@ -333,6 +342,33 @@ class InferenceServer(Logger):
             "veles_serving_swap_refused_total")
         self._m_gen_age = _reg.gauge(
             "veles_serving_generation_age_seconds")
+        # per-replica labeled twins (fleet mode only): the process-wide
+        # unlabeled families above stay the aggregate every existing
+        # consumer reads; a named replica ADDITIONALLY feeds labeled
+        # children so the fleet table / FLEET_RECORD can attribute
+        # traffic per ring. Pre-bound here (hot-metric contract).
+        self._mr_requests = self._mr_latency = None
+        self._mr_rejected = self._mr_gen_age = None
+        if self.replica is not None:
+            rl = ("replica",)
+            self._mr_requests = _reg.counter(
+                "veles_serving_replica_requests_total",
+                "predict requests admitted, per fleet replica",
+                labelnames=rl).labels(replica=self.replica)
+            self._mr_rejected = _reg.counter(
+                "veles_serving_replica_rejected_total",
+                "requests shed (overload + drain), per fleet replica",
+                labelnames=rl).labels(replica=self.replica)
+            self._mr_latency = _reg.histogram(
+                "veles_serving_replica_latency_seconds",
+                "predict latency per fleet replica",
+                labelnames=rl,
+                buckets=_tmetrics.LATENCY_BUCKETS).labels(
+                    replica=self.replica)
+            self._mr_gen_age = _reg.gauge(
+                "veles_serving_replica_generation_age_seconds",
+                "live-generation age per fleet replica",
+                labelnames=rl).labels(replica=self.replica)
         self._tr = _ttracer.active()
         self._build()
 
@@ -736,6 +772,8 @@ class InferenceServer(Logger):
             gen = self._gens.commit(digest, source, new_dev)
         self._m_swap_applied.inc()
         self._m_gen_age.set(0.0)
+        if self._mr_gen_age is not None:
+            self._mr_gen_age.set(0.0)
         self.info("hot swap applied: serving generation %s (from %s, "
                   "probe err %.2e)", digest[:12], source, err)
         return gen
@@ -764,6 +802,8 @@ class InferenceServer(Logger):
             gen, outgoing = self._gens.rollback()
         self._m_swap_applied.inc()
         self._m_gen_age.set(0.0)
+        if self._mr_gen_age is not None:
+            self._mr_gen_age.set(0.0)
         self.info("rollback applied: serving generation %s (was %s)",
                   gen["digest"][:12], outgoing["digest"][:12])
         return gen
@@ -835,10 +875,14 @@ class InferenceServer(Logger):
         if self._draining or self._stopping:
             self.n_rejected += 1
             self._m_rejected.inc()
+            if self._mr_rejected is not None:
+                self._mr_rejected.inc()
             raise ServerDraining("server draining")
         if self._inflight >= self.queue_limit:
             self.n_rejected += 1
             self._m_rejected.inc()
+            if self._mr_rejected is not None:
+                self._mr_rejected.inc()
             raise ServerOverloaded(
                 f"overloaded: {self._inflight} requests in flight "
                 f"(queue_limit {self.queue_limit})",
@@ -874,6 +918,8 @@ class InferenceServer(Logger):
             self._shed_locked()
             self._inflight += 1
             self._m_requests.inc()
+            if self._mr_requests is not None:
+                self._mr_requests.inc()
             self._m_inflight.set(self._inflight)
         try:
             # _predict_batched re-checks the dispatcher under _cv —
@@ -888,7 +934,10 @@ class InferenceServer(Logger):
                 self._inflight -= 1
                 self._m_inflight.set(self._inflight)
                 self._cv.notify_all()   # drain waiters watch this count
-            self._m_latency.observe(time.perf_counter() - t_admit)
+            elapsed = time.perf_counter() - t_admit
+            self._m_latency.observe(elapsed)
+            if self._mr_latency is not None:
+                self._mr_latency.observe(elapsed)
         out = out.reshape(n, -1)
         resp: Dict[str, Any] = {"outputs": out.tolist()}
         if self._softmax:
@@ -1172,7 +1221,10 @@ class InferenceServer(Logger):
             gen = dict(self._generation)
             gen["serving_for_s"] = round(now - gen["since"], 3)
             self._m_gen_age.set(now - gen["since"])
+            if self._mr_gen_age is not None:
+                self._mr_gen_age.set(now - gen["since"])
             return {"status": status,
+                    "replica": self.replica,
                     "uptime_s": round(now - self._started_at, 3),
                     "inflight": self._inflight,
                     "pending": len(self._pending),
@@ -1377,8 +1429,9 @@ class InferenceServer(Logger):
                 self._batcher = threading.Thread(
                     target=target, daemon=True, name="batcher")
                 self._batcher.start()
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="inference")
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="inference")
         self._thread.start()
         self.info_log = f"serving on http://{self.host}:{self.port}"
         self.info("inference %s (POST /predict, GET /info; %s dispatch)",

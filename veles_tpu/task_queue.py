@@ -326,8 +326,9 @@ class FitnessQueueServer(Logger, IDistributable):
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="fitness-queue")
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="fitness-queue")
         self._thread.start()
         return self
 

@@ -426,6 +426,31 @@ def register_standard(reg: MetricsRegistry) -> None:
     reg.gauge("veles_serving_generation_age_seconds",
               "seconds the live weight generation has been serving "
               "(resets to 0 at every applied swap/rollback)")
+    # fleet front door (serving_router.py) — present on every router
+    # scrape even before the first beacon lands; the labelnames here
+    # MUST match the router's bindings (the registry re-get contract)
+    reg.counter("veles_router_requests_total",
+                "client requests through the fleet router by terminal "
+                "outcome (ok / shed / error / bad)",
+                labelnames=("outcome",))
+    reg.counter("veles_router_dispatch_total",
+                "per-replica dispatch attempts by outcome (ok / fail / "
+                "shed / client_error / hedge)",
+                labelnames=("replica", "outcome"))
+    reg.counter("veles_router_hedges_total",
+                "hedged dispatches (first replica exceeded its "
+                "measured p99)")
+    reg.counter("veles_router_retries_total",
+                "dispatch retries after a replica failure or shed")
+    reg.gauge("veles_router_replicas_live",
+              "replicas currently routable (status up, beacon fresh)")
+    reg.gauge("veles_router_fleet_capacity",
+              "summed capacity hint across routable replicas — the "
+              "HPA-shaped autoscale signal (deploy/veles-serving.yaml)")
+    reg.histogram("veles_router_latency_seconds",
+                  "end-to-end /predict latency through the router "
+                  "(includes retries and hedges)",
+                  buckets=LATENCY_BUCKETS)
 
 
 _DEFAULT: Optional[MetricsRegistry] = None

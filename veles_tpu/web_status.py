@@ -42,6 +42,10 @@ background:#181818;border:1px solid #444;margin:1em 0"></svg>
 <th>host</th><th>devices</th><th>last seen</th><th>feed b/batch</th>
 <th>feed blocked (s)</th><th>on demand</th><th>mem max</th></tr></thead>
 <tbody></tbody></table>
+<table id="fleet" style="display:none"><thead><tr><th>replica</th>
+<th>status</th><th>circuit</th><th>capacity</th><th>inflight</th>
+<th>generation</th><th>gen age (s)</th><th>p99 (s)</th></tr></thead>
+<tbody></tbody></table>
 <table id="units"><thead><tr><th>unit</th><th>runs</th><th>time (s)</th>
 </tr></thead><tbody></tbody></table>
 <script>
@@ -72,6 +76,21 @@ async function tick(){
       `<td>${f.on_demand == null ? '-' : f.on_demand}</td>` +
       `<td>${mb(m.live_bytes_max)}</td>`;
     ptb.appendChild(tr);
+  }
+  const ft = document.getElementById('fleet');
+  const ftb = ft.querySelector('tbody'); ftb.innerHTML = '';
+  const fleet = (s.fleet && s.fleet.replicas) || [];
+  ft.style.display = fleet.length ? '' : 'none';
+  for (const r of fleet){
+    const tr = document.createElement('tr');
+    const dg = r.generation ? r.generation.slice(0, 12) : '-';
+    tr.innerHTML = `<td>${r.rid}</td><td>${r.status}</td>` +
+      `<td>${r.circuit}</td><td>${r.capacity}</td>` +
+      `<td>${r.inflight}</td><td>${dg}</td>` +
+      `<td>${r.generation_age_s == null ? '-'
+            : r.generation_age_s.toFixed(0)}</td>` +
+      `<td>${r.p99_s == null ? '-' : r.p99_s.toFixed(3)}</td>`;
+    ftb.appendChild(tr);
   }
   const tb = document.querySelector('#units tbody'); tb.innerHTML = '';
   for (const u of s.units){
@@ -191,12 +210,21 @@ class WebStatusServer:
     def __init__(self, workflow, host: str = "127.0.0.1",
                  port: int = 8090, token: Optional[str] = None,
                  max_workers: int = 256,
-                 profile_controller=None) -> None:
+                 profile_controller=None,
+                 fleet_source: Optional[str] = None) -> None:
         self.workflow = workflow
         self.host = host
         self.port = port
         self.token = token
         self.max_workers = max_workers
+        #: serving-fleet router base URL ("http://host:port"). When
+        #: set, /status.json carries a "fleet" key (the router's
+        #: GET /fleet registry view — per-replica generation digest /
+        #: age, capacity hint, circuit state) and the dashboard shows
+        #: the fleet table. The fetch reuses this server's token: the
+        #: fleet runs under ONE shared-token trust domain (SERVING.md).
+        self.fleet_source = fleet_source.rstrip("/") if fleet_source \
+            else None
         #: the live run's profile-window controller (telemetry/tracer):
         #: POST /profile arms an on-chip capture window on it
         self.profile_controller = profile_controller
@@ -248,6 +276,34 @@ class WebStatusServer:
                 out[k] = v
         return out
 
+    def _fetch_fleet(self) -> Optional[Dict[str, Any]]:
+        """One GET /fleet against the router; None on any failure (a
+        down router must not break the training dashboard)."""
+        if self.fleet_source is None:
+            return None
+        import http.client
+        from urllib.parse import urlsplit
+        try:
+            parts = urlsplit(self.fleet_source)
+            conn = http.client.HTTPConnection(
+                parts.hostname or "127.0.0.1", parts.port or 80,
+                timeout=2)
+            try:
+                headers = {}
+                if self.token:
+                    headers["X-Veles-Token"] = self.token
+                conn.request("GET", "/fleet", headers=headers)
+                resp = conn.getresponse()
+                body = resp.read(1 << 20)
+                if resp.status != 200:
+                    return None
+                fleet = json.loads(body)
+            finally:
+                conn.close()
+            return fleet if isinstance(fleet, dict) else None
+        except Exception:   # noqa: BLE001 — dashboard survives outages
+            return None
+
     def start(self) -> None:
         wf = self.workflow
         workers = self.workers
@@ -255,6 +311,7 @@ class WebStatusServer:
         token = self.token
         max_workers = self.max_workers
         clean = self._clean_beat
+        fetch_fleet = self._fetch_fleet
 
         profile_ctl = self.profile_controller
 
@@ -291,6 +348,9 @@ class WebStatusServer:
                         pid: {**{k: v for k, v in w.items() if k != "t"},
                               "age_s": round(now - w["t"], 3)}
                         for pid, w in snap}
+                    fleet = fetch_fleet()
+                    if fleet is not None:
+                        status["fleet"] = fleet
                     body = json.dumps(status).encode()
                     ctype = "application/json"
                 else:
@@ -387,8 +447,9 @@ class WebStatusServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolve port 0
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="web-status")
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="web-status")
         self._thread.start()
 
     def stop(self) -> None:
